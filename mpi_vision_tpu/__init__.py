@@ -1,0 +1,41 @@
+"""mpi_vision_tpu — a TPU-native multi-plane-image framework.
+
+JAX/XLA/Pallas re-design of the capabilities of Findeton/mpi-vision (a torch
+port of Google's Stereo Magnification): differentiable MPI rendering via
+plane-induced homographies and plane-sweep cost volumes, with the
+stereo-magnification U-Net + VGG-perceptual training pipeline, data loading,
+mesh-parallel batched rendering, and DeepView HTML viewer export built on top
+(see the ``models``, ``train``, ``data``, ``parallel`` and ``viewer``
+subpackages as they land; current public surface below).
+"""
+
+from mpi_vision_tpu.core.camera import (
+    crop_image_and_adjust_intrinsics,
+    crop_to_bounding_box,
+    deprocess_image,
+    intrinsics_matrix,
+    inv_depths,
+    preprocess_image,
+    scale_intrinsics,
+)
+from mpi_vision_tpu.core.compose import over_composite
+from mpi_vision_tpu.core.geometry import (
+    apply_homography,
+    from_homogeneous,
+    homogeneous_grid,
+    inverse_homography,
+    relative_pose,
+    safe_divide,
+)
+from mpi_vision_tpu.core.render import plane_homographies, render_mpi, warp_planes
+from mpi_vision_tpu.core.sampling import Convention, bilinear_sample
+from mpi_vision_tpu.core.sweep import (
+    cam2pixel,
+    pixel2cam,
+    plane_sweep,
+    plane_sweep_one,
+    projective_inverse_warp,
+    projective_pixel_transform,
+)
+
+__version__ = "0.1.0"

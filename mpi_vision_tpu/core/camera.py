@@ -1,0 +1,102 @@
+"""Camera intrinsics, depth-plane spacing, and image pre/de-processing.
+
+TPU-native counterpart of the reference's camera/image helpers
+(utils.py:297-318, 334-352, 535-546, 576-581, 601-651).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from mpi_vision_tpu.core import geometry, sampling
+
+
+def intrinsics_matrix(fx, fy, cx, cy, dtype=jnp.float32) -> jnp.ndarray:
+  """3x3 K from scalars. Reference: ``make_intrinsics_matrix`` (utils.py:576-581)."""
+  fx, fy, cx, cy = (jnp.asarray(v, dtype) for v in (fx, fy, cx, cy))
+  zero = jnp.zeros_like(fx)
+  one = jnp.ones_like(fx)
+  rows = jnp.stack([
+      jnp.stack([fx, zero, cx], axis=-1),
+      jnp.stack([zero, fy, cy], axis=-1),
+      jnp.stack([zero, zero, one], axis=-1),
+  ], axis=-2)
+  return rows
+
+
+def scale_intrinsics(intrinsics: jnp.ndarray, height, width) -> jnp.ndarray:
+  """Scale K by (height, width) factors (ratios or absolute sizes).
+
+  Reference: ``scale_intrinsics`` (utils.py:535-546) — elementwise multiply by
+  ``[[w, 1, w], [0, h, h], [0, 0, 1]]``.
+  """
+  scale = jnp.array(
+      [[width, 1.0, width], [0.0, height, height], [0.0, 0.0, 1.0]],
+      intrinsics.dtype,
+  )
+  return intrinsics * scale
+
+
+def inv_depths(start_depth: float, end_depth: float, num_depths: int) -> jnp.ndarray:
+  """Depths uniform in inverse depth, endpoints included, descending (far first).
+
+  Back-to-front compositing order. Reference: ``inv_depths`` (utils.py:297-318),
+  which builds [start, end] + interior samples, sorts ascending, reverses.
+  """
+  fractions = jnp.arange(1, num_depths - 1, dtype=jnp.float32) / (num_depths - 1)
+  inv_start = 1.0 / start_depth
+  inv_end = 1.0 / end_depth
+  interior = 1.0 / (inv_start + (inv_end - inv_start) * fractions)
+  depths = jnp.concatenate([
+      jnp.array([start_depth, end_depth], jnp.float32), interior])
+  return jnp.sort(depths)[::-1]
+
+
+def preprocess_image(image: jnp.ndarray) -> jnp.ndarray:
+  """float [0, 1] -> [-1, 1]. Reference: ``preprocess_image_torch`` (utils.py:334-342)."""
+  return image * 2.0 - 1.0
+
+
+def deprocess_image(image: jnp.ndarray) -> jnp.ndarray:
+  """[-1, 1] -> uint8 [0, 255]. Reference: ``deprocess_image_torch`` (utils.py:344-352)."""
+  return (((image + 1.0) / 2.0) * 255.0).astype(jnp.uint8)
+
+
+def crop_to_bounding_box(image: jnp.ndarray, offset_y, offset_x,
+                         height: int, width: int) -> jnp.ndarray:
+  """Differentiable crop via the bilinear sampler.
+
+  Builds the crop grid ``((x + offset_x + 0.5)/W_img, (y + offset_y + 0.5)/H_img)``
+  — the reference's (unswapped) crop convention (utils.py:601-620) — and
+  resamples. ``image``: ``[..., H, W, C]``; offsets may be traced scalars.
+
+  Returns ``[..., height, width, C]``.
+  """
+  img_h, img_w = image.shape[-3], image.shape[-2]
+  grid = geometry.homogeneous_grid(height, width)  # [3, h, w]
+  xy = jnp.moveaxis(grid[:2], 0, -1)  # [h, w, 2] (x, y)
+  offset = jnp.stack([jnp.asarray(offset_x, jnp.float32) + 0.5,
+                      jnp.asarray(offset_y, jnp.float32) + 0.5])
+  coords = (xy + offset) / jnp.array([img_w, img_h], jnp.float32)
+  coords = jnp.broadcast_to(coords, image.shape[:-3] + coords.shape)
+  return sampling.bilinear_sample(image, coords)
+
+
+def crop_image_and_adjust_intrinsics(
+    image: jnp.ndarray, intrinsics: jnp.ndarray,
+    offset_y, offset_x, height: int, width: int,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+  """Crop images and shift/renormalize the (normalized) intrinsics to match.
+
+  Reference: ``crop_image_and_adjust_intrinsics_torch`` (utils.py:622-651):
+  denormalize K to pixels, subtract the offset from (cx, cy), renormalize to
+  the crop size.
+  """
+  orig_h, orig_w = image.shape[-3], image.shape[-2]
+  pixel_k = scale_intrinsics(intrinsics, orig_h, orig_w)
+  shift = jnp.zeros_like(pixel_k)
+  shift = shift.at[..., 0, 2].set(jnp.asarray(offset_x, pixel_k.dtype))
+  shift = shift.at[..., 1, 2].set(jnp.asarray(offset_y, pixel_k.dtype))
+  cropped_k = scale_intrinsics(pixel_k - shift, 1.0 / height, 1.0 / width)
+  cropped = crop_to_bounding_box(image, offset_y, offset_x, height, width)
+  return cropped, cropped_k

@@ -1,0 +1,143 @@
+"""MPI rendering: plane-induced homography warps + over-compositing.
+
+TPU-native redesign of the reference homography path (utils.py:160-294):
+``mpi_render_view_torch -> projective_forward_homography_torch ->
+planar_transform_torch -> transform_plane_imgs_torch``. Instead of that call
+tower, everything reduces to three fused stages under one ``jit``:
+
+  1. one batched 3x3 solve for all P plane homographies (`plane_homographies`),
+  2. one einsum mapping the target grid through all P homographies,
+  3. either a fused ``lax.scan`` that warps a plane and immediately composites
+     it (never materializing the [P, B, H, W, 4] warped stack — the HBM-friendly
+     default for large frames), or a batched warp + composite ('scan'/'assoc'/
+     'pallas' methods, see core/compose.py).
+
+Layouts: MPIs enter as ``[B, H, W, P, 4]`` (the reference layout,
+utils.py:271) or planes-leading ``[P, B, H, W, 4]`` (the internal/fast layout).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from mpi_vision_tpu.core import compose, geometry, sampling
+from mpi_vision_tpu.core.sampling import Convention
+
+
+def plane_homographies(
+    tgt_pose: jnp.ndarray,
+    depths: jnp.ndarray,
+    intrinsics: jnp.ndarray,
+    tgt_intrinsics: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+  """Inverse homographies (target pixels -> source pixels) for every MPI plane.
+
+  Args:
+    tgt_pose: ``[B, 4, 4]`` transform from the MPI (source/reference) camera
+      frame to the target camera frame.
+    depths: ``[P]`` plane depths, descending (far -> near).
+    intrinsics: ``[B, 3, 3]`` source camera intrinsics.
+    tgt_intrinsics: optional ``[B, 3, 3]`` target intrinsics (defaults to the
+      source's, as in the reference, utils.py:260-261).
+
+  Returns:
+    ``[P, B, 3, 3]``.
+
+  Reference: ``projective_forward_homography_torch`` (utils.py:237-265) with
+  n_hat = [0, 0, 1] and a = -depth.
+  """
+  rot, t = geometry.pose_rt(tgt_pose)  # [B,3,3], [B,3,1]
+  p = depths.shape[0]
+  n_hat = jnp.broadcast_to(jnp.array([0.0, 0.0, 1.0]), (p, 1, 1, 3))
+  a = -depths.reshape(p, 1, 1, 1)
+  k_t = intrinsics if tgt_intrinsics is None else tgt_intrinsics
+  return geometry.inverse_homography(
+      intrinsics[None], k_t[None], rot[None], t[None], n_hat, a)
+
+
+def warp_coordinates(
+    homographies: jnp.ndarray,
+    height: int,
+    width: int,
+    convention: Convention = Convention.REF_HOMOGRAPHY,
+) -> jnp.ndarray:
+  """Normalized (0, 1) source-sampling coords for a target grid.
+
+  ``homographies``: ``[..., 3, 3]`` -> coords ``[..., H, W, 2]``.
+  """
+  grid = jnp.moveaxis(geometry.homogeneous_grid(height, width), 0, -1)  # [H,W,3]
+  pts = geometry.apply_homography(grid, homographies)
+  xy = geometry.from_homogeneous(pts)
+  return sampling.normalize_pixel_coords(xy, height, width, convention)
+
+
+def warp_planes(
+    planes: jnp.ndarray,
+    tgt_pose: jnp.ndarray,
+    depths: jnp.ndarray,
+    intrinsics: jnp.ndarray,
+    convention: Convention = Convention.REF_HOMOGRAPHY,
+) -> jnp.ndarray:
+  """Warp all MPI planes into the target view in one batched gather.
+
+  ``planes``: ``[P, B, H, W, C]`` -> ``[P, B, H, W, C]``.
+  """
+  _, _, h, w, _ = planes.shape
+  homs = plane_homographies(tgt_pose, depths, intrinsics)
+  coords = warp_coordinates(homs, h, w, convention)  # [P, B, H, W, 2]
+  return sampling.bilinear_sample(planes, coords)
+
+
+def render_mpi(
+    rgba_layers: jnp.ndarray,
+    tgt_pose: jnp.ndarray,
+    depths: jnp.ndarray,
+    intrinsics: jnp.ndarray,
+    convention: Convention = Convention.REF_HOMOGRAPHY,
+    method: str = "fused",
+    planes_leading: bool = False,
+) -> jnp.ndarray:
+  """Render a novel view from an MPI. The reference's ``mpi_render_view_torch``.
+
+  Args:
+    rgba_layers: ``[B, H, W, P, 4]`` MPI (or ``[P, B, H, W, 4]`` when
+      ``planes_leading``), planes ordered back-to-front (descending depth).
+    tgt_pose: ``[B, 4, 4]`` source-cam -> target-cam transform.
+    depths: ``[P]`` descending plane depths (see ``camera.inv_depths``).
+    intrinsics: ``[B, 3, 3]``.
+    convention: coordinate convention; REF_HOMOGRAPHY reproduces the reference
+      exactly (utils.py:188), EXACT is correct for non-square frames.
+    method: 'fused' scans warp+composite per plane with no [P,...] warped
+      stack in HBM; 'scan'/'assoc'/'pallas' warp all planes then composite
+      (see core/compose.py).
+
+  Returns:
+    ``[B, H, W, 3]`` rendered view.
+
+  Reference: utils.py:267-294.
+  """
+  planes = rgba_layers if planes_leading else jnp.moveaxis(rgba_layers, 3, 0)
+  _, _, h, w, _ = planes.shape
+  homs = plane_homographies(tgt_pose, depths, intrinsics)  # [P, B, 3, 3]
+
+  if method != "fused":
+    coords = warp_coordinates(homs, h, w, convention)
+    warped = sampling.bilinear_sample(planes, coords)
+    return compose.over_composite(warped, method=method)
+
+  def warp_one(plane, hom):
+    coords = warp_coordinates(hom, h, w, convention)
+    return sampling.bilinear_sample(plane, coords)
+
+  # Farthest plane: alpha ignored (utils.py:152-153).
+  out0 = warp_one(planes[0], homs[0])[..., :3]
+
+  def step(out, xs):
+    plane, hom = xs
+    rgba = warp_one(plane, hom)
+    rgb, alpha = rgba[..., :3], rgba[..., 3:]
+    return rgb * alpha + out * (1.0 - alpha), None
+
+  out, _ = jax.lax.scan(step, out0, (planes[1:], homs[1:]))
+  return out

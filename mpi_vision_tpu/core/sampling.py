@@ -1,0 +1,120 @@
+"""Bilinear image sampling with exact ``torch.nn.functional.grid_sample`` parity.
+
+XLA has no grid_sample; this implements the gather-based equivalent of torch's
+``grid_sample(mode='bilinear', padding_mode='zeros', align_corners=False)`` —
+the defaults used by both reference warp paths (utils.py:128, utils.py:404).
+
+Coordinate pipeline (matching the reference exactly):
+  * callers produce coords in a (0, 1) "normalized" space (x, y last-dim order);
+  * the reference maps them to grid_sample's (-1, 1) via ``-1 + 2c`` (utils.py:127);
+  * with ``align_corners=False`` torch maps a normalized coord g to the pixel
+    index ``((g + 1) * size - 1) / 2``. Composed: ``pixel = c * size - 0.5``.
+
+The three coordinate conventions that feed this sampler in the reference:
+  * homography path: ``c = (x/(H-1), y/(W-1))`` — note the x/height, y/width
+    swap (utils.py:188, quirk Q2; benign for square images only);
+  * projection path: ``c = ((x+0.5)/H, (y+0.5)/W)`` — same swap (utils.py:444, Q3);
+  * crop path: ``c = ((x+0.5)/W, (y+0.5)/H)`` — unswapped (utils.py:617-618).
+``Convention`` reproduces each so the parity suite can pin all three; EXACT is
+the recommended non-square-correct convention for new code.
+"""
+
+from __future__ import annotations
+
+import enum
+
+import jax.numpy as jnp
+
+
+class Convention(enum.Enum):
+  """How raw pixel coordinates are normalized into the (0, 1) sampler space."""
+
+  # x/(H-1), y/(W-1): reference homography/render path (utils.py:188).
+  REF_HOMOGRAPHY = "ref_homography"
+  # (x+0.5)/H, (y+0.5)/W: reference projection/plane-sweep path (utils.py:444).
+  REF_PROJECTION = "ref_projection"
+  # (x+0.5)/W, (y+0.5)/H: correct for non-square images; equals REF_PROJECTION
+  # on square inputs (and is the crop-path convention, utils.py:617-618).
+  EXACT = "exact"
+
+
+def normalize_pixel_coords(
+    coords_xy: jnp.ndarray,
+    height: int,
+    width: int,
+    convention: Convention = Convention.REF_HOMOGRAPHY,
+) -> jnp.ndarray:
+  """Map raw pixel (x, y) coords into the sampler's (0, 1) space per convention."""
+  if convention is Convention.REF_HOMOGRAPHY:
+    scale = jnp.array([height - 1, width - 1], coords_xy.dtype)
+    return coords_xy / scale
+  if convention is Convention.REF_PROJECTION:
+    scale = jnp.array([height, width], coords_xy.dtype)
+    return (coords_xy + 0.5) / scale
+  scale = jnp.array([width, height], coords_xy.dtype)
+  return (coords_xy + 0.5) / scale
+
+
+def bilinear_sample(image: jnp.ndarray, coords: jnp.ndarray) -> jnp.ndarray:
+  """Bilinearly sample ``image`` at normalized (0, 1) coords, zeros outside.
+
+  Exactly reproduces ``grid_sample(align_corners=False, padding_mode='zeros')``
+  fed with ``-1 + 2 * coords`` (the reference's ``bilinear_wrapper_torch`` /
+  ``resampler_wrapper_torch``, utils.py:104-134 / 395-407) — including its
+  treatment of out-of-range corners: each of the four gathered neighbours is
+  zeroed independently when it falls outside the image.
+
+  Args:
+    image: ``[..., H_s, W_s, C]``.
+    coords: ``[..., H_t, W_t, 2]`` with (x, y) in (0, 1) space; leading dims
+      broadcast against the image's.
+
+  Returns:
+    ``[..., H_t, W_t, C]`` sampled image (NHWC in and out — the reference's
+    quirk Q1 channel-first leak is not reproduced here; the torch-parity
+    harness compensates on the oracle side).
+  """
+  h_s, w_s = image.shape[-3], image.shape[-2]
+  lead = jnp.broadcast_shapes(image.shape[:-3], coords.shape[:-3])
+  image = jnp.broadcast_to(image, lead + image.shape[-3:])
+  coords = jnp.broadcast_to(coords, lead + coords.shape[-3:])
+  coords = coords.astype(jnp.float32)
+  # (0,1) space -> pixel index: c * size - 0.5 (align_corners=False).
+  px = coords[..., 0] * w_s - 0.5
+  py = coords[..., 1] * h_s - 0.5
+
+  x0 = jnp.floor(px)
+  y0 = jnp.floor(py)
+  wx = px - x0
+  wy = py - y0
+  x0 = x0.astype(jnp.int32)
+  y0 = y0.astype(jnp.int32)
+  x1 = x0 + 1
+  y1 = y0 + 1
+
+  def gather(ix, iy):
+    valid = ((ix >= 0) & (ix < w_s) & (iy >= 0) & (iy < h_s))
+    ix_c = jnp.clip(ix, 0, w_s - 1)
+    iy_c = jnp.clip(iy, 0, h_s - 1)
+    # Flatten spatial dims so the lookup is one gather along a single axis —
+    # the form XLA lowers best on TPU.
+    flat = image.reshape(image.shape[:-3] + (h_s * w_s, image.shape[-1]))
+    idx = iy_c * w_s + ix_c
+    taken = jnp.take_along_axis(
+        flat,
+        idx.reshape(idx.shape[:-2] + (-1,))[..., None],
+        axis=-2,
+    )
+    taken = taken.reshape(ix.shape + (image.shape[-1],))
+    return taken * valid[..., None].astype(image.dtype)
+
+  v00 = gather(x0, y0)
+  v01 = gather(x1, y0)
+  v10 = gather(x0, y1)
+  v11 = gather(x1, y1)
+
+  wx = wx[..., None]
+  wy = wy[..., None]
+  top = v00 * (1.0 - wx) + v01 * wx
+  bot = v10 * (1.0 - wx) + v11 * wx
+  return top * (1.0 - wy) + bot * wy

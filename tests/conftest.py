@@ -1,0 +1,39 @@
+"""Test harness config: run JAX on a virtual 8-device CPU mesh.
+
+Multi-chip TPU hardware is not available in CI; the standard JAX substitute is
+`--xla_force_host_platform_device_count` (SURVEY.md §4d). Must run before the
+first `import jax`, hence env mutation at conftest import time.
+"""
+
+import os
+
+_FLAG = "--xla_force_host_platform_device_count=8"
+_existing = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _existing:
+  os.environ["XLA_FLAGS"] = f"{_existing} {_FLAG}".strip()
+# Hard override: the ambient environment may point JAX at a tunneled TPU
+# (JAX_PLATFORMS=axon); tests must run on the virtual CPU mesh regardless.
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+# The axon PJRT plugin may already be registered by sitecustomize before this
+# conftest runs, and its (tunnelled) initialization hangs CPU-only test runs
+# even under JAX_PLATFORMS=cpu — drop the factory so it can never initialize.
+import jax._src.xla_bridge as _xb  # noqa: E402
+
+for _plat in ("axon", "tpu"):
+  _xb._backend_factories.pop(_plat, None)
+
+# jax was already imported by sitecustomize with JAX_PLATFORMS=axon baked into
+# its config; point the live config back at cpu as well.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+  return np.random.default_rng(0)

@@ -1,0 +1,77 @@
+"""Camera/intrinsics utilities: parity with reference formulas + round trips."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from mpi_vision_tpu.core import camera
+
+
+def _reference_inv_depths(start, end, num):
+  # Literal restatement of the reference algorithm (utils.py:297-318).
+  inv_s, inv_e = 1.0 / start, 1.0 / end
+  depths = [start, end]
+  for i in range(1, num - 1):
+    frac = float(i) / float(num - 1)
+    depths.append(1.0 / (inv_s + (inv_e - inv_s) * frac))
+  return sorted(depths)[::-1]
+
+
+def test_inv_depths_matches_reference():
+  for num in (2, 3, 10, 33):
+    got = np.asarray(camera.inv_depths(1.0, 100.0, num))
+    want = np.array(_reference_inv_depths(1.0, 100.0, num), np.float32)
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+    # Descending (far -> near), endpoints included.
+    assert got[0] == 100.0 and got[-1] == 1.0
+    assert (np.diff(got) < 0).all()
+
+
+def test_intrinsics_matrix():
+  k = np.asarray(camera.intrinsics_matrix(100.0, 110.0, 32.0, 24.0))
+  np.testing.assert_allclose(
+      k, [[100, 0, 32], [0, 110, 24], [0, 0, 1]])
+
+
+def test_intrinsics_matrix_batched():
+  k = np.asarray(camera.intrinsics_matrix(
+      jnp.array([1.0, 2.0]), jnp.array([3.0, 4.0]),
+      jnp.array([5.0, 6.0]), jnp.array([7.0, 8.0])))
+  assert k.shape == (2, 3, 3)
+  np.testing.assert_allclose(k[1], [[2, 0, 6], [0, 4, 8], [0, 0, 1]])
+
+
+def test_scale_intrinsics():
+  k = camera.intrinsics_matrix(0.5, 0.6, 0.5, 0.5)  # normalized
+  scaled = np.asarray(camera.scale_intrinsics(k, 224, 224))
+  np.testing.assert_allclose(
+      scaled, [[112, 0, 112], [0, 134.4, 112], [0, 0, 1]], rtol=1e-6)
+
+
+def test_preprocess_roundtrip(rng):
+  img01 = rng.uniform(0, 1, (4, 4, 3)).astype(np.float32)
+  pre = camera.preprocess_image(jnp.asarray(img01))
+  assert np.asarray(pre).min() >= -1 and np.asarray(pre).max() <= 1
+  post = np.asarray(camera.deprocess_image(pre))
+  assert post.dtype == np.uint8
+  np.testing.assert_allclose(post, (img01 * 255).astype(np.uint8), atol=1)
+
+
+def test_crop_to_bounding_box(rng):
+  img = rng.uniform(0, 1, (1, 16, 16, 3)).astype(np.float32)
+  crop = np.asarray(camera.crop_to_bounding_box(jnp.asarray(img), 2, 3, 8, 8))
+  # Differentiable crop at integer offsets == plain slicing.
+  np.testing.assert_allclose(crop, img[:, 2:10, 3:11], atol=1e-5)
+
+
+def test_crop_adjust_intrinsics(rng):
+  img = rng.uniform(0, 1, (1, 16, 16, 3)).astype(np.float32)
+  k = camera.intrinsics_matrix(0.5, 0.5, 0.5, 0.5)
+  cropped, k2 = camera.crop_image_and_adjust_intrinsics(
+      jnp.asarray(img), k, 4, 4, 8, 8)
+  assert cropped.shape == (1, 8, 8, 3)
+  # Center of crop (pixels 4..11) => cx in pixels = 8*0.5... check principal
+  # point shifted: pixel cx was 8, minus offset 4 => 4, normalized /8 => 0.5.
+  k2 = np.asarray(k2)
+  np.testing.assert_allclose(k2[0, 2], 0.5, rtol=1e-6)
+  np.testing.assert_allclose(k2[1, 2], 0.5, rtol=1e-6)
+  np.testing.assert_allclose(k2[0, 0], 1.0, rtol=1e-6)  # fx 0.5*16/8

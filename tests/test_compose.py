@@ -1,0 +1,68 @@
+"""Over-composite: scan vs associative-scan vs torch oracle + properties."""
+
+import jax.numpy as jnp
+import numpy as np
+import torch
+
+from mpi_vision_tpu.core import compose
+from mpi_vision_tpu.torchref import oracle
+
+
+def _random_mpi(rng, p=6, b=2, h=5, w=7):
+  rgba = rng.uniform(0, 1, (p, b, h, w, 4)).astype(np.float32)
+  return rgba
+
+
+def test_scan_matches_oracle(rng):
+  rgba = _random_mpi(rng)
+  got = np.asarray(compose.over_composite(jnp.asarray(rgba), method="scan"))
+  want = oracle.over_composite(torch.tensor(rgba)).numpy()
+  np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+def test_assoc_matches_scan(rng):
+  rgba = _random_mpi(rng, p=9)
+  a = np.asarray(compose.over_composite(jnp.asarray(rgba), method="scan"))
+  b = np.asarray(compose.over_composite(jnp.asarray(rgba), method="assoc"))
+  np.testing.assert_allclose(a, b, atol=1e-5)
+
+
+def test_first_plane_alpha_ignored(rng):
+  rgba = _random_mpi(rng)
+  rgba2 = rgba.copy()
+  rgba2[0, ..., 3] = 0.123  # must not matter
+  a = np.asarray(compose.over_composite(jnp.asarray(rgba)))
+  b = np.asarray(compose.over_composite(jnp.asarray(rgba2)))
+  np.testing.assert_allclose(a, b)
+
+
+def test_opaque_front_plane_wins(rng):
+  rgba = _random_mpi(rng)
+  rgba[-1, ..., 3] = 1.0
+  out = np.asarray(compose.over_composite(jnp.asarray(rgba)))
+  np.testing.assert_allclose(out, rgba[-1, ..., :3], atol=1e-6)
+
+
+def test_transparent_planes_passthrough(rng):
+  rgba = _random_mpi(rng)
+  rgba[1:, ..., 3] = 0.0
+  out = np.asarray(compose.over_composite(jnp.asarray(rgba)))
+  np.testing.assert_allclose(out, rgba[0, ..., :3], atol=1e-6)
+
+
+def test_single_plane(rng):
+  rgba = _random_mpi(rng, p=1)
+  out = np.asarray(compose.over_composite(jnp.asarray(rgba)))
+  np.testing.assert_allclose(out, rgba[0, ..., :3])
+
+
+def test_affine_combine_associative(rng):
+  rgba = jnp.asarray(_random_mpi(rng, p=4, b=1, h=2, w=2))
+  a, b = compose.plane_affine(rgba)
+  e = [(a[i], b[i]) for i in range(4)]
+  left = compose.combine_affine(compose.combine_affine(e[0], e[1]),
+                                compose.combine_affine(e[2], e[3]))
+  right = compose.combine_affine(
+      e[0], compose.combine_affine(e[1], compose.combine_affine(e[2], e[3])))
+  np.testing.assert_allclose(np.asarray(left[0]), np.asarray(right[0]), atol=1e-6)
+  np.testing.assert_allclose(np.asarray(left[1]), np.asarray(right[1]), atol=1e-6)

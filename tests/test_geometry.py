@@ -1,0 +1,98 @@
+"""Geometry parity vs the torch oracle + algebraic property tests."""
+
+import jax.numpy as jnp
+import numpy as np
+import torch
+
+from mpi_vision_tpu.core import geometry
+from mpi_vision_tpu.torchref import oracle
+
+
+def _random_pose(rng):
+  # Small random rotation via Rodrigues + small translation.
+  axis = rng.standard_normal(3)
+  axis = axis / np.linalg.norm(axis)
+  angle = rng.uniform(-0.3, 0.3)
+  k = np.array([[0, -axis[2], axis[1]], [axis[2], 0, -axis[0]],
+                [-axis[1], axis[0], 0]])
+  rot = np.eye(3) + np.sin(angle) * k + (1 - np.cos(angle)) * (k @ k)
+  t = rng.uniform(-0.2, 0.2, (3, 1))
+  return rot.astype(np.float32), t.astype(np.float32)
+
+
+def test_homogeneous_grid():
+  grid = np.asarray(geometry.homogeneous_grid(3, 5))
+  want = oracle.meshgrid_abs(1, 3, 5)[0].numpy()
+  np.testing.assert_allclose(grid, want)
+
+
+def test_safe_divide():
+  num = jnp.array([1.0, 2.0, 3.0])
+  den = jnp.array([0.0, 4.0, -2.0])
+  got = np.asarray(geometry.safe_divide(num, den))
+  want = oracle.safe_divide(torch.tensor([1.0, 2.0, 3.0]),
+                            torch.tensor([0.0, 4.0, -2.0])).numpy()
+  np.testing.assert_allclose(got, want)
+
+
+def test_inverse_homography_parity(rng):
+  rot, t = _random_pose(rng)
+  k = np.array([[100.0, 0, 32], [0, 100.0, 24], [0, 0, 1]], np.float32)
+  n_hat = np.array([[0.0, 0.0, 1.0]], np.float32)[None]
+  a = np.array([[[-2.5]]], np.float32)
+  got = np.asarray(geometry.inverse_homography(
+      jnp.asarray(k)[None], jnp.asarray(k)[None], jnp.asarray(rot)[None],
+      jnp.asarray(t)[None], jnp.asarray(n_hat), jnp.asarray(a)))
+  want = oracle.inverse_homography(
+      torch.tensor(k)[None], torch.tensor(k)[None], torch.tensor(rot)[None],
+      torch.tensor(t)[None], torch.tensor(n_hat), torch.tensor(a)).numpy()
+  np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-4)
+
+
+def test_identity_homography_is_identity():
+  # Identity pose => homography == identity for any plane.
+  k = jnp.array([[50.0, 0, 16], [0, 50.0, 16], [0, 0, 1]])[None]
+  rot = jnp.eye(3)[None]
+  t = jnp.zeros((1, 3, 1))
+  n_hat = jnp.array([[[0.0, 0.0, 1.0]]])
+  a = jnp.array([[[-3.0]]])
+  hom = np.asarray(geometry.inverse_homography(k, k, rot, t, n_hat, a))
+  np.testing.assert_allclose(hom[0], np.eye(3), atol=1e-5)
+
+
+def test_apply_homography_roundtrip(rng):
+  rot, t = _random_pose(rng)
+  k = np.array([[80.0, 0, 20], [0, 80.0, 20], [0, 0, 1]], np.float32)
+  n_hat = np.array([[[0.0, 0.0, 1.0]]], np.float32)
+  a = np.array([[[-4.0]]], np.float32)
+  hom = geometry.inverse_homography(
+      jnp.asarray(k)[None], jnp.asarray(k)[None], jnp.asarray(rot)[None],
+      jnp.asarray(t)[None], jnp.asarray(n_hat), jnp.asarray(a))
+  inv_hom = jnp.linalg.inv(hom)
+  pts = jnp.moveaxis(geometry.homogeneous_grid(6, 6), 0, -1)[None]
+  fwd = geometry.apply_homography(pts, hom)
+  back = geometry.apply_homography(fwd, inv_hom)
+  back = geometry.from_homogeneous(back)
+  np.testing.assert_allclose(
+      np.asarray(back), np.asarray(geometry.from_homogeneous(pts)),
+      atol=1e-3)
+
+
+def test_relative_pose_composition():
+  src = jnp.eye(4).at[:3, 3].set(jnp.array([1.0, 0, 0]))[None]
+  tgt = jnp.eye(4).at[:3, 3].set(jnp.array([0.0, 2.0, 0]))[None]
+  rel = np.asarray(geometry.relative_pose(src, tgt))
+  # rel maps src-cam coords to tgt-cam coords: p_tgt = rel @ p_src.
+  p_world = np.array([0.0, 0, 5.0, 1.0])
+  p_src = np.asarray(src)[0] @ p_world
+  p_tgt = np.asarray(tgt)[0] @ p_world
+  np.testing.assert_allclose(rel[0] @ p_src, p_tgt, atol=1e-6)
+
+
+def test_intrinsics_to_4x4():
+  k = jnp.array([[10.0, 0, 2], [0, 11.0, 3], [0, 0, 1]])
+  k4 = np.asarray(geometry.intrinsics_to_4x4(k[None]))[0]
+  assert k4.shape == (4, 4)
+  np.testing.assert_allclose(k4[:3, :3], np.asarray(k))
+  np.testing.assert_allclose(k4[3], [0, 0, 0, 1])
+  np.testing.assert_allclose(k4[:3, 3], 0)

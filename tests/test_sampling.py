@@ -1,0 +1,96 @@
+"""Parity: JAX bilinear sampler vs torch grid_sample (the whole 1e-3 budget).
+
+torch's F.grid_sample with its defaults (bilinear, zeros padding,
+align_corners=False) is the spec oracle, exercised through the oracle wrapper
+that reproduces the reference's (0,1)->(-1,1) mapping (utils.py:127).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import torch
+
+from mpi_vision_tpu.core import sampling
+from mpi_vision_tpu.core.sampling import Convention
+from mpi_vision_tpu.torchref import oracle
+
+TOL = 1e-5
+
+
+def _compare(img, coords):
+  got = np.asarray(sampling.bilinear_sample(jnp.asarray(img), jnp.asarray(coords)))
+  want = oracle.grid_sample_01(torch.tensor(img), torch.tensor(coords)).numpy()
+  np.testing.assert_allclose(got, want, atol=TOL, rtol=0)
+
+
+def test_in_range_square(rng):
+  img = rng.standard_normal((2, 16, 16, 3), dtype=np.float32)
+  coords = rng.uniform(0.1, 0.9, (2, 8, 8, 2)).astype(np.float32)
+  _compare(img, coords)
+
+
+def test_out_of_range_and_edges(rng):
+  # Coords spilling outside (0,1) must hit zero padding identically.
+  img = rng.standard_normal((1, 12, 12, 4), dtype=np.float32)
+  coords = rng.uniform(-0.5, 1.5, (1, 10, 10, 2)).astype(np.float32)
+  _compare(img, coords)
+
+
+def test_non_square(rng):
+  img = rng.standard_normal((3, 9, 17, 2), dtype=np.float32)
+  coords = rng.uniform(-0.2, 1.2, (3, 5, 7, 2)).astype(np.float32)
+  _compare(img, coords)
+
+
+def test_exact_pixel_centers(rng):
+  # Coord (i + 0.5)/size hits pixel i exactly under align_corners=False.
+  img = rng.standard_normal((1, 4, 6, 1), dtype=np.float32)
+  ys, xs = np.meshgrid(np.arange(4), np.arange(6), indexing="ij")
+  coords = np.stack([(xs + 0.5) / 6.0, (ys + 0.5) / 4.0], axis=-1)
+  coords = coords[None].astype(np.float32)
+  got = np.asarray(sampling.bilinear_sample(jnp.asarray(img), jnp.asarray(coords)))
+  np.testing.assert_allclose(got, img, atol=TOL, rtol=0)
+
+
+def test_leading_dims_broadcast(rng):
+  # Planes axis on the images, shared coords.
+  img = rng.standard_normal((4, 2, 8, 8, 3), dtype=np.float32)
+  coords = rng.uniform(0, 1, (2, 8, 8, 2)).astype(np.float32)
+  got = sampling.bilinear_sample(jnp.asarray(img), jnp.asarray(coords))
+  assert got.shape == (4, 2, 8, 8, 3)
+  want = oracle.grid_sample_01(
+      torch.tensor(img), torch.tensor(np.broadcast_to(coords, (4, 2, 8, 8, 2)).copy()))
+  np.testing.assert_allclose(np.asarray(got), want.numpy(), atol=TOL, rtol=0)
+
+
+def test_gradients_match_torch(rng):
+  import jax
+
+  img = rng.standard_normal((1, 8, 8, 2), dtype=np.float32)
+  coords = rng.uniform(-0.1, 1.1, (1, 6, 6, 2)).astype(np.float32)
+
+  def loss_jax(i, c):
+    return jnp.sum(sampling.bilinear_sample(i, c) ** 2)
+
+  gi, gc = jax.grad(loss_jax, argnums=(0, 1))(jnp.asarray(img), jnp.asarray(coords))
+
+  ti = torch.tensor(img, requires_grad=True)
+  tc = torch.tensor(coords, requires_grad=True)
+  loss = (oracle.grid_sample_01(ti, tc) ** 2).sum()
+  loss.backward()
+
+  np.testing.assert_allclose(np.asarray(gi), ti.grad.numpy(), atol=1e-4, rtol=1e-4)
+  np.testing.assert_allclose(np.asarray(gc), tc.grad.numpy(), atol=1e-3, rtol=1e-3)
+
+
+def test_conventions():
+  # REF_PROJECTION == EXACT on square sizes, differs on non-square.
+  xy = jnp.array([[[3.0, 2.0]]])
+  sq_a = sampling.normalize_pixel_coords(xy, 8, 8, Convention.REF_PROJECTION)
+  sq_b = sampling.normalize_pixel_coords(xy, 8, 8, Convention.EXACT)
+  np.testing.assert_allclose(np.asarray(sq_a), np.asarray(sq_b))
+  ns_a = sampling.normalize_pixel_coords(xy, 8, 16, Convention.REF_PROJECTION)
+  ns_b = sampling.normalize_pixel_coords(xy, 8, 16, Convention.EXACT)
+  assert not np.allclose(np.asarray(ns_a), np.asarray(ns_b))
+  # REF_HOMOGRAPHY divides by (dim - 1) with the x/height, y/width swap.
+  hom = sampling.normalize_pixel_coords(xy, 5, 9, Convention.REF_HOMOGRAPHY)
+  np.testing.assert_allclose(np.asarray(hom)[0, 0], [3.0 / 4.0, 2.0 / 8.0])

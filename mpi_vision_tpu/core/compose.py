@@ -81,9 +81,9 @@ def over_composite_assoc(rgba: jnp.ndarray) -> jnp.ndarray:
 def over_composite(rgba: jnp.ndarray, method: str = "scan") -> jnp.ndarray:
   """Composite ``[P, ..., 4]`` back-to-front RGBA planes to ``[..., 3]`` RGB.
 
-  ``method``: 'scan' (default), 'assoc', or 'pallas' (TPU kernel; requires the
-  trailing dims to be ``[H, W, 4]`` with a leading batch, see
-  kernels/compose_pallas.py).
+  ``method``: 'scan' (default), 'assoc', or 'pallas' (TPU kernel; requires
+  trailing ``[H, W, 4]`` dims, any — possibly zero — batch dims between P and
+  H; see kernels/compose_pallas.py).
   """
   if method == "scan":
     return over_composite_scan(rgba)

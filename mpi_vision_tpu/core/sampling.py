@@ -92,13 +92,14 @@ def bilinear_sample(image: jnp.ndarray, coords: jnp.ndarray) -> jnp.ndarray:
   x1 = x0 + 1
   y1 = y0 + 1
 
+  # Flatten spatial dims so each lookup is one gather along a single axis —
+  # the form XLA lowers best on TPU.
+  flat = image.reshape(image.shape[:-3] + (h_s * w_s, image.shape[-1]))
+
   def gather(ix, iy):
     valid = ((ix >= 0) & (ix < w_s) & (iy >= 0) & (iy < h_s))
     ix_c = jnp.clip(ix, 0, w_s - 1)
     iy_c = jnp.clip(iy, 0, h_s - 1)
-    # Flatten spatial dims so the lookup is one gather along a single axis —
-    # the form XLA lowers best on TPU.
-    flat = image.reshape(image.shape[:-3] + (h_s * w_s, image.shape[-1]))
     idx = iy_c * w_s + ix_c
     taken = jnp.take_along_axis(
         flat,

@@ -18,11 +18,20 @@ os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 
 # The axon PJRT plugin may already be registered by sitecustomize before this
 # conftest runs, and its (tunnelled) initialization hangs CPU-only test runs
-# even under JAX_PLATFORMS=cpu — drop the factory so it can never initialize.
+# even under JAX_PLATFORMS=cpu — swap in a quietly-failing factory so the
+# platform names stay *known* (Pallas import registers 'tpu' lowerings, which
+# requires that) but the tunnelled backend can never initialize.
 import jax._src.xla_bridge as _xb  # noqa: E402
 
+
+def _disabled_backend_factory(*args, **kwargs):
+  raise RuntimeError("tpu/axon backends are disabled under the CPU test mesh")
+
+
 for _plat in ("axon", "tpu"):
-  _xb._backend_factories.pop(_plat, None)
+  if _plat in _xb._backend_factories:
+    _xb.register_backend_factory(
+        _plat, _disabled_backend_factory, priority=-1000, fail_quietly=True)
 
 # jax was already imported by sitecustomize with JAX_PLATFORMS=axon baked into
 # its config; point the live config back at cpu as well.

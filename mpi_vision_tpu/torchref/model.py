@@ -1,0 +1,109 @@
+"""CPU-torch mirror of the stereo-magnification U-Net, for parity tests.
+
+Independent restatement of the reference model (notebook cell 10) in plain
+torch (no fastai): each block is conv -> [InstanceNorm2d(affine)] -> ReLU,
+transpose-conv decoder stages ks=4/s=2/p=1, norm-free 1x1 Tanh head. Block
+names ``cnv1_1 .. cnv8_1`` line up with the flax module so
+``models.stereo_mag.params_from_torch_state(model.state_dict())`` transfers
+weights exactly.
+
+``norm=None`` reproduces the notebook's *effective* configuration (fastai
+silently dropped the norm layers — see models/stereo_mag.py docstring).
+"""
+
+from __future__ import annotations
+
+import torch
+from torch import nn
+
+
+class _Block(nn.Module):
+
+  def __init__(self, cin: int, cout: int, ks: int = 3, stride: int = 1,
+               dilation: int = 1, transpose: bool = False,
+               norm: str | None = "instance", act: str | None = "relu"):
+    super().__init__()
+    if transpose:
+      self.conv = nn.ConvTranspose2d(cin, cout, ks, stride=stride, padding=1)
+    else:
+      pad = dilation * (ks - 1) // 2
+      self.conv = nn.Conv2d(cin, cout, ks, stride=stride, padding=pad,
+                            dilation=dilation)
+    self.norm = nn.InstanceNorm2d(cout, affine=True) if norm == "instance" else None
+    self.act = {"relu": nn.ReLU(), "tanh": nn.Tanh(), None: None}[act]
+
+  def forward(self, x):
+    x = self.conv(x)
+    if self.norm is not None:
+      x = self.norm(x)
+    if self.act is not None:
+      x = self.act(x)
+    return x
+
+
+class StereoMagnificationModel(nn.Module):
+  """NCHW torch twin of ``models.stereo_mag.StereoMagnificationModel``."""
+
+  def __init__(self, num_planes: int = 10, norm: str | None = "instance"):
+    super().__init__()
+    ngf = 3 + num_planes * 3
+    nout = 3 + num_planes * 2
+    self.num_planes = num_planes
+    self.cnv1_1 = _Block(ngf, ngf, norm=norm)
+    self.cnv1_2 = _Block(ngf, ngf * 2, stride=2, norm=norm)
+    self.cnv2_1 = _Block(ngf * 2, ngf * 2, norm=norm)
+    self.cnv2_2 = _Block(ngf * 2, ngf * 4, stride=2, norm=norm)
+    self.cnv3_1 = _Block(ngf * 4, ngf * 4, norm=norm)
+    self.cnv3_2 = _Block(ngf * 4, ngf * 4, norm=norm)
+    self.cnv3_3 = _Block(ngf * 4, ngf * 8, stride=2, norm=norm)
+    self.cnv4_1 = _Block(ngf * 8, ngf * 8, dilation=2, norm=norm)
+    self.cnv4_2 = _Block(ngf * 8, ngf * 8, dilation=2, norm=norm)
+    self.cnv4_3 = _Block(ngf * 8, ngf * 8, dilation=2, norm=norm)
+    self.cnv5_1 = _Block(ngf * 16, ngf * 4, ks=4, stride=2, transpose=True, norm=norm)
+    self.cnv5_2 = _Block(ngf * 4, ngf * 4, norm=norm)
+    self.cnv5_3 = _Block(ngf * 4, ngf * 4, norm=norm)
+    self.cnv6_1 = _Block(ngf * 8, ngf * 2, ks=4, stride=2, transpose=True, norm=norm)
+    self.cnv6_2 = _Block(ngf * 2, ngf * 2, norm=norm)
+    self.cnv7_1 = _Block(ngf * 4, nout, ks=4, stride=2, transpose=True, norm=norm)
+    self.cnv7_2 = _Block(nout, nout, norm=norm)
+    self.cnv8_1 = _Block(nout, nout, ks=1, norm=None, act="tanh")
+
+  def forward(self, x):
+    c1_1 = self.cnv1_1(x)
+    c1_2 = self.cnv1_2(c1_1)
+    c2_1 = self.cnv2_1(c1_2)
+    c2_2 = self.cnv2_2(c2_1)
+    c3_1 = self.cnv3_1(c2_2)
+    c3_2 = self.cnv3_2(c3_1)
+    c3_3 = self.cnv3_3(c3_2)
+    c4_1 = self.cnv4_1(c3_3)
+    c4_2 = self.cnv4_2(c4_1)
+    c4_3 = self.cnv4_3(c4_2)
+    c5_1 = self.cnv5_1(torch.cat([c4_3, c3_3], dim=1))
+    c5_2 = self.cnv5_2(c5_1)
+    c5_3 = self.cnv5_3(c5_2)
+    c6_1 = self.cnv6_1(torch.cat([c5_3, c2_2], dim=1))
+    c6_2 = self.cnv6_2(c6_1)
+    c7_1 = self.cnv7_1(torch.cat([c6_2, c1_2], dim=1))
+    c7_2 = self.cnv7_2(c7_1)
+    return self.cnv8_1(c7_2)
+
+
+def mpi_from_net_output(mpi_pred: torch.Tensor, ref_img: torch.Tensor) -> torch.Tensor:
+  """Reference MPI assembly (notebook cell 10), per-plane loop kept as-is.
+
+  ``mpi_pred``: ``[B, C, H, W]`` (NCHW, as the torch net emits);
+  ``ref_img``: ``[B, H, W, 3]``. Returns ``[B, H, W, P, 4]``.
+  """
+  b, _, h, w = mpi_pred.shape
+  pred = mpi_pred.permute(0, 2, 3, 1)
+  p = (pred.shape[-1] - 3) // 2
+  blend = (pred[..., :p] + 1.0) / 2.0
+  alphas = (pred[..., p:2 * p] + 1.0) / 2.0
+  bg = pred[..., -3:]
+  layers = []
+  for i in range(p):
+    wgt = blend[..., i:i + 1]
+    rgb = wgt * ref_img + (1.0 - wgt) * bg
+    layers.append(torch.cat([rgb, alphas[..., i:i + 1]], dim=3))
+  return torch.cat(layers, dim=3).reshape(b, h, w, p, 4)

@@ -1,0 +1,79 @@
+"""Stereo-magnification U-Net: shapes, gradients, and torch-mirror parity."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+from mpi_vision_tpu.models import stereo_mag
+from mpi_vision_tpu.torchref import model as torch_model
+
+
+def _init(num_planes, h, w, norm="instance"):
+  net = stereo_mag.StereoMagnificationModel(num_planes=num_planes, norm=norm)
+  x = jnp.zeros((1, h, w, 3 + 3 * num_planes))
+  params = net.init(jax.random.key(0), x)
+  return net, params
+
+
+def test_output_shape():
+  net, params = _init(3, 32, 32)
+  x = jnp.ones((2, 32, 32, 12))
+  y = net.apply(params, x)
+  assert y.shape == (2, 32, 32, 3 + 2 * 3)
+  assert np.all(np.abs(np.asarray(y)) <= 1.0)  # tanh head
+
+
+@pytest.mark.parametrize("norm", ["instance", None])
+def test_parity_with_torch_mirror(rng, norm):
+  p, h, w = 2, 16, 16
+  torch.manual_seed(0)  # unseeded draws occasionally push f32 divergence past atol
+  tnet = torch_model.StereoMagnificationModel(num_planes=p, norm=norm).eval()
+  jnet = stereo_mag.StereoMagnificationModel(num_planes=p, norm=norm)
+  params = stereo_mag.params_from_torch_state(tnet.state_dict(), norm=norm)
+
+  x = rng.uniform(-1.0, 1.0, size=(1, h, w, 3 + 3 * p)).astype(np.float32)
+  with torch.no_grad():
+    want = tnet(torch.tensor(np.transpose(x, (0, 3, 1, 2))))
+  want = np.transpose(want.numpy(), (0, 2, 3, 1))
+  got = np.asarray(jnet.apply(params, jnp.asarray(x)))
+  np.testing.assert_allclose(got, want, atol=5e-5)
+
+
+def test_mpi_from_net_output_parity(rng):
+  b, h, w, p = 2, 8, 8, 5
+  pred = rng.uniform(-1.0, 1.0, size=(b, h, w, 3 + 2 * p)).astype(np.float32)
+  ref = rng.uniform(-1.0, 1.0, size=(b, h, w, 3)).astype(np.float32)
+  got = np.asarray(stereo_mag.mpi_from_net_output(jnp.asarray(pred), jnp.asarray(ref)))
+  want = torch_model.mpi_from_net_output(
+      torch.tensor(np.transpose(pred, (0, 3, 1, 2))), torch.tensor(ref)).numpy()
+  assert got.shape == (b, h, w, p, 4)
+  np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+def test_gradients_flow(rng):
+  net, params = _init(2, 16, 16)
+  x = jnp.asarray(rng.uniform(-1, 1, size=(1, 16, 16, 9)).astype(np.float32))
+
+  @jax.jit
+  def loss(p):
+    return jnp.sum(net.apply(p, x) ** 2)
+
+  g = jax.grad(loss)(params)
+  leaves = jax.tree_util.tree_leaves(g)
+  assert all(np.isfinite(np.asarray(l)).all() for l in leaves)
+  assert any(float(jnp.abs(l).sum()) > 0 for l in leaves)
+
+
+def test_mpi_assembly_blend_extremes():
+  # w=1 -> plane RGB equals ref image; w=-(-1)=0 -> equals background.
+  b, h, w_, p = 1, 4, 4, 2
+  pred = np.zeros((b, h, w_, 3 + 2 * p), np.float32)
+  pred[..., 0] = 1.0   # plane 0 blend weight -> 1
+  pred[..., 1] = -1.0  # plane 1 blend weight -> 0
+  pred[..., -3:] = 0.5  # background
+  ref = np.full((b, h, w_, 3), -0.25, np.float32)
+  rgba = np.asarray(stereo_mag.mpi_from_net_output(jnp.asarray(pred), jnp.asarray(ref)))
+  np.testing.assert_allclose(rgba[..., 0, :3], -0.25, atol=1e-6)
+  np.testing.assert_allclose(rgba[..., 1, :3], 0.5, atol=1e-6)

@@ -97,6 +97,7 @@ def render_mpi(
     convention: Convention = Convention.REF_HOMOGRAPHY,
     method: str = "fused",
     planes_leading: bool = False,
+    separable: bool | None = None,
 ) -> jnp.ndarray:
   """Render a novel view from an MPI. The reference's ``mpi_render_view_torch``.
 
@@ -110,7 +111,14 @@ def render_mpi(
       exactly (utils.py:188), EXACT is correct for non-square frames.
     method: 'fused' scans warp+composite per plane with no [P,...] warped
       stack in HBM; 'scan'/'assoc'/'pallas' warp all planes then composite
-      (see core/compose.py).
+      (see core/compose.py); 'fused_pallas' runs warp+sample+composite as one
+      TPU kernel (kernels/render_pallas.py — the fastest path; requires
+      H % 8 == 0, H >= 24, W % 128 == 0, and W >= 256 for its separable
+      fast path).
+    separable: for 'fused_pallas' only — select the shared-gather fast path
+      (valid when the warps are axis-aligned: camera translation/zoom, no
+      rotation). None auto-detects when poses are concrete; under jit the
+      check cannot run, so pass True explicitly to keep the fast path.
 
   Returns:
     ``[B, H, W, 3]`` rendered view.
@@ -119,6 +127,21 @@ def render_mpi(
   """
   planes = rgba_layers if planes_leading else jnp.moveaxis(rgba_layers, 3, 0)
   _, _, h, w, _ = planes.shape
+
+  if method == "fused_pallas":
+    from mpi_vision_tpu.kernels import render_pallas
+    homs = render_pallas.pixel_homographies(
+        tgt_pose, depths, intrinsics, h, w, convention)    # [P, B, 3, 3]
+    if separable is None:
+      try:
+        separable = render_pallas.is_separable(homs)
+      except jax.errors.TracerArrayConversionError:
+        separable = False  # inside jit the check can't run; pass explicitly
+    planar = jnp.moveaxis(planes, -1, 2)                   # [P, B, 4, H, W]
+    outs = [render_pallas.render_mpi_fused(planar[:, b], homs[:, b], separable)
+            for b in range(planar.shape[1])]
+    return jnp.stack([jnp.moveaxis(o, 0, -1) for o in outs])
+
   homs = plane_homographies(tgt_pose, depths, intrinsics)  # [P, B, 3, 3]
 
   if method != "fused":

@@ -1,0 +1,162 @@
+"""Tests for the fused Pallas render kernel (interpret mode on the CPU mesh).
+
+The oracle is ``reference_render`` — the XLA gather path with the kernel's
+pixel-space contract — which is itself pinned against the public
+``render_mpi`` API (and transitively against the torch oracle by the
+existing render parity suite).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpi_vision_tpu.core import render
+from mpi_vision_tpu.core.camera import inv_depths
+from mpi_vision_tpu.core.sampling import Convention
+from mpi_vision_tpu.kernels import render_pallas as rp
+
+
+def _mpi(rng, p, h, w):
+  return jnp.asarray(rng.uniform(0, 1, (p, 4, h, w)).astype(np.float32))
+
+
+def _intrinsics(h, w):
+  return jnp.asarray(
+      np.array([[0.6 * w, 0, w / 2], [0, 0.6 * w, h / 2], [0, 0, 1]],
+               np.float32))[None]
+
+
+def _pose(tx=0.0, ty=0.0, tz=0.0, rx=0.0, ry=0.0):
+  pose = np.eye(4, dtype=np.float32)
+  cx, sx = np.cos(rx), np.sin(rx)
+  cy, sy = np.cos(ry), np.sin(ry)
+  rot_x = np.array([[1, 0, 0], [0, cx, -sx], [0, sx, cx]], np.float32)
+  rot_y = np.array([[cy, 0, sy], [0, 1, 0], [-sy, 0, cy]], np.float32)
+  pose[:3, :3] = rot_y @ rot_x
+  pose[:3, 3] = [tx, ty, tz]
+  return jnp.asarray(pose)[None]
+
+
+TRANSLATION = dict(tx=0.06, ty=-0.03, tz=-0.04)
+ROTATION = dict(tx=0.04, ty=0.02, tz=0.03, rx=0.006, ry=-0.008)
+
+
+class TestPixelHomographies:
+
+  @pytest.mark.parametrize("convention", list(Convention))
+  def test_matches_public_render_path(self, rng, convention):
+    """reference_render(pixel homs) == render_mpi for every convention."""
+    p, h, w = 4, 24, 256
+    planes = _mpi(rng, p, h, w)
+    depths = inv_depths(1.0, 100.0, p)
+    pose, k = _pose(**ROTATION), _intrinsics(h, w)
+    homs = rp.pixel_homographies(pose, depths, k, h, w, convention)
+    got = rp.reference_render(planes, homs[:, 0])
+    want = render.render_mpi(
+        jnp.moveaxis(planes, 1, -1)[:, None], pose, depths, k,
+        convention=convention, method="scan", planes_leading=True)[0]
+    # EXACT folds to the identity (bit-equal coords); the REF conventions
+    # fold the rescale into the 3x3, which reassociates float ops and can
+    # move a tap coordinate by ~1e-3 px — well inside the 1e-3 parity budget.
+    atol = 1e-5 if convention is Convention.EXACT else 2e-3
+    np.testing.assert_allclose(
+        np.moveaxis(np.asarray(got), 0, -1), np.asarray(want),
+        atol=atol, rtol=0)
+
+  def test_separable_detection(self):
+    depths = inv_depths(1.0, 100.0, 3)
+    k = _intrinsics(32, 256)
+    assert rp.is_separable(
+        rp.pixel_homographies(_pose(**TRANSLATION), depths, k, 32, 256))
+    assert not rp.is_separable(
+        rp.pixel_homographies(_pose(**ROTATION), depths, k, 32, 256))
+
+
+class TestFusedKernel:
+
+  @pytest.mark.parametrize("separable,pose_kw", [
+      (False, ROTATION),
+      (False, TRANSLATION),
+      (True, TRANSLATION),
+  ])
+  def test_parity_vs_reference(self, rng, separable, pose_kw):
+    p, h, w = 5, 32, 256
+    planes = _mpi(rng, p, h, w)
+    depths = inv_depths(1.0, 100.0, p)
+    homs = rp.pixel_homographies(
+        _pose(**pose_kw), depths, _intrinsics(h, w), h, w)[:, 0]
+    got = rp.render_mpi_fused(planes, homs, separable)
+    want = rp.reference_render(planes, homs)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=1e-4, rtol=0)
+
+  def test_identity_pose_is_identity_composite(self, rng):
+    p, h, w = 3, 24, 256
+    planes = _mpi(rng, p, h, w)
+    depths = inv_depths(1.0, 100.0, p)
+    homs = rp.pixel_homographies(
+        _pose(), depths, _intrinsics(h, w), h, w)[:, 0]
+    got = rp.render_mpi_fused(planes, homs, True)
+    want = rp.reference_render(planes, homs)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-6)
+
+  def test_zeros_padding_offscreen(self, rng):
+    """A large shift leaves out-of-image regions exactly black."""
+    p, h, w = 2, 24, 256
+    planes = jnp.ones((p, 4, h, w), jnp.float32)
+    depths = inv_depths(1.0, 100.0, p)
+    # Big sideways translation: part of the target view sees off-image.
+    homs = rp.pixel_homographies(
+        _pose(tx=1.2), depths, _intrinsics(h, w), h, w)[:, 0]
+    got = np.asarray(rp.render_mpi_fused(planes, homs, True))
+    want = np.asarray(rp.reference_render(planes, homs))
+    np.testing.assert_allclose(got, want, atol=1e-4, rtol=0)
+    assert (got == 0).any(), "expected some exactly-zero off-image pixels"
+
+  def test_non_square(self, rng):
+    p, h, w = 3, 40, 384
+    planes = _mpi(rng, p, h, w)
+    depths = inv_depths(1.0, 100.0, p)
+    homs = rp.pixel_homographies(
+        _pose(**ROTATION), depths, _intrinsics(h, w), h, w)[:, 0]
+    np.testing.assert_allclose(
+        np.asarray(rp.render_mpi_fused(planes, homs)),
+        np.asarray(rp.reference_render(planes, homs)), atol=1e-4, rtol=0)
+
+  def test_shape_validation(self, rng):
+    depths = inv_depths(1.0, 100.0, 2)
+    homs = rp.pixel_homographies(
+        _pose(), depths, _intrinsics(24, 256), 24, 256)[:, 0]
+    with pytest.raises(ValueError, match="multiple"):
+      rp.render_mpi_fused(jnp.zeros((2, 4, 30, 256)), homs)
+    with pytest.raises(ValueError, match="multiple"):
+      rp.render_mpi_fused(jnp.zeros((2, 4, 24, 200)), homs)
+
+  def test_gradients_flow_through_vjp(self, rng):
+    p, h, w = 3, 24, 256
+    planes = _mpi(rng, p, h, w)
+    depths = inv_depths(1.0, 100.0, p)
+    homs = rp.pixel_homographies(
+        _pose(**TRANSLATION), depths, _intrinsics(h, w), h, w)[:, 0]
+
+    g_fused = jax.grad(lambda x: rp.render_mpi_fused(x, homs).sum())(planes)
+    g_ref = jax.grad(lambda x: rp.reference_render(x, homs).sum())(planes)
+    np.testing.assert_allclose(
+        np.asarray(g_fused), np.asarray(g_ref), atol=1e-4, rtol=0)
+
+
+class TestRenderMpiIntegration:
+
+  def test_fused_pallas_method_matches_scan(self, rng):
+    p, h, w, b = 4, 24, 256, 2
+    mpi = jnp.asarray(rng.uniform(0, 1, (b, h, w, p, 4)).astype(np.float32))
+    depths = inv_depths(1.0, 100.0, p)
+    pose = jnp.concatenate([_pose(**TRANSLATION), _pose(**ROTATION)])
+    k = jnp.concatenate([_intrinsics(h, w)] * b)
+    got = render.render_mpi(mpi, pose, depths, k,
+                            convention=Convention.EXACT, method="fused_pallas")
+    want = render.render_mpi(mpi, pose, depths, k,
+                             convention=Convention.EXACT, method="scan")
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=1e-4, rtol=0)

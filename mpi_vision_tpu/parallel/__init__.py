@@ -1,0 +1,9 @@
+"""Mesh parallelism: DP view rendering, plane-sharded composite, placement."""
+
+from mpi_vision_tpu.parallel.mesh import (
+    make_mesh,
+    over_composite_planes_sharded,
+    render_views_sharded,
+    replicate,
+    shard_batch,
+)

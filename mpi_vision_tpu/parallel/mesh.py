@@ -1,0 +1,158 @@
+"""Device-mesh parallelism for MPI rendering and compositing.
+
+The reference is single-GPU (SURVEY.md §2: no torch.distributed, no NCCL —
+"mpi" means multi-plane image). Scaling on TPU is therefore new capability
+designed mesh-first, the standard JAX way: build a ``jax.sharding.Mesh``,
+annotate shardings, and let ``shard_map`` + XLA collectives place the
+communication on ICI.
+
+Two parallel axes exist in the workload (SURVEY.md §5.7):
+
+  * **views** — embarrassingly parallel. ``render_views_sharded`` shards a
+    batch of target poses over the ``data`` mesh axis with the MPI
+    replicated; zero cross-chip traffic inside the render.
+  * **planes** — the over-composite is a scan over planes, but each plane is
+    an affine map ``out -> rgb*a + (1-a)*out`` and affine maps compose
+    associatively (core/compose.py). ``over_composite_planes_sharded``
+    shards planes across the ``planes`` axis: every device folds its local
+    planes into ONE (A, B) pair, pairs are all-gathered (tiny: 4 channels x
+    pixels per device), and the ordered fold finishes locally. This is the
+    long-axis / sequence-parallel analogue for MPIs — the plane axis plays
+    the role sequence length plays in ring attention.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from mpi_vision_tpu.core import compose, render
+from mpi_vision_tpu.core.sampling import Convention
+
+
+def make_mesh(axis_names: tuple[str, ...] = ("data",),
+              shape: tuple[int, ...] | None = None,
+              devices=None) -> Mesh:
+  """A device mesh over all (or the given) devices.
+
+  Defaults to a 1-D ``('data',)`` mesh across every visible device; pass
+  ``shape`` for multi-axis layouts, e.g. ``axis_names=('data', 'planes'),
+  shape=(2, 4)``.
+  """
+  devices = jax.devices() if devices is None else devices
+  if shape is None:
+    shape = (len(devices),) + (1,) * (len(axis_names) - 1)
+  arr = np.asarray(devices).reshape(shape)
+  return Mesh(arr, axis_names)
+
+
+def render_views_sharded(
+    rgba_layers: jnp.ndarray,
+    tgt_poses: jnp.ndarray,
+    depths: jnp.ndarray,
+    intrinsics: jnp.ndarray,
+    mesh: Mesh,
+    axis: str = "data",
+    convention: Convention = Convention.REF_HOMOGRAPHY,
+    method: str = "fused",
+) -> jnp.ndarray:
+  """Render a batch of V target views, views sharded over a mesh axis.
+
+  The MPI (one scene) is replicated; each device renders ``V / n_devices``
+  views independently — the BASELINE config-4 layout (64 views over a DP
+  mesh). V must be divisible by the axis size.
+
+  Args:
+    rgba_layers: ``[H, W, P, 4]`` single-scene MPI, back-to-front.
+    tgt_poses: ``[V, 4, 4]`` source-cam -> target-cam transforms.
+    depths: ``[P]`` descending plane depths.
+    intrinsics: ``[3, 3]`` shared camera intrinsics.
+
+  Returns:
+    ``[V, H, W, 3]`` rendered views, sharded over ``axis``.
+  """
+  n = mesh.shape[axis]
+  v = tgt_poses.shape[0]
+  if v % n:
+    raise ValueError(f"view count {v} not divisible by mesh axis {axis}={n}")
+
+  def local_render(mpi, poses, k):
+    # mpi [1, H, W, P, 4] (replicated), poses [V/n, 4, 4].
+    vn = poses.shape[0]
+    planes = jnp.broadcast_to(mpi, (vn,) + mpi.shape[1:])
+    return render.render_mpi(planes, poses, depths, k.reshape(1, 3, 3).repeat(vn, 0),
+                             convention=convention, method=method)
+
+  fn = shard_map(
+      local_render, mesh=mesh,
+      in_specs=(P(), P(axis), P()),
+      out_specs=P(axis))
+  return fn(rgba_layers[None], tgt_poses, intrinsics)
+
+
+def over_composite_planes_sharded(
+    rgba: jnp.ndarray,
+    mesh: Mesh,
+    axis: str = "planes",
+) -> jnp.ndarray:
+  """Back-to-front composite with the plane axis sharded across devices.
+
+  ``rgba``: ``[P, ..., 4]`` back-to-front, P divisible by the axis size.
+  Same contract as ``core.compose.over_composite`` (farthest plane's alpha
+  ignored). Each device reduces its plane shard to one affine (A, B) pair
+  via ``associative_scan``; the tiny pairs are all-gathered and folded in
+  axis order — O(P/n) local work + one all-gather of 4/3-channel images.
+  """
+  p = rgba.shape[0]
+  n = mesh.shape[axis]
+  if p % n:
+    raise ValueError(f"plane count {p} not divisible by mesh axis {axis}={n}")
+
+  def local(shard):
+    # shard [P/n, ..., 4]; only the global index-0 plane gets first_opaque.
+    first = jax.lax.axis_index(axis) == 0
+    coeff, offset = compose.plane_affine(shard, first_opaque=False)
+    coeff = jnp.where(first, coeff.at[0].set(0.0), coeff)
+    offset = jnp.where(first, offset.at[0].set(shard[0, ..., :3]), offset)
+    a, b = jax.lax.associative_scan(compose.combine_affine, (coeff, offset),
+                                   axis=0)
+    a, b = a[-1], b[-1]                       # this shard as ONE affine map
+    a_all = jax.lax.all_gather(a, axis)       # [n, ..., 1]
+    b_all = jax.lax.all_gather(b, axis)       # [n, ..., 3]
+    out = b_all[0]
+    for i in range(1, n):                     # ordered fold, n is tiny
+      out = b_all[i] + a_all[i] * out
+    return out
+
+  # check_vma=False: the ordered fold after the all_gather yields the same
+  # value on every device, but shard_map cannot infer that replication.
+  fn = shard_map(local, mesh=mesh, in_specs=(P(axis),), out_specs=P(),
+                 check_vma=False)
+  return fn(rgba)
+
+
+def replicate(x, mesh: Mesh):
+  """Place a pytree fully replicated on ``mesh``."""
+  sharding = NamedSharding(mesh, P())
+  return jax.tree.map(lambda a: jax.device_put(a, sharding), x)
+
+
+def batch_spec(a, mesh: Mesh, axis: str = "data") -> P:
+  """Partition spec for one batch leaf: leading dim over ``axis`` when it
+  divides the axis size, else replicated (shared per-scene constants like
+  ``mpi_planes [P]`` ride along in batch dicts)."""
+  shardable = getattr(a, "ndim", 0) >= 1 and a.shape[0] % mesh.shape[axis] == 0
+  return P(axis) if shardable else P()
+
+
+def shard_batch(x, mesh: Mesh, axis: str = "data"):
+  """Place a pytree with its leading dim sharded over ``axis`` (leaves that
+  don't divide the axis size are replicated — see ``batch_spec``)."""
+  return jax.tree.map(
+      lambda a: jax.device_put(a, NamedSharding(mesh, batch_spec(a, mesh, axis))),
+      x)

@@ -1,0 +1,42 @@
+"""Torch mirror of the VGG16 feature extractor, for weight-transfer parity.
+
+Mirrors ``torchvision.models.vgg16().features[:23]`` structurally (the slice
+the reference's ``VGGPerceptualLoss`` consumes, notebook cell 12:21-24)
+without needing torchvision: plain Conv2d/ReLU/MaxPool in the torchvision
+layer order, with torchvision-compatible ``state_dict`` keys (``{i}.weight``)
+so ``train.vgg.params_from_torch_state`` accepts it directly.
+"""
+
+from __future__ import annotations
+
+import torch
+from torch import nn
+
+_CFG = [64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512]
+_TAP_LAYERS = (3, 8, 15, 22)  # relu1_2, relu2_2, relu3_3, relu4_3
+
+
+def build_features() -> nn.Sequential:
+  """`vgg16().features[:23]`-shaped Sequential (random init)."""
+  layers: list[nn.Module] = []
+  in_ch = 3
+  for c in _CFG:
+    if c == "M":
+      layers.append(nn.MaxPool2d(2, 2))
+    else:
+      layers.append(nn.Conv2d(in_ch, c, 3, padding=1))
+      layers.append(nn.ReLU(inplace=False))
+      in_ch = c
+  return nn.Sequential(*layers)
+
+
+@torch.no_grad()
+def extract_features(features: nn.Sequential,
+                     x: torch.Tensor) -> list[torch.Tensor]:
+  """The four perceptual-loss taps for NCHW input."""
+  taps = []
+  for i, layer in enumerate(features):
+    x = layer(x)
+    if i in _TAP_LAYERS:
+      taps.append(x)
+  return taps

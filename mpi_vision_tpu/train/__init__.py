@@ -1,0 +1,17 @@
+"""Training: losses (renderer-in-the-loss), optax loop, VGG16, orbax ckpt."""
+
+from mpi_vision_tpu.train.loop import (
+    TrainState,
+    create_train_state,
+    fit,
+    make_train_step,
+    restore_checkpoint,
+    save_checkpoint,
+    shard_train_step,
+)
+from mpi_vision_tpu.train.loss import (
+    l2_render_loss,
+    render_novel_view,
+    vgg_perceptual_loss,
+)
+from mpi_vision_tpu.train.vgg import VGG16Features, imagenet_normalize

@@ -1,0 +1,153 @@
+"""Training loop: optax Adam train step, mesh-sharded variant, orbax ckpt.
+
+The reference delegates training to fastai (``Learner.fit(20, lr=2e-4)``,
+notebook cells 14-16) with Adam defaults, bs=1, and no checkpointing. Here
+the loop is an explicit jitted step — pure ``(state, batch) -> (state,
+metrics)`` — plus:
+
+  * ``make_train_step`` — single-chip jit, VGG-perceptual or L2 loss;
+  * ``shard_train_step`` — the same step compiled with the batch sharded
+    over a mesh ``data`` axis and params/optimizer state replicated; XLA
+    inserts the gradient all-reduce over ICI (the DP layout the reference
+    never had, SURVEY.md §5.8);
+  * orbax checkpoint save/restore of the full train state (SURVEY.md §5.4:
+    absent upstream, supplied here idiomatically).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Mapping
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax.training import train_state
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from mpi_vision_tpu.models.stereo_mag import StereoMagnificationModel
+from mpi_vision_tpu.train import loss as loss_lib
+
+Batch = Mapping[str, jnp.ndarray]
+
+
+class TrainState(train_state.TrainState):
+  """Params + Adam state; the model stays outside (pure apply_fn)."""
+
+
+def create_train_state(
+    rng: jax.Array,
+    num_planes: int = 10,
+    image_size: tuple[int, int] = (224, 224),
+    learning_rate: float = 2e-4,
+    norm: str | None = "instance",
+) -> TrainState:
+  """Init model params and Adam (reference lr 2e-4, cells 15-16)."""
+  model = StereoMagnificationModel(num_planes=num_planes, norm=norm)
+  h, w = image_size
+  sample = jnp.zeros((1, h, w, 3 + 3 * num_planes), jnp.float32)
+  params = model.init(rng, sample)["params"]
+  return TrainState.create(
+      apply_fn=model.apply, params=params, tx=optax.adam(learning_rate))
+
+
+def make_loss_fn(vgg_params: Any | None,
+                 resize: int | None = 224) -> Callable[..., jnp.ndarray]:
+  """Loss closure: VGG-perceptual when ``vgg_params`` given, else L2."""
+
+  def loss_fn(params, apply_fn, batch: Batch):
+    mpi_pred = apply_fn({"params": params}, batch["net_input"])
+    if vgg_params is None:
+      return loss_lib.l2_render_loss(mpi_pred, batch)
+    return loss_lib.vgg_perceptual_loss(mpi_pred, batch, vgg_params, resize)
+
+  return loss_fn
+
+
+def _grad_step(loss_fn):
+  """The raw ``(state, batch) -> (state, metrics)`` update for a loss."""
+
+  def step(state: TrainState, batch: Batch):
+    loss, grads = jax.value_and_grad(loss_fn)(
+        state.params, state.apply_fn, batch)
+    state = state.apply_gradients(grads=grads)
+    return state, {"loss": loss}
+
+  return step
+
+
+def make_train_step(vgg_params: Any | None = None,
+                    resize: int | None = 224):
+  """A jitted ``(state, batch) -> (state, metrics)`` step."""
+  return jax.jit(_grad_step(make_loss_fn(vgg_params, resize)))
+
+
+def shard_train_step(mesh: Mesh, vgg_params: Any | None = None,
+                     resize: int | None = 224, axis: str = "data"):
+  """The train step compiled for a mesh: batch DP-sharded, state replicated.
+
+  Gradients are averaged across the ``axis`` shards by XLA (the loss means
+  over the batch dim, so sharding the batch IS data parallelism; the
+  all-reduce rides ICI). Returns ``step(state, batch)``; place ``state``
+  with ``replicate(state, mesh)`` and the batch with ``shard_batch``.
+  """
+  from mpi_vision_tpu.parallel.mesh import batch_spec
+
+  raw_step = _grad_step(make_loss_fn(vgg_params, resize))
+  repl = NamedSharding(mesh, P())
+
+  @functools.partial(jax.jit, donate_argnums=(0,))
+  def step(state: TrainState, batch: Batch):
+    batch = jax.lax.with_sharding_constraint(
+        batch, jax.tree.map(
+            lambda a: NamedSharding(mesh, batch_spec(a, mesh, axis)), batch))
+    out_state, metrics = raw_step(state, batch)
+    out_state = jax.lax.with_sharding_constraint(
+        out_state, jax.tree.map(lambda _: repl, out_state))
+    return out_state, metrics
+
+  return step
+
+
+def fit(state: TrainState, batches, step=None, log_every: int = 0):
+  """Minimal epoch driver over an iterable of batches; returns final state
+  and the list of per-step losses.
+
+  Losses stay on-device during the loop (converting per step would block
+  async dispatch); they are fetched once at the end, or on ``log_every``
+  boundaries when periodic logging is requested.
+  """
+  step = step or make_train_step()
+  losses = []
+  for i, batch in enumerate(batches):
+    state, metrics = step(state, batch)
+    losses.append(metrics["loss"])
+    if log_every and i % log_every == 0:
+      print(f"step {i}: loss {float(losses[-1]):.4f}")
+  return state, [float(l) for l in jax.device_get(losses)]
+
+
+# --- Checkpointing (orbax) -------------------------------------------------
+
+
+def save_checkpoint(path: str, state: TrainState) -> None:
+  """Write params + opt state + step to ``path`` (an absolute directory)."""
+  import orbax.checkpoint as ocp
+
+  with ocp.StandardCheckpointer() as ckptr:
+    ckptr.save(path, {"params": state.params,
+                      "opt_state": state.opt_state,
+                      "step": state.step})
+
+
+def restore_checkpoint(path: str, state: TrainState) -> TrainState:
+  """Restore into an abstract-compatible ``state`` (same model/optimizer)."""
+  import orbax.checkpoint as ocp
+
+  with ocp.StandardCheckpointer() as ckptr:
+    target = {"params": state.params, "opt_state": state.opt_state,
+              "step": state.step}
+    restored = ckptr.restore(path, target)
+  return state.replace(params=restored["params"],
+                       opt_state=restored["opt_state"],
+                       step=restored["step"])

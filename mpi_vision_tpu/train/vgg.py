@@ -1,0 +1,100 @@
+"""VGG16 feature extractor (flax, NHWC) for the perceptual loss.
+
+Reference: ``VGGPerceptualLoss`` (fast-torch-stereo-vision.ipynb cell 12)
+slices ``torchvision.models.vgg16(pretrained=True).features`` into four
+blocks — ``[:4], [4:9], [9:16], [16:23]`` — i.e. activations after relu1_2,
+relu2_2, relu3_3 and relu4_3. This module reproduces exactly those taps.
+
+Pretrained weights: this environment has no torchvision model zoo and no
+network egress, so there is no baked-in ImageNet checkpoint. The supported
+flows are (a) ``params_from_torch_state`` — transfer a torchvision-format
+``state_dict`` (tensors or arrays, e.g. from an ``.npz``) once and save it
+with orbax; (b) ``init_params`` — deterministic He-style random features,
+which still yield a usable (if weaker) perceptual metric and keep every test
+hermetic. The torch mirror for parity tests lives in ``torchref/vgg.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# torchvision vgg16.features layout: (layer index, out channels); 'M' = pool.
+_CFG = [64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512]
+# Indices (into torchvision .features) of the convs we instantiate, in order;
+# used by params_from_torch_state. relu4_3 is features[22], so convs up to
+# index 21 participate.
+_TORCH_CONV_INDICES = [0, 2, 5, 7, 10, 12, 14, 17, 19, 21]
+# Taps: after relu1_2 (block 0), relu2_2, relu3_3, relu4_3.
+_TAPS_AFTER_CONV = {2: 0, 4: 1, 7: 2, 10: 3}
+
+
+class VGG16Features(nn.Module):
+  """Returns the four perceptual-loss feature maps for NHWC input."""
+
+  @nn.compact
+  def __call__(self, x: jnp.ndarray) -> list[jnp.ndarray]:
+    taps = []
+    conv_i = 0
+    for c in _CFG:
+      if c == "M":
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        continue
+      x = nn.Conv(c, (3, 3), padding=((1, 1), (1, 1)),
+                  name=f"conv{conv_i}")(x)
+      x = nn.relu(x)
+      conv_i += 1
+      if conv_i in _TAPS_AFTER_CONV:
+        taps.append(x)
+    return taps
+
+
+def init_params(rng_seed: int = 0):
+  """Deterministic random-feature params (hermetic fallback, see module doc)."""
+  model = VGG16Features()
+  return model.init(jax.random.PRNGKey(rng_seed),
+                    jnp.zeros((1, 32, 32, 3), jnp.float32))
+
+
+def params_from_torch_state(state: dict[str, Any]):
+  """Map a torchvision ``vgg16().features`` state dict onto this module.
+
+  Accepts keys ``features.{i}.weight/bias`` or ``{i}.weight/bias`` with torch
+  tensors or numpy arrays ([out, in, kh, kw] conv layout).
+  """
+  def get(i, leaf):
+    for key in (f"features.{i}.{leaf}", f"{i}.{leaf}"):
+      if key in state:
+        v = state[key]
+        v = v.detach().cpu().numpy() if hasattr(v, "detach") else np.asarray(v)
+        return v
+    raise KeyError(f"missing VGG16 weight {i}.{leaf}")
+
+  params = {}
+  for conv_i, torch_i in enumerate(_TORCH_CONV_INDICES):
+    params[f"conv{conv_i}"] = {
+        "kernel": np.transpose(get(torch_i, "weight"), (2, 3, 1, 0)),
+        "bias": get(torch_i, "bias"),
+    }
+  return {"params": params}
+
+
+# ImageNet normalization constants (notebook cell 12, mean_const/std_const).
+IMAGENET_MEAN = np.array([0.485, 0.456, 0.406], np.float32)
+IMAGENET_STD = np.array([0.229, 0.224, 0.225], np.float32)
+
+
+def imagenet_normalize(img: jnp.ndarray) -> jnp.ndarray:
+  """NHWC RGB -> ``(img - mean) / std``, exactly as the reference loss.
+
+  Note the reference applies the ImageNet constants DIRECTLY to its [-1, 1]
+  images (cell 12: ``input = (input-self.mean_const) / self.std_const`` with
+  no [0, 1] rescale) — arguably a quirk, but the published loss curve
+  (BASELINE.md, final valid 1.3152) depends on it, so it is reproduced
+  verbatim here.
+  """
+  return (img - IMAGENET_MEAN) / IMAGENET_STD

@@ -1,0 +1,13 @@
+"""Data pipeline: RealEstate10K parsing, triplet sampling, PSV net inputs."""
+
+from mpi_vision_tpu.data.realestate import (
+    RealEstateDataset,
+    Scene,
+    draw_triplet,
+    iterate_batches,
+    load_scenes,
+    make_example,
+    parse_camera_lines,
+    read_file_lines,
+    synthesize_dataset,
+)

@@ -1,0 +1,231 @@
+"""RealEstate10K data pipeline: camera parsing, triplet sampling, PSV input.
+
+Reference: ``RealEstateDataset`` + ``parse_camera_lines`` (notebook cells
+6/8; duplicated in utils.py:583-598, 689-721 — collapsed to one definition
+here per SURVEY.md §2.8). Layout on disk (the reduced dataset, cell 2):
+
+    <root>/RealEstate10K/{train,test}/<scene>.txt   camera files
+    <root>/transcode/<youtube_id>/<timestamp>.jpg   frames
+
+Camera file format: first line is the YouTube URL; each subsequent line is
+``timestamp fx fy px py k1 k2 row0(4) row1(4) row2(4)`` with normalized
+intrinsics and a 3x4 world-to-camera pose (k1 = k2 = 0 asserted, as in the
+reference, utils.py:706).
+
+The host side stays numpy/PIL; the per-example plane-sweep volume runs
+through the jitted ``core.sweep`` path. Examples come out NHWC with
+``net_input [H, W, 3 + 3P]`` (reference image ++ PSV of the source image in
+the reference frame) and the dep-var dict the losses consume
+(``train/loss.py``). ``synthesize_dataset`` writes a tiny procedural scene
+set in the same layout so tests and benchmarks never need the external 4 GB
+repo (SURVEY.md §7 hard part #5).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Any, Iterator, Mapping, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from mpi_vision_tpu.core.camera import inv_depths, intrinsics_matrix, preprocess_image
+from mpi_vision_tpu.core.sweep import plane_sweep_one
+
+
+def read_file_lines(path: str) -> list[str]:
+  """Non-empty lines of a text file, ``#`` comment lines dropped
+  (utils.py:583-598)."""
+  with open(path) as f:
+    return [ln.rstrip("\n") for ln in f
+            if ln.strip() and not ln.lstrip().startswith("#")]
+
+
+@dataclass
+class Scene:
+  """One RealEstate10K view sequence (cameras only, images on disk)."""
+
+  youtube_id: str
+  timestamps: list[int]
+  intrinsics: np.ndarray  # [N, 4] normalized (fx, fy, cx, cy)
+  poses: np.ndarray       # [N, 4, 4] world-to-camera
+
+
+def parse_camera_lines(lines: Sequence[str]) -> Scene:
+  """Parse a camera file (utils.py:689-721). Asserts k1 = k2 = 0."""
+  url = lines[0]
+  youtube_id = url[url.find("/watch?v=") + len("/watch?v="):]
+  data = [[int(f) if i == 0 else float(f)
+           for i, f in enumerate(ln.split(" "))] for ln in lines[1:]]
+  if any(row[5] != 0.0 or row[6] != 0.0 for row in data):
+    raise ValueError("non-zero radial distortion (k1/k2) not supported "
+                     "(reference asserts the same, utils.py:706)")
+  poses = np.array(
+      [[row[7:11], row[11:15], row[15:19], [0.0, 0.0, 0.0, 1.0]]
+       for row in data], np.float32)
+  return Scene(
+      youtube_id=youtube_id,
+      timestamps=[row[0] for row in data],
+      intrinsics=np.array([row[1:5] for row in data], np.float32),
+      poses=poses,
+  )
+
+
+def load_scenes(dataset_path: str, split: str = "train") -> list[Scene]:
+  """All scenes of a split (``RealEstate10K/{train,test}`` camera files)."""
+  base = os.path.join(dataset_path, "RealEstate10K", split)
+  return [parse_camera_lines(read_file_lines(os.path.join(base, name)))
+          for name in sorted(os.listdir(base))]
+
+
+def draw_triplet(scene: Scene, rng: np.random.Generator,
+                 min_dist: float = 16e3, max_dist: float = 500e3) -> list[int]:
+  """(ref, src, tgt) frame indices with timestamp distance in
+  [min_dist, max_dist] from the reference (cell 8:29-38)."""
+  n = len(scene.timestamps)
+  ref = int(rng.integers(n))
+  base = scene.timestamps[ref]
+  near = [i for i in range(n)
+          if min_dist <= abs(base - scene.timestamps[i]) <= max_dist]
+  if len(near) < 2:
+    raise ValueError(
+        f"scene {scene.youtube_id}: <2 frames within timestamp window of "
+        f"frame {ref} (reference asserts the same, cell 8:34)")
+  src = int(rng.choice(near))
+  tgt = int(rng.choice([i for i in near if i != src]))
+  return [ref, src, tgt]
+
+
+def _load_frame(dataset_path: str, scene: Scene, index: int,
+                img_size: int) -> dict[str, np.ndarray]:
+  from PIL import Image
+
+  fx, fy, cx, cy = (img_size * scene.intrinsics[index]).tolist()
+  path = os.path.join(dataset_path, "transcode", scene.youtube_id,
+                      f"{scene.timestamps[index]}.jpg")
+  img = Image.open(path).convert("RGB").resize((img_size, img_size))
+  image = np.asarray(preprocess_image(np.asarray(img, np.float32) / 255.0))
+  return {
+      "image": image,                                        # [S, S, 3] NHWC
+      "intrinsics": np.asarray(intrinsics_matrix(fx, fy, cx, cy)),
+      "pose": scene.poses[index],
+  }
+
+
+def make_example(dataset_path: str, scene: Scene, indexes: Sequence[int],
+                 img_size: int = 224, num_planes: int = 10,
+                 depths: tuple[float, float] = (1.0, 100.0)) -> dict[str, Any]:
+  """One training example from a (ref, src, tgt) triplet (cell 8:45-87)."""
+  ref, src, tgt = (_load_frame(dataset_path, scene, i, img_size)
+                   for i in indexes)
+  planes = jnp.asarray(np.asarray(inv_depths(*depths, num_planes)))
+  rel = src["pose"] @ np.linalg.inv(ref["pose"])             # cell 8:74
+  psv = plane_sweep_one(jnp.asarray(src["image"]), planes,
+                        jnp.asarray(rel), jnp.asarray(src["intrinsics"]))
+  net_input = jnp.concatenate(
+      [jnp.asarray(ref["image"])[None], psv], axis=-1)[0]    # [S, S, 3+3P]
+  return {
+      "net_input": np.asarray(net_input),
+      "tgt_img_cfw": tgt["pose"],
+      "tgt_img": tgt["image"],
+      "ref_img": ref["image"],
+      "ref_img_wfc": np.linalg.inv(ref["pose"]).astype(np.float32),
+      "intrinsics": src["intrinsics"],
+      "mpi_planes": np.asarray(planes),
+  }
+
+
+@dataclass
+class RealEstateDataset:
+  """The reference dataset: one example per scene per epoch.
+
+  ``is_valid`` uses the fixed triplet [0, 1, 2] (cell 8:42-43); training
+  draws randomly per access from ``rng``.
+  """
+
+  dataset_path: str
+  is_valid: bool = False
+  min_dist: float = 16e3
+  max_dist: float = 500e3
+  img_size: int = 224
+  num_planes: int = 10
+  rng: np.random.Generator = field(default_factory=np.random.default_rng)
+  scenes: list[Scene] = field(init=False)
+
+  def __post_init__(self):
+    self.scenes = load_scenes(self.dataset_path,
+                              "test" if self.is_valid else "train")
+
+  def __len__(self) -> int:
+    return len(self.scenes)
+
+  def __getitem__(self, i: int) -> dict[str, Any]:
+    scene = self.scenes[i]
+    indexes = ([0, 1, 2] if self.is_valid
+               else draw_triplet(scene, self.rng, self.min_dist, self.max_dist))
+    return make_example(self.dataset_path, scene, indexes,
+                        self.img_size, self.num_planes)
+
+
+def iterate_batches(dataset: RealEstateDataset, batch_size: int = 1,
+                    shuffle: bool = True,
+                    rng: np.random.Generator | None = None
+                    ) -> Iterator[Mapping[str, jnp.ndarray]]:
+  """Collate examples into jnp batch dicts (reference bs=1, cell 8:97-101).
+
+  ``mpi_planes`` is stacked to [B, P] exactly as a torch dataloader would;
+  the losses use row 0 (the reference's ``dep['mpi_planes'][0]``).
+  """
+  order = np.arange(len(dataset))
+  if shuffle:
+    (rng or np.random.default_rng()).shuffle(order)
+  for start in range(0, len(order) - batch_size + 1, batch_size):
+    examples = [dataset[int(i)] for i in order[start:start + batch_size]]
+    yield {k: jnp.asarray(np.stack([e[k] for e in examples]))
+           for k in examples[0]}
+
+
+def synthesize_dataset(root: str, num_scenes: int = 3, frames: int = 4,
+                       img_size: int = 64, seed: int = 0) -> str:
+  """Write a tiny procedural dataset in the RealEstate10K layout.
+
+  Scenes are textured gradients with drifting blobs viewed by a camera
+  trucking sideways; timestamps are spaced so the reference min_dist=16e3
+  window admits triplets. Purely for hermetic tests/benchmarks.
+  """
+  from PIL import Image
+
+  rng = np.random.default_rng(seed)
+  for s in range(num_scenes):
+    vid = f"synth{s:03d}"
+    for split in ("train", "test"):
+      os.makedirs(os.path.join(root, "RealEstate10K", split), exist_ok=True)
+    os.makedirs(os.path.join(root, "transcode", vid), exist_ok=True)
+
+    lines = [f"https://www.youtube.com/watch?v={vid}"]
+    yy, xx = np.mgrid[0:img_size, 0:img_size].astype(np.float32) / img_size
+    blobs = rng.uniform(0.15, 0.85, (6, 2)).astype(np.float32)
+    colors = rng.uniform(0.2, 1.0, (6, 3)).astype(np.float32)
+    for f in range(frames):
+      ts = 16000 * (f + 1)
+      shift = 0.04 * f
+      img = np.stack([0.6 * xx, 0.5 * yy, 0.4 * (xx + yy) / 2], -1)
+      for (bx, by), col in zip(blobs, colors):
+        d2 = (xx - bx + shift) ** 2 + (yy - by) ** 2
+        img = img + col * np.exp(-d2 / 0.004)[..., None] * 0.5
+      img8 = (np.clip(img, 0, 1) * 255).astype(np.uint8)
+      Image.fromarray(img8).save(
+          os.path.join(root, "transcode", vid, f"{ts}.jpg"))
+
+      pose = np.eye(4, dtype=np.float32)
+      pose[0, 3] = -0.1 * f  # camera trucking right in world space
+      row = ([str(ts), "0.9", "0.9", "0.5", "0.5", "0", "0"]
+             + [f"{v:.6f}" for v in pose[:3].reshape(-1)])
+      lines.append(" ".join(row))
+
+    for split in ("train", "test"):
+      with open(os.path.join(root, "RealEstate10K", split,
+                             f"{vid}.txt"), "w") as fh:
+        fh.write("\n".join(lines) + "\n")
+  return root

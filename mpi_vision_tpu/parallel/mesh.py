@@ -143,10 +143,15 @@ def replicate(x, mesh: Mesh):
 
 
 def batch_spec(a, mesh: Mesh, axis: str = "data") -> P:
-  """Partition spec for one batch leaf: leading dim over ``axis`` when it
-  divides the axis size, else replicated (shared per-scene constants like
-  ``mpi_planes [P]`` ride along in batch dicts)."""
-  shardable = getattr(a, "ndim", 0) >= 1 and a.shape[0] % mesh.shape[axis] == 0
+  """Partition spec for one batch leaf.
+
+  Rank >= 2 leaves with a divisible leading dim are batch-sharded; rank <= 1
+  leaves are treated as shared per-scene constants (``mpi_planes [P]``) and
+  replicated — a divisibility test alone would mis-shard such constants
+  whenever P happens to divide the device count. Known limitation: a genuine
+  rank-1 per-sample leaf (e.g. scalar labels ``[B]``) is also replicated.
+  """
+  shardable = getattr(a, "ndim", 0) >= 2 and a.shape[0] % mesh.shape[axis] == 0
   return P(axis) if shardable else P()
 
 

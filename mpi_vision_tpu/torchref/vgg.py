@@ -12,8 +12,9 @@ from __future__ import annotations
 import torch
 from torch import nn
 
-_CFG = [64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512]
-_TAP_LAYERS = (3, 8, 15, 22)  # relu1_2, relu2_2, relu3_3, relu4_3
+# Layout imported from the flax side — one definition feeds both mirrors, so
+# the weight-transfer parity test is structurally tied to the same cfg.
+from mpi_vision_tpu.train.vgg import _CFG, _TORCH_TAP_INDICES as _TAP_LAYERS
 
 
 def build_features() -> nn.Sequential:

@@ -23,14 +23,33 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-# torchvision vgg16.features layout: (layer index, out channels); 'M' = pool.
+# torchvision vgg16.features layout up to relu4_3 (features[:23]); 'M' = pool.
+# Single source of truth — the torch mirror (torchref/vgg.py) imports this.
 _CFG = [64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512]
-# Indices (into torchvision .features) of the convs we instantiate, in order;
-# used by params_from_torch_state. relu4_3 is features[22], so convs up to
-# index 21 participate.
-_TORCH_CONV_INDICES = [0, 2, 5, 7, 10, 12, 14, 17, 19, 21]
-# Taps: after relu1_2 (block 0), relu2_2, relu3_3, relu4_3.
+# Taps after the 2nd, 4th, 7th, 10th conv == relu1_2, relu2_2, relu3_3,
+# relu4_3 — the block boundaries the reference slices at (cell 12:21-24).
 _TAPS_AFTER_CONV = {2: 0, 4: 1, 7: 2, 10: 3}
+
+
+def _torch_layer_indices(cfg):
+  """(conv indices, tap indices) into the torchvision ``features`` Sequential
+  for a cfg: each conv entry expands to Conv2d+ReLU, each 'M' to MaxPool2d."""
+  convs, taps, i, conv_n = [], [], 0, 0
+  for c in cfg:
+    if c == "M":
+      i += 1
+    else:
+      convs.append(i)
+      conv_n += 1
+      if conv_n in _TAPS_AFTER_CONV:
+        taps.append(i + 1)            # the ReLU following this conv
+      i += 2
+  return convs, taps
+
+
+_TORCH_CONV_INDICES, _TORCH_TAP_INDICES = _torch_layer_indices(_CFG)
+assert _TORCH_CONV_INDICES == [0, 2, 5, 7, 10, 12, 14, 17, 19, 21]
+assert _TORCH_TAP_INDICES == [3, 8, 15, 22]
 
 
 class VGG16Features(nn.Module):

@@ -1,0 +1,218 @@
+"""MPI viewer export: layer PNGs + a self-contained CSS-3D HTML viewer.
+
+Reference behavior (notebook cell 18 + deepview-mpi-viewer-template.html):
+RGBA layers in [-1, 1] are rescaled to [0, 1] (alpha passed through), saved
+as PNGs, base64-embedded into an HTML page that renders the MPI with CSS
+``preserve-3d`` transforms — layers spaced uniformly in inverse depth with
+index 0 farthest, each pre-scaled so the stack aligns exactly when viewed
+head-on and produces parallax under pose changes.
+
+The HTML here is an original implementation of that behavior (not a copy of
+the reference template): a ``perspective: f px`` stage whose focal length is
+``0.5 * w / tan(fov/2)`` (the reference's focal model, template:304), layers
+at ``translateZ(-z) scale((f+z)/f)`` with ``z = f * (d/d_near - 1)``, and
+pointer controls — move for parallax, drag to rotate, shift-drag to
+translate, wheel to dolly, digit keys to inspect single layers, ``a`` for
+alpha view.
+"""
+
+from __future__ import annotations
+
+import base64
+import io
+import os
+from typing import Sequence
+
+import numpy as np
+
+_HTML_TEMPLATE = """<!DOCTYPE html>
+<html>
+<head>
+<meta charset="utf-8">
+<title>MPI viewer — mpi_vision_tpu</title>
+<style>
+  html, body { margin: 0; background: #111; height: 100%; overflow: hidden;
+               font: 12px monospace; color: #ccc; }
+  #stage { position: absolute; inset: 0; display: flex;
+           align-items: center; justify-content: center; }
+  #frustum { position: relative; transform-style: preserve-3d; }
+  .layer { position: absolute; left: 0; top: 0; width: 100%; height: 100%;
+           transform-style: preserve-3d; backface-visibility: hidden;
+           pointer-events: none; }
+  .alpha .layer img { filter: grayscale(1) contrast(0); }
+  #hud { position: fixed; left: 8px; bottom: 8px; opacity: .7;
+         user-select: none; }
+</style>
+</head>
+<body>
+<div id="stage"><div id="frustum"></div></div>
+<div id="hud">drag: rotate · shift-drag: pan · wheel: dolly ·
+1-9/0: solo layer · a: alpha · r: reset</div>
+<script>
+"use strict";
+const mpiSources = __MPI_SOURCES__;
+const cfg = { w: __W__, h: __H__, near: __NEAR__, far: __FAR__,
+              fov: __FOV__ };
+
+const focal = 0.5 * cfg.w / Math.tan(cfg.fov * Math.PI / 360);
+const P = mpiSources.length;
+// Inverse-depth uniform spacing, index 0 = farthest (matches inv_depths).
+const depths = [];
+for (let i = 0; i < P; i++) {
+  const inv = 1 / cfg.far + (1 / cfg.near - 1 / cfg.far) * (P > 1 ? i / (P - 1) : 1);
+  depths.push(1 / inv);
+}
+
+const frustum = document.getElementById("frustum");
+const stage = document.getElementById("stage");
+frustum.style.width = cfg.w + "px";
+frustum.style.height = cfg.h + "px";
+stage.style.perspective = focal + "px";
+
+const layers = [];
+for (let i = 0; i < P; i++) {
+  const div = document.createElement("div");
+  div.className = "layer";
+  const img = document.createElement("img");
+  img.src = mpiSources[i];
+  img.style.width = "100%"; img.style.height = "100%";
+  div.appendChild(img);
+  // z grows with scene depth relative to the nearest layer; (f+z)/f undoes
+  // the perspective shrink so the stack aligns exactly head-on.
+  const z = focal * (depths[i] / depths[P - 1] - 1);
+  div.style.transform =
+      `translateZ(${-z}px) scale(${(focal + z) / focal})`;
+  div.dataset.z = z;
+  frustum.appendChild(div);
+  layers.push(div);
+}
+
+// Drag rotation accumulates into `base`; hover parallax is a small
+// additive offset on top, so releasing a drag never snaps the view back.
+const base = { rx: 0, ry: 0, tx: 0, ty: 0, tz: 0 };
+const hover = { rx: 0, ry: 0 };
+let solo = -1, dragging = false, lastX = 0, lastY = 0;
+
+function apply() {
+  frustum.style.transform =
+      `translate3d(${base.tx}px, ${base.ty}px, ${base.tz}px) ` +
+      `rotateX(${base.rx + hover.rx}deg) rotateY(${base.ry + hover.ry}deg)`;
+  layers.forEach((l, i) =>
+      l.style.opacity = (solo < 0 || solo === i) ? 1 : 0.04);
+}
+
+window.addEventListener("pointerdown", e => {
+  dragging = true; lastX = e.clientX; lastY = e.clientY;
+});
+window.addEventListener("pointerup", () => dragging = false);
+window.addEventListener("pointermove", e => {
+  if (dragging) {
+    if (e.shiftKey) {
+      base.tx += e.clientX - lastX; base.ty += e.clientY - lastY;
+    } else {
+      base.ry += (e.clientX - lastX) * 0.15;
+      base.rx -= (e.clientY - lastY) * 0.15;
+    }
+    lastX = e.clientX; lastY = e.clientY;
+  } else {
+    hover.ry = (e.clientX / innerWidth - 0.5) * 6;
+    hover.rx = -(e.clientY / innerHeight - 0.5) * 6;
+  }
+  apply();
+});
+window.addEventListener("wheel", e => {
+  base.tz -= e.deltaY * 0.5; apply();
+});
+window.addEventListener("keydown", e => {
+  if (e.key >= "0" && e.key <= "9") {
+    const k = e.key === "0" ? 9 : +e.key - 1;
+    solo = (k < P && solo !== k) ? k : -1;
+  } else if (e.key === "a") {
+    document.body.classList.toggle("alpha");
+  } else if (e.key === "r") {
+    Object.assign(base, { rx: 0, ry: 0, tx: 0, ty: 0, tz: 0 }); solo = -1;
+  }
+  apply();
+});
+apply();
+</script>
+</body>
+</html>
+"""
+
+
+def layer_to_png_bytes(rgba: np.ndarray) -> bytes:
+  """One ``[H, W, 4]`` RGBA layer in [-1, 1] -> PNG bytes.
+
+  RGB is rescaled [-1, 1] -> [0, 1]; alpha is passed through as-is (already
+  (0, 1) from the MPI assembly) — the reference's ``save_image`` (cell 18).
+  """
+  from PIL import Image
+
+  rgb = np.rint(
+      np.clip((rgba[..., :3] + 1.0) / 2.0, 0, 1) * 255).astype(np.uint8)
+  a = np.rint(np.clip(rgba[..., 3:], 0, 1) * 255).astype(np.uint8)
+  buf = io.BytesIO()
+  Image.fromarray(np.concatenate([rgb, a], -1), "RGBA").save(buf, "PNG")
+  return buf.getvalue()
+
+
+def save_layer_pngs(rgba_layers: np.ndarray, out_dir: str,
+                    prefix: str = "mpi") -> list[str]:
+  """Save ``[H, W, P, 4]`` layers as ``<prefix>00.png ...`` (cell 18)."""
+  os.makedirs(out_dir, exist_ok=True)
+  paths = []
+  for i in range(rgba_layers.shape[2]):
+    path = os.path.join(out_dir, f"{prefix}{i:02d}.png")
+    with open(path, "wb") as f:
+      f.write(layer_to_png_bytes(np.asarray(rgba_layers[:, :, i])))
+    paths.append(path)
+  return paths
+
+
+def to_data_uri(png_bytes: bytes) -> str:
+  return "data:image/png;base64," + base64.b64encode(png_bytes).decode()
+
+
+def export_viewer_html(rgba_layers: np.ndarray, out_path: str,
+                       near: float = 1.0, far: float = 100.0,
+                       fov_deg: float = 60.0) -> str:
+  """Write a self-contained HTML MPI viewer for ``[H, W, P, 4]`` layers.
+
+  ``near``/``far`` must match the plane depths the MPI was built with
+  (``inv_depths(near, far, P)``, index 0 farthest); ``fov_deg`` sets the
+  CSS focal length. Returns ``out_path``.
+  """
+  rgba_layers = np.asarray(rgba_layers)
+  h, w, p, _ = rgba_layers.shape
+  uris = [to_data_uri(layer_to_png_bytes(rgba_layers[:, :, i]))
+          for i in range(p)]
+  html = (_HTML_TEMPLATE
+          .replace("__MPI_SOURCES__",
+                   "[" + ",".join(f'"{u}"' for u in uris) + "]")
+          .replace("__W__", str(w)).replace("__H__", str(h))
+          .replace("__NEAR__", repr(float(near)))
+          .replace("__FAR__", repr(float(far)))
+          .replace("__FOV__", repr(float(fov_deg))))
+  with open(out_path, "w") as f:
+    f.write(html)
+  return out_path
+
+
+def load_fixture_mpi(test_dir: str, prefix: str = "rgba_",
+                     count: int | None = None) -> np.ndarray:
+  """Load a baked PNG MPI (e.g. the reference's ``test/rgba_00..09.png``)
+  into ``[H, W, P, 4]`` in [-1, 1] (alpha in (0, 1))."""
+  from PIL import Image
+
+  names = sorted(n for n in os.listdir(test_dir)
+                 if n.startswith(prefix) and n.endswith(".png"))
+  if count is not None:
+    names = names[:count]
+  layers = []
+  for n in names:
+    arr = np.asarray(
+        Image.open(os.path.join(test_dir, n)).convert("RGBA"),
+        np.float32) / 255.0
+    layers.append(np.concatenate([arr[..., :3] * 2.0 - 1.0, arr[..., 3:]], -1))
+  return np.stack(layers, axis=2)

@@ -1,0 +1,102 @@
+"""Data pipeline tests on a synthesized RealEstate10K-layout dataset."""
+
+import numpy as np
+import pytest
+
+from mpi_vision_tpu import data as mvdata
+from mpi_vision_tpu.train import loop as tloop
+
+
+@pytest.fixture(scope="module")
+def dataset_root(tmp_path_factory):
+  root = tmp_path_factory.mktemp("re10k")
+  return mvdata.synthesize_dataset(str(root), num_scenes=2, frames=4,
+                                   img_size=32)
+
+
+class TestParsing:
+
+  def test_parse_camera_lines_roundtrip(self, dataset_root):
+    scenes = mvdata.load_scenes(dataset_root, "train")
+    assert len(scenes) == 2
+    s = scenes[0]
+    assert s.youtube_id == "synth000"
+    assert s.timestamps == [16000, 32000, 48000, 64000]
+    assert s.intrinsics.shape == (4, 4)
+    assert s.poses.shape == (4, 4, 4)
+    np.testing.assert_array_equal(s.poses[0], np.eye(4))
+    assert s.poses[2][0, 3] == pytest.approx(-0.2)
+
+  def test_rejects_radial_distortion(self):
+    lines = ["https://www.youtube.com/watch?v=x",
+             "100 0.9 0.9 0.5 0.5 0.1 0 " + " ".join(["0"] * 12)]
+    with pytest.raises(ValueError, match="k1/k2"):
+      mvdata.parse_camera_lines(lines)
+
+  def test_comment_lines_dropped(self, tmp_path):
+    p = tmp_path / "f.txt"
+    p.write_text("# comment\nkeep\n\n  # also comment\nkeep2\n")
+    assert mvdata.read_file_lines(str(p)) == ["keep", "keep2"]
+
+
+class TestTriplets:
+
+  def test_draw_triplet_respects_window(self, dataset_root):
+    scene = mvdata.load_scenes(dataset_root, "train")[0]
+    rng = np.random.default_rng(0)
+    for _ in range(10):
+      ref, src, tgt = mvdata.draw_triplet(scene, rng)
+      assert src != tgt
+      for j in (src, tgt):
+        d = abs(scene.timestamps[ref] - scene.timestamps[j])
+        assert 16e3 <= d <= 500e3
+
+  def test_window_too_small_raises(self, dataset_root):
+    scene = mvdata.load_scenes(dataset_root, "train")[0]
+    rng = np.random.default_rng(0)
+    with pytest.raises(ValueError, match="<2 frames"):
+      mvdata.draw_triplet(scene, rng, min_dist=1e9, max_dist=2e9)
+
+
+class TestExamples:
+
+  def test_example_contract(self, dataset_root):
+    ds = mvdata.RealEstateDataset(dataset_root, is_valid=True,
+                                  img_size=32, num_planes=4)
+    ex = ds[0]
+    assert ex["net_input"].shape == (32, 32, 3 + 3 * 4)
+    assert ex["ref_img"].shape == (32, 32, 3)
+    assert ex["tgt_img_cfw"].shape == (4, 4)
+    assert ex["mpi_planes"].shape == (4,)
+    assert ex["mpi_planes"][0] == pytest.approx(100.0)  # far first
+    assert ex["net_input"].min() >= -1.0 and ex["net_input"].max() <= 1.0
+    # ref image rides in the first 3 channels of the net input (cell 8:77).
+    np.testing.assert_array_equal(ex["net_input"][..., :3], ex["ref_img"])
+    # world-from-camera really is the inverse of the ref pose.
+    scene = ds.scenes[0]
+    np.testing.assert_allclose(
+        ex["ref_img_wfc"] @ scene.poses[0], np.eye(4), atol=1e-6)
+
+  def test_batches_feed_training(self, dataset_root):
+    ds = mvdata.RealEstateDataset(dataset_root, is_valid=True,
+                                  img_size=32, num_planes=4)
+    state = tloop.create_train_state(
+        __import__("jax").random.PRNGKey(0), num_planes=4,
+        image_size=(32, 32), learning_rate=1e-3, norm=None)
+    step = tloop.make_train_step(vgg_params=None)
+    batches = list(mvdata.iterate_batches(ds, batch_size=1, shuffle=False))
+    assert len(batches) == 2
+    assert batches[0]["mpi_planes"].shape == (1, 4)
+    losses = []
+    for batch in batches * 3:
+      state, metrics = step(state, batch)
+      losses.append(float(metrics["loss"]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+
+  def test_train_split_randomizes(self, dataset_root):
+    ds = mvdata.RealEstateDataset(dataset_root, is_valid=False, img_size=32,
+                                  num_planes=4,
+                                  rng=np.random.default_rng(1))
+    exs = [ds[0]["tgt_img_cfw"] for _ in range(6)]
+    assert any(not np.array_equal(exs[0], e) for e in exs[1:])

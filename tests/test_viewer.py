@@ -1,0 +1,72 @@
+"""Viewer export tests + BASELINE config-1 parity on the baked fixture MPI.
+
+The ``tests/fixtures/scene_009`` PNGs are the reference repo's only test
+data (a real 10-plane 640x400 MPI; SURVEY.md §4): compositing them to the
+frontal view against the torch oracle is benchmark config #1.
+"""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+from mpi_vision_tpu import viewer
+from mpi_vision_tpu.core import compose
+from mpi_vision_tpu.torchref import oracle
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures", "scene_009")
+
+
+@pytest.fixture(scope="module")
+def fixture_mpi():
+  return viewer.load_fixture_mpi(FIXTURES)
+
+
+class TestFixtureComposite:
+
+  def test_config1_frontal_composite_matches_torch(self, fixture_mpi):
+    """BASELINE config 1: over-composite the baked MPI to the frontal view."""
+    planes = jnp.moveaxis(jnp.asarray(fixture_mpi), 2, 0)  # [P, H, W, 4]
+    got = compose.over_composite(planes)
+    want = oracle.over_composite(torch.from_numpy(
+        np.moveaxis(fixture_mpi, 2, 0))).numpy()
+    assert got.shape == (400, 640, 3)
+    l1 = np.abs(np.asarray(got) - want).mean()
+    assert l1 <= 1e-3, f"per-pixel L1 {l1} above parity budget"
+
+  def test_fixture_shape(self, fixture_mpi):
+    assert fixture_mpi.shape == (400, 640, 10, 4)
+    assert fixture_mpi[..., :3].min() >= -1.0
+    assert 0.0 <= fixture_mpi[..., 3].min() <= fixture_mpi[..., 3].max() <= 1.0
+
+
+class TestPngRoundtrip:
+
+  def test_layer_png_roundtrip(self, rng, tmp_path):
+    mpi = rng.uniform(-1, 1, (16, 24, 3, 4)).astype(np.float32)
+    mpi[..., 3] = (mpi[..., 3] + 1) / 2  # alpha in (0,1)
+    paths = viewer.save_layer_pngs(mpi, str(tmp_path))
+    assert [os.path.basename(p) for p in paths] == [
+        "mpi00.png", "mpi01.png", "mpi02.png"]
+    back = viewer.load_fixture_mpi(str(tmp_path), prefix="mpi")
+    # 8-bit quantization budget: half a step in [-1,1] rgb / [0,1] alpha.
+    np.testing.assert_allclose(back[..., :3], mpi[..., :3], atol=1.1 / 255)
+    np.testing.assert_allclose(back[..., 3], mpi[..., 3], atol=0.6 / 255)
+
+
+class TestHtmlExport:
+
+  def test_export_html_structure(self, fixture_mpi, tmp_path):
+    out = viewer.export_viewer_html(
+        fixture_mpi[:, :, :3], str(tmp_path / "v.html"))
+    html = open(out).read()
+    assert html.count("data:image/png;base64,") == 3
+    assert "__MPI_SOURCES__" not in html and "__NEAR__" not in html
+    assert '"w": 640' not in html  # substituted, not templated json
+    assert "perspective" in html and "translateZ" in html
+
+  def test_data_uri(self):
+    uri = viewer.to_data_uri(b"\x89PNG")
+    assert uri.startswith("data:image/png;base64,")

@@ -1,0 +1,78 @@
+"""Tiny per-plane RGBA predictor (DeepView-style direct MPI prediction).
+
+BASELINE config 5's model: instead of the stereo-magnification
+background+blend parameterization (models/stereo_mag.py, notebook cell 10 —
+which constrains per-plane RGB to a blend of the reference image and one
+background image), this small U-Net predicts every plane's RGBA directly
+from the plane-sweep volume, the DeepView-family approach (the reference
+repo's viewer is the "deepview" template; the model family itself has no
+reference implementation, so this is new capability sized for the
+train-on-a-stereo-pair benchmark).
+
+TPU-first layout: the PSV arrives plane-major ``[B, H, W, P, C]`` and planes
+fold into the batch axis — every plane is processed by the same shared-weight
+network in one big batched conv (MXU-friendly: one conv over B*P images
+instead of P small convs), with a few cross-plane mixing convs operating on
+channels-stacked features at the bottleneck so planes can exchange occlusion
+evidence.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class TinyPlaneUNet(nn.Module):
+  """PSV ``[B, H, W, P, C]`` -> MPI ``[B, H, W, P, 4]`` (rgb/alpha in (0,1)-ish).
+
+  Output RGB is tanh in [-1, 1] (image range), alpha is sigmoid in (0, 1).
+  H and W must be divisible by 4 (two stride-2 stages).
+  """
+
+  width: int = 32
+  mix: int = 2   # cross-plane mixing convs at the bottleneck
+
+  @nn.compact
+  def __call__(self, psv: jnp.ndarray) -> jnp.ndarray:
+    b, h, w, p, c = psv.shape
+    x = psv.transpose(0, 3, 1, 2, 4).reshape(b * p, h, w, c)
+
+    # Shared-weight per-plane encoder (planes folded into batch).
+    e0 = nn.relu(nn.Conv(self.width, (3, 3), name="enc0")(x))
+    e1 = nn.relu(nn.Conv(self.width * 2, (3, 3), strides=(2, 2),
+                         name="enc1")(e0))
+    e2 = nn.relu(nn.Conv(self.width * 4, (3, 3), strides=(2, 2),
+                         name="enc2")(e1))
+
+    # Cross-plane mixing: stack plane features on channels at 1/4 res.
+    m = e2.reshape(b, p, h // 4, w // 4, -1)
+    m = m.transpose(0, 2, 3, 1, 4).reshape(b, h // 4, w // 4, -1)
+    for i in range(self.mix):
+      m = nn.relu(nn.Conv(self.width * 4 * 2, (3, 3), name=f"mix{i}")(m))
+    m = nn.Conv(p * self.width * 4, (1, 1), name="unmix")(m)
+    m = m.reshape(b, h // 4, w // 4, p, -1)
+    m = m.transpose(0, 3, 1, 2, 4).reshape(b * p, h // 4, w // 4, -1)
+
+    # Shared-weight decoder with skips.
+    d1 = nn.relu(nn.ConvTranspose(self.width * 2, (4, 4), strides=(2, 2),
+                                  name="dec1")(jnp.concatenate([m, e2], -1)))
+    d0 = nn.relu(nn.ConvTranspose(self.width, (4, 4), strides=(2, 2),
+                                  name="dec0")(jnp.concatenate([d1, e1], -1)))
+    out = nn.Conv(4, (1, 1), name="head")(jnp.concatenate([d0, e0], -1))
+
+    rgb = jnp.tanh(out[..., :3])
+    alpha = nn.sigmoid(out[..., 3:])
+    out = jnp.concatenate([rgb, alpha], -1)
+    return out.reshape(b, p, h, w, 4).transpose(0, 2, 3, 1, 4)
+
+
+def psv_from_net_input(net_input: jnp.ndarray, num_planes: int) -> jnp.ndarray:
+  """Split a stereo-mag net input ``[B, H, W, 3+3P]`` into a plane-major PSV
+  ``[B, H, W, P, 3]`` plus the broadcast reference image as a 4th channel
+  group is NOT added — the tiny model sees (psv_rgb ++ ref_rgb) per plane."""
+  b, h, w, _ = net_input.shape
+  ref = net_input[..., :3]
+  psv = net_input[..., 3:].reshape(b, h, w, num_planes, 3)
+  ref_b = jnp.broadcast_to(ref[..., None, :], psv.shape)
+  return jnp.concatenate([psv, ref_b], axis=-1)   # [B, H, W, P, 6]

@@ -1,0 +1,55 @@
+"""Force JAX onto a virtual n-device CPU mesh — the single shared hardening.
+
+Used by BOTH ``tests/conftest.py`` and ``__graft_entry__.dryrun_multichip``
+so the two cannot drift: multi-chip TPU hardware is absent in CI, and the
+standard JAX substitute is ``--xla_force_host_platform_device_count``
+(SURVEY.md §4d). The ambient environment may point JAX at a tunnelled TPU
+backend (axon) whose initialization can hang CPU-only runs even under
+``JAX_PLATFORMS=cpu``, so hardening has two parts:
+
+  1. env vars (must be in place before JAX builds its first backend);
+  2. swapping the 'axon'/'tpu' backend factories for quietly-failing stubs —
+     platform names stay *known* (Pallas' 'tpu' lowering registration needs
+     that) but the tunnelled backend can never be constructed.
+
+This module must stay importable without triggering a JAX import at module
+scope (callers need to mutate env first).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def hardened_env(n_devices: int, base: dict | None = None) -> dict:
+  """A copy of ``base`` (default ``os.environ``) forcing the CPU mesh."""
+  env = dict(os.environ if base is None else base)
+  flags = [f for f in env.get("XLA_FLAGS", "").split()
+           if "xla_force_host_platform_device_count" not in f]
+  flags.append(f"--xla_force_host_platform_device_count={n_devices}")
+  env["XLA_FLAGS"] = " ".join(flags)
+  env["JAX_PLATFORMS"] = "cpu"
+  env.pop("PALLAS_AXON_POOL_IPS", None)
+  return env
+
+
+def force_cpu_mesh(n_devices: int = 8) -> None:
+  """Apply the full hardening to THIS process (env + backend factories).
+
+  Call before first device use; the env half only sticks if no JAX backend
+  has been initialized yet in this process.
+  """
+  os.environ.update(hardened_env(n_devices))
+  os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+  import jax
+  import jax._src.xla_bridge as xb
+
+  def _disabled(*args, **kwargs):
+    raise RuntimeError("tpu/axon backends are disabled under the CPU mesh")
+
+  for plat in ("axon", "tpu"):
+    if plat in xb._backend_factories:
+      xb.register_backend_factory(
+          plat, _disabled, priority=-1000, fail_quietly=True)
+  jax.config.update("jax_platforms", "cpu")

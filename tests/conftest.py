@@ -1,46 +1,22 @@
 """Test harness config: run JAX on a virtual 8-device CPU mesh.
 
-Multi-chip TPU hardware is not available in CI; the standard JAX substitute is
-`--xla_force_host_platform_device_count` (SURVEY.md §4d). Must run before the
-first `import jax`, hence env mutation at conftest import time.
+The hardening itself (env vars + tunnelled-backend neutralization) lives in
+the repo-root ``_cpu_mesh`` module, shared with ``__graft_entry__``'s
+multichip dryrun so the two cannot drift. Must run before the first device
+use, hence the call at conftest import time.
 """
 
 import os
+import sys
 
-_FLAG = "--xla_force_host_platform_device_count=8"
-_existing = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _existing:
-  os.environ["XLA_FLAGS"] = f"{_existing} {_FLAG}".strip()
-# Hard override: the ambient environment may point JAX at a tunneled TPU
-# (JAX_PLATFORMS=axon); tests must run on the virtual CPU mesh regardless.
-os.environ["JAX_PLATFORMS"] = "cpu"
-os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-# The axon PJRT plugin may already be registered by sitecustomize before this
-# conftest runs, and its (tunnelled) initialization hangs CPU-only test runs
-# even under JAX_PLATFORMS=cpu — swap in a quietly-failing factory so the
-# platform names stay *known* (Pallas import registers 'tpu' lowerings, which
-# requires that) but the tunnelled backend can never initialize.
-import jax._src.xla_bridge as _xb  # noqa: E402
+from _cpu_mesh import force_cpu_mesh  # noqa: E402
 
+force_cpu_mesh(8)
 
-def _disabled_backend_factory(*args, **kwargs):
-  raise RuntimeError("tpu/axon backends are disabled under the CPU test mesh")
-
-
-for _plat in ("axon", "tpu"):
-  if _plat in _xb._backend_factories:
-    _xb.register_backend_factory(
-        _plat, _disabled_backend_factory, priority=-1000, fail_quietly=True)
-
-# jax was already imported by sitecustomize with JAX_PLATFORMS=axon baked into
-# its config; point the live config back at cpu as well.
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
-
-import numpy as np
-import pytest
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
 
 
 @pytest.fixture

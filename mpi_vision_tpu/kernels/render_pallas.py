@@ -34,10 +34,18 @@ Per grid step (strip of 8 output rows, one plane; planes innermost):
 Restrictions (documented contract): H % 8 == 0, W % 128 == 0, H >= 24, and
 per-plane source extents bounded — a strip's source rows must fit the 24-row
 band (17 usable after alignment slack: vertical scale <= ~1.5 with modest
-tilt) and one output row's 128-column chunk must span <= 382 source columns
-(horizontal scale <= ~2.9). Poses beyond that render black where the band
-misses; use an XLA method for extreme zoom-out. The backward pass is the XLA
-reference path via ``jax.custom_vjp``.
+tilt) and one output row's 128-column chunk must reach <= 2*128+1 = 257
+source columns from its leftmost tap (separable path, 3 windows: horizontal
+scale <= ~2.0) or <= 3*128+1 = 385 (general path, 4 windows: scale <= ~3.0).
+Window bases are 128-aligned *down* from the leftmost tap, so these bounds
+already include the worst-case (127-column) alignment slack.
+``fits_envelope`` checks the exact contract eagerly (cheap: the separable
+check is closed-form per strip/chunk) and ``render_mpi_fused`` uses it to
+fall back to the XLA path for out-of-envelope concrete poses. Outside the
+envelope (only reachable by jitting around the check) dropped taps produce
+PARTIAL bilinear sums — dimmed, wrong pixels, not black — and the backward
+pass (the XLA reference path via ``jax.custom_vjp``) does not match such a
+forward; inside the envelope forward and backward agree.
 """
 
 from __future__ import annotations
@@ -57,7 +65,8 @@ STRIP = 8      # output rows per grid step
 BAND = 24      # source rows held in VMEM (8-aligned start)
 CHUNK = 128    # output columns per inner step == one vreg of lanes
 WIN = 128      # gather window width == max lane-gather span
-MAX_WINDOWS = 3
+SEP_WINDOWS = 3   # separable path: 2 unconditional + 1 conditional
+MAX_WINDOWS = 4   # general path: all conditional
 
 
 def pixel_homographies(
@@ -120,7 +129,7 @@ def _ymin_of(hom, oy0, height, width):
 
 
 def _separable_kernel(hom_ref, planes_ref, out_ref, band_ref, acc_ref, sems,
-                      *, num_planes, height, width):
+                      *, num_planes, height, width, n_windows):
   """Fast path for axis-aligned (separable) homographies.
 
   With h01 = h10 = h20 = h21 = 0, ``u`` depends only on the output column
@@ -129,6 +138,12 @@ def _separable_kernel(hom_ref, planes_ref, out_ref, band_ref, acc_ref, sems,
   for the full [8, CHUNK] tile is one small MXU matmul
   ``KY[8, BAND] @ xle[BAND, CHUNK]``. Band DMAs are double-buffered across
   grid steps.
+
+  ``n_windows`` (static: 2 or 3) is the per-chunk gather-window count, all
+  unconditional — branchless beats ``lax.cond`` here (a scalar cond in the
+  hot loop measured ~1.7x slower than just doing the third gather). Eager
+  callers auto-select it from the concrete homographies (2 suffices for
+  horizontal scale <= 1.0 at ANY alignment; 3 guarantees scale <= ~2.0).
   """
   s = pl.program_id(0)
   p = pl.program_id(1)
@@ -181,15 +196,13 @@ def _separable_kernel(hom_ref, planes_ref, out_ref, band_ref, acc_ref, sems,
     ua = jnp.where(jnp.isfinite(ua), ua, 0.0)
     ub = jnp.where(jnp.isfinite(ub), ub, 0.0)
     x_lo = jnp.floor(jnp.minimum(ua, ub)).astype(jnp.int32)
-    # Clamp so the two gather windows below are always distinct and in-range.
-    w0 = jnp.clip((x_lo // WIN) * WIN, 0, width - 2 * WIN)
+    # Clamp so all n_windows gather windows are always in-range; window
+    # bases align DOWN from x_lo, so guaranteed coverage from the leftmost
+    # tap is (n_windows-1)*WIN + 1 columns.
+    w0 = jnp.clip((x_lo // WIN) * WIN, 0, width - n_windows * WIN)
 
-    # Two unconditional 128-lane gather windows cover any row whose 128
-    # output columns span <= 254 source columns (horizontal scale < ~1.97);
-    # branchless — scalar conds flush the vector pipeline and cost more than
-    # the skipped work.
     xles = None
-    for wi in range(2):
+    for wi in range(n_windows):
       base = pl.multiple_of(w0 + wi * WIN, WIN)
       rel = x0 - base
       in0 = (rel >= 0) & (rel < WIN) & valid0
@@ -344,9 +357,123 @@ def is_separable(homs, atol: float = 1e-6) -> bool:
   return bool(np.all(np.abs(h[:, [1, 3, 6, 7]]) <= atol * np.abs(h[:, 8:9])))
 
 
-@functools.partial(jax.jit, static_argnames=("separable", "interpret"))
+def fits_envelope(homs, height: int, width: int,
+                  separable: bool | None = None) -> bool:
+  """Eagerly check the fused kernel's exact coverage contract.
+
+  Mirrors the kernel's band / gather-window arithmetic: every in-image
+  bilinear tap of every output pixel must land inside the 24-row source band
+  its strip DMAs and inside the gather windows its 128-column chunk reaches
+  (3 windows separable, 4 general, bases 128-aligned down from the leftmost
+  tap). Extrema are evaluated at strip/chunk boundaries, exact for
+  projective maps whose denominator keeps one sign over the image (checked);
+  sign-changing denominators reject. ``homs`` must be concrete ([P, 3, 3]).
+  """
+  h = np.asarray(homs, np.float64).reshape(-1, 3, 3)
+  if separable is None:
+    separable = is_separable(homs)
+  n_win = SEP_WINDOWS if separable else MAX_WINDOWS
+  p = h.shape[0]
+
+  # Denominator one-signed over the image (else u/v are not edge-monotone).
+  cx = np.array([0.0, width - 1.0])
+  cy = np.array([0.0, height - 1.0])
+  d_corner = (h[:, 2, 0, None, None] * cx[None, :, None]
+              + h[:, 2, 1, None, None] * cy[None, None, :])    # [P, 2, 2]
+  d_flat = (d_corner + h[:, 2, 2, None, None]).reshape(p, 4)
+  if not np.isfinite(d_flat).all():
+    return False
+  if not np.all((d_flat > 0).all(1) | (d_flat < 0).all(1)):
+    return False
+
+  def uv(ox, oy):
+    # ox [...,], oy [...] broadcastable against a trailing plane axis.
+    den = h[:, 2, 0] * ox + h[:, 2, 1] * oy + h[:, 2, 2]
+    u = (h[:, 0, 0] * ox + h[:, 0, 1] * oy + h[:, 0, 2]) / den
+    v = (h[:, 1, 0] * ox + h[:, 1, 1] * oy + h[:, 1, 2]) / den
+    return u, v
+
+  # --- vertical: per strip, the kernel's corner-based band must hold all
+  # in-image taps of every row in the strip (row extrema at ox in {0, W-1}).
+  # Separable fast path: v is linear in the row (denominator constant), so
+  # strip-corner rows are exact extrema — O(P*S) instead of O(P*H).
+  n_strips = height // STRIP
+  if separable:
+    oy = (np.arange(n_strips, dtype=np.float64)[:, None] * STRIP
+          + np.array([0.0, STRIP - 1.0]))                      # [S, 2]
+    v_c = ((h[:, 1, 1] * oy[..., None] + h[:, 1, 2])
+           / h[:, 2, 2]).transpose(2, 0, 1)                    # [P, S, 2]
+    v_c = np.where(np.isfinite(v_c), v_c, 0.0)
+    v_lo, v_hi = v_c.min(axis=2), v_c.max(axis=2)              # [P, S]
+    vmin_strip = v_lo
+  else:
+    rows = np.arange(height, dtype=np.float64)                 # [H]
+    _, v_edge = uv(cx[:, None, None], rows[None, :, None])     # [2, H, P]
+    v_lo = v_edge.min(axis=0).T                                # [P, H]
+    v_hi = v_edge.max(axis=0).T
+    vs = v_edge.reshape(2, n_strips, STRIP, p)[:, :, [0, STRIP - 1]]
+    vmin_strip = np.where(np.isfinite(vs), vs, 0.0).min(axis=(0, 2)).T
+  ymin = np.clip(np.floor(vmin_strip).astype(np.int64) - 1, 0,
+                 height - BAND) // 8 * 8                       # [P, S]
+  if not separable:
+    ymin = np.repeat(ymin, STRIP, axis=1)                      # [P, H]
+  q_lo = np.maximum(np.floor(v_lo), 0)
+  q_hi = np.minimum(np.floor(v_hi) + 1, height - 1)
+  # A row is tap-free only when every v is <= -1 or >= H: the boundary taps
+  # (row 0 for v in (-1, 0), row H-1 for v in (H-1, H)) carry weight.
+  row_empty = (v_hi <= -1) | (v_lo >= height)
+  v_ok = row_empty | ((q_lo >= ymin) & (q_hi <= ymin + BAND - 1))
+  if not v_ok.all():
+    return False
+
+  # --- horizontal: per row and 128-column chunk, all in-image taps must fit
+  # the window union [w0, w0 + n_win*WIN) ∩ [0, width) (chunk-edge extrema).
+  # Separable fast path: u is row-independent — O(P*C) instead of O(P*C*H).
+  if separable:
+    x_lo, x_hi = _sep_tap_extents(h, width)                    # [P, C]
+  else:
+    n_chunks = width // CHUNK
+    ox_edges = (np.arange(n_chunks, dtype=np.float64)[:, None] * CHUNK
+                + np.array([0.0, CHUNK - 1.0]))                # [C, 2]
+    rows = np.arange(height, dtype=np.float64)
+    u_e, _ = uv(ox_edges[:, :, None, None], rows[None, None, :, None])
+    u_e = np.moveaxis(u_e, -1, 0)                              # [P, C, 2, H]
+    u_lo = u_e.min(axis=2)                                     # [P, C, H]
+    u_hi = u_e.max(axis=2)
+    x_lo = np.floor(np.where(np.isfinite(u_lo), u_lo, 0.0)).astype(np.int64)
+    x_hi = np.floor(
+        np.where(np.isfinite(u_hi), u_hi, 0.0)).astype(np.int64) + 1
+  w0_max = width - 2 * WIN if separable else width - WIN
+  w0 = np.clip(x_lo // WIN * WIN, 0, max(w0_max, 0))
+  cover_end = np.minimum(w0 + n_win * WIN, width)
+  chunk_empty = (x_hi < 0) | (x_lo > width - 1)
+  u_ok = chunk_empty | (np.minimum(x_hi, width - 1) <= cover_end - 1)
+  return bool(u_ok.all())
+
+
+def _sep_tap_extents(h, width: int):
+  """Per-chunk integer tap extents [x_lo, x_hi] for separable homographies.
+
+  ``h``: ``[P, 3, 3]`` float64. u is row-independent, so chunk-edge u values
+  are exact extrema. Shared by ``fits_envelope`` and the window auto-tuner
+  so the check and the tuner cannot diverge from each other.
+  """
+  n_chunks = width // CHUNK
+  ox_edges = (np.arange(n_chunks, dtype=np.float64)[:, None] * CHUNK
+              + np.array([0.0, CHUNK - 1.0]))                  # [C, 2]
+  u_e = ((h[:, 0, 0] * ox_edges[..., None] + h[:, 0, 2])
+         / h[:, 2, 2]).transpose(2, 0, 1)                      # [P, C, 2]
+  u_e = np.where(np.isfinite(u_e), u_e, 0.0)
+  x_lo = np.floor(u_e.min(axis=2)).astype(np.int64)
+  x_hi = np.floor(u_e.max(axis=2)).astype(np.int64) + 1
+  return x_lo, x_hi
+
+
+@functools.partial(
+    jax.jit, static_argnames=("separable", "n_windows", "interpret"))
 def _fused_call(planes: jnp.ndarray, homs: jnp.ndarray,
-                separable: bool, interpret: bool) -> jnp.ndarray:
+                separable: bool, n_windows: int,
+                interpret: bool) -> jnp.ndarray:
   num_planes, _, height, width = planes.shape
   if height % STRIP or width % CHUNK:
     raise ValueError(
@@ -358,7 +485,8 @@ def _fused_call(planes: jnp.ndarray, homs: jnp.ndarray,
     raise ValueError(f"separable path needs W >= {2 * WIN}, got {width}")
   if separable:
     kernel = functools.partial(
-        _separable_kernel, num_planes=num_planes, height=height, width=width)
+        _separable_kernel, num_planes=num_planes, height=height, width=width,
+        n_windows=min(n_windows, width // WIN))
     band_shape, sems = (2, 4, BAND, width), pltpu.SemaphoreType.DMA((2,))
   else:
     kernel = functools.partial(
@@ -400,11 +528,11 @@ def reference_render(planes: jnp.ndarray, homs: jnp.ndarray) -> jnp.ndarray:
   return jnp.moveaxis(out[0], -1, 0)
 
 
-def _make_fused(separable: bool):
+def _make_fused(separable: bool, n_windows: int):
 
   @jax.custom_vjp
   def fused(planes, homs):
-    return _fused_call(planes, homs, separable,
+    return _fused_call(planes, homs, separable, n_windows,
                        jax.default_backend() != "tpu")
 
   def fwd(planes, homs):
@@ -419,11 +547,27 @@ def _make_fused(separable: bool):
   return fused
 
 
-_FUSED = {False: _make_fused(False), True: _make_fused(True)}
+_FUSED = {(sep, n): _make_fused(sep, n)
+          for sep, n in ((False, 2), (True, 2), (True, SEP_WINDOWS))}
+
+
+def _sep_windows_needed(homs, height: int, width: int) -> int:
+  """Minimal separable-path window count (2 or 3) for concrete homographies.
+
+  2 covers any chunk whose taps span <= WIN+1 source columns from the
+  aligned-down base (always true for |h00/h22| <= 1.0); chunks reaching
+  further need the third window. Mirrors the kernel's w0 computation.
+  """
+  h = np.asarray(homs, np.float64).reshape(-1, 3, 3)
+  x_lo, x_hi = _sep_tap_extents(h, width)
+  w0 = np.clip(x_lo // WIN * WIN, 0, max(width - 2 * WIN, 0))
+  need3 = np.minimum(x_hi, width - 1) >= w0 + 2 * WIN
+  return SEP_WINDOWS if bool(need3.any()) else 2
 
 
 def render_mpi_fused(planes: jnp.ndarray, homs: jnp.ndarray,
-                     separable: bool = False) -> jnp.ndarray:
+                     separable: bool = False,
+                     check: bool = True) -> jnp.ndarray:
   """Render an MPI to a novel view in one fused TPU kernel.
 
   Args:
@@ -434,8 +578,29 @@ def render_mpi_fused(planes: jnp.ndarray, homs: jnp.ndarray,
       when ``is_separable(homs)`` (axis-aligned warps, e.g. any pure camera
       translation/zoom). The result is identical either way; the fast path
       is ~10x quicker.
+    check: when ``homs`` is concrete (not a jit tracer), verify the kernel's
+      coverage envelope with ``fits_envelope`` and transparently fall back
+      to the XLA ``reference_render`` path if the pose is outside it, so
+      out-of-envelope poses return correct pixels instead of silently
+      dropping taps. The separable check is O(P·(S+C)) host math —
+      microseconds against a ~30 ms 1080p render. The separable gather-
+      window count is also auto-tuned from the concrete homographies
+      (2 when the pose provably needs no third window — any horizontal
+      scale <= 1.0, the usual novel-view case — else 3). Under jit the
+      homographies are tracers: no check is possible, the separable path
+      conservatively uses 3 windows, and callers jitting over poses own the
+      envelope (or should use an XLA method).
 
   Returns:
     ``[3, H, W]`` rendered view, float32.
   """
-  return _FUSED[bool(separable)](planes, homs)
+  _, _, height, width = planes.shape
+  shapes_ok = not (height % STRIP or width % CHUNK) and height >= BAND
+  homs_concrete = not isinstance(homs, jax.core.Tracer)
+  n_windows = SEP_WINDOWS if separable else 2
+  if separable and homs_concrete and shapes_ok:
+    n_windows = _sep_windows_needed(homs, height, width)
+  if (check and homs_concrete and shapes_ok
+      and not fits_envelope(homs, height, width, bool(separable))):
+    return reference_render(planes, homs)
+  return _FUSED[bool(separable), n_windows](planes, homs)

@@ -133,6 +133,76 @@ class TestFusedKernel:
     with pytest.raises(ValueError, match="multiple"):
       rp.render_mpi_fused(jnp.zeros((2, 4, 24, 200)), homs)
 
+  def test_separable_wide_scale_window_coverage(self, rng):
+    """Horizontal scale 1.3 with worst-case window alignment (regression).
+
+    Window bases align down from the leftmost tap, so a chunk whose x_lo
+    lands high in its 128-block needs the third gather window; with only
+    two windows this produced ~1.0 max error (dropped taps)."""
+    p, h, w = 3, 24, 640
+    planes = _mpi(rng, p, h, w)
+    # u = 1.3*ox + 55: chunk 1's x_lo = 221 (mod 128 = 93), taps reach 387,
+    # past the two-window coverage end 384.
+    hom = np.array([[1.3, 0, 55.0], [0, 1, 3.0], [0, 0, 1]], np.float32)
+    homs = jnp.asarray(np.broadcast_to(hom, (p, 3, 3)))
+    assert rp.fits_envelope(homs, h, w, separable=True)
+    got = rp.render_mpi_fused(planes, homs, separable=True, check=False)
+    want = rp.reference_render(planes, homs)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=1e-4, rtol=0)
+
+  def test_general_wide_scale_window_coverage(self, rng):
+    """Horizontal scale 2.5 (general path) needs the fourth window."""
+    p, h, w = 2, 24, 768
+    planes = _mpi(rng, p, h, w)
+    # Chunk 1's x_lo = 330 (mod 128 = 74), taps reach 648, past the
+    # three-window coverage end 640.
+    hom = np.array([[2.5, 0.01, 10.0], [0.01, 1, 2.0], [0, 0, 1]], np.float32)
+    homs = jnp.asarray(np.broadcast_to(hom, (p, 3, 3)))
+    assert rp.fits_envelope(homs, h, w, separable=False)
+    got = rp.render_mpi_fused(planes, homs, separable=False, check=False)
+    want = rp.reference_render(planes, homs)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=1e-4, rtol=0)
+
+  def test_out_of_envelope_falls_back_to_reference(self, rng):
+    """Eager calls outside the coverage envelope return exact XLA output."""
+    p, h, w = 2, 24, 768
+    planes = _mpi(rng, p, h, w)
+    # Horizontal scale 4: chunk 0's in-image taps reach column 508, beyond
+    # its three-window coverage end 384 (and the general path's four-window
+    # guarantee is exceeded for interior chunks at this scale too).
+    hom = np.array([[4.0, 0, 0.0], [0, 1, 0.0], [0, 0, 1]], np.float32)
+    homs = jnp.asarray(np.broadcast_to(hom, (p, 3, 3)))
+    assert not rp.fits_envelope(homs, h, w)
+    got = rp.render_mpi_fused(planes, homs, separable=True)
+    want = rp.reference_render(planes, homs)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+  def test_boundary_tap_row_rejected(self, rng):
+    """Rows mapping to v in (H-1, H) still tap source row H-1 (regression).
+
+    A pose whose strip band sits low while one row reaches v = H-0.5 must
+    be rejected by fits_envelope (the H-1 tap misses the band), not
+    silently rendered with a dropped 0.5-weight tap."""
+    p, h, w = 2, 48, 128
+    planes = _mpi(rng, p, h, w)
+    hom = np.array([[0.1, 0, 10.0], [0, -13.3, 653.6], [0, -1, 47.6]],
+                   np.float32)
+    homs = jnp.asarray(np.broadcast_to(hom, (p, 3, 3)))
+    assert not rp.fits_envelope(homs, h, w, separable=False)
+    got = rp.render_mpi_fused(planes, homs, separable=False)
+    want = rp.reference_render(planes, homs)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+  def test_fits_envelope_accepts_normal_poses(self, rng):
+    p, h, w = 4, 32, 256
+    depths = inv_depths(1.0, 100.0, p)
+    for kw in (TRANSLATION, ROTATION):
+      homs = rp.pixel_homographies(
+          _pose(**kw), depths, _intrinsics(h, w), h, w)[:, 0]
+      assert rp.fits_envelope(homs, h, w)
+
   def test_gradients_flow_through_vjp(self, rng):
     p, h, w = 3, 24, 256
     planes = _mpi(rng, p, h, w)

@@ -48,8 +48,16 @@ def _make_inputs():
   homs = render_pallas.pixel_homographies(
       jnp.asarray(pose)[None], depths, jnp.asarray(intrinsics)[None],
       HEIGHT, WIDTH)[:, 0]
-  return planes, homs, jnp.asarray(pose)[None], depths, jnp.asarray(
-      intrinsics)[None]
+  # A 1-degree pan + truck: the general (non-separable) novel-view case.
+  rot = np.eye(4, dtype=np.float32)
+  c, s = np.cos(np.radians(1.0)), np.sin(np.radians(1.0))
+  rot[:3, :3] = [[c, 0, s], [0, 1, 0], [-s, 0, c]]
+  rot[0, 3], rot[2, 3] = 0.05, -0.03
+  homs_rot = render_pallas.pixel_homographies(
+      jnp.asarray(rot)[None], depths, jnp.asarray(intrinsics)[None],
+      HEIGHT, WIDTH)[:, 0]
+  return (planes, homs, homs_rot, jnp.asarray(pose)[None], depths,
+          jnp.asarray(intrinsics)[None])
 
 
 def _fps(fn, *args, iters: int = 30) -> float:

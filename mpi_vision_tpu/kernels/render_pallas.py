@@ -66,7 +66,13 @@ BAND = 24      # source rows held in VMEM (8-aligned start)
 CHUNK = 128    # output columns per inner step == one vreg of lanes
 WIN = 128      # gather window width == max lane-gather span
 SEP_WINDOWS = 3   # separable path: 2 unconditional + 1 conditional
-MAX_WINDOWS = 4   # general path: all conditional
+MAX_WINDOWS = 4   # legacy general strip path: all conditional
+
+# Tiled general path (rotations): 2-D output tiles with per-tile source
+# rectangles and per-row 16-row band slices for the vertical lerp.
+G_TILE_W = 384   # preferred output tile width (3 chunks)
+G_BAND = 32      # source rows per tile band (8-aligned start)
+G_SLICE = 16     # band rows gathered per output row (8-aligned offset)
 
 
 def pixel_homographies(
@@ -346,6 +352,253 @@ def _render_kernel(hom_ref, planes_ref, out_ref, band_ref, acc_ref, sem,
     out_ref[0] = acc_ref[:]
 
 
+def _tile_sizes(height: int, width: int, n_windows: int):
+  """Static tile geometry for the tiled general kernel."""
+  tw = next(t for t in (G_TILE_W, 256, CHUNK) if width % t == 0)
+  tsrc = min(width, 640 if n_windows == 2 else 1024)
+  bandg = G_BAND if height >= G_BAND else BAND
+  n_eff = min(n_windows, tsrc // WIN)
+  return tw, tsrc, bandg, n_eff
+
+
+def _tiled_kernel(hom_ref, meta_ref, meta_next_ref, wq_ref, planes_ref,
+                  out_ref, band_ref, acc_ref, sems,
+                  *, num_planes, height, width, n_windows, tw, tsrc, bandg):
+  """General-homography render on 2-D output tiles (the rotation path).
+
+  The legacy strip path holds one 24-row source band for a full-width row
+  strip, so any rotation whose source rows drift more than ~16 over the
+  whole width (≈0.2° pan at 1080p) falls outside it. Tiling the output into
+  ``[STRIP, tw]`` blocks bounds the drift per tile: each (strip, tile,
+  plane) step DMAs its own ``[4, bandg, tsrc]`` source rectangle, raising
+  the envelope to ~2-3° of rotation at 1080p with exact bilinear output.
+
+  Per output row the vertical lerp reads only a 16-row slice of the band
+  (``pl.ds(q0, G_SLICE)``, 8-aligned per row-chunk) — 2x fewer gathered
+  elements than a full-band gather. x-taps come from ``n_windows``
+  unconditional 128-lane windows per row-chunk, bases aligned down from
+  that row's leftmost tap relative to the tile origin.
+
+  All data-dependent scalars (tile band origins ``ymin``/``xmin``, per-
+  row-chunk window base ``w0`` and band-slice offset ``q0``) are
+  precomputed VECTORIZED on the VPU by ``_tiled_call`` (inside the same
+  jit) and fed in as SMEM-blocked tables: an earlier revision derived them
+  in-kernel from chunk-boundary homography evaluations, and those ~48
+  scalar-core divides per grid step dominated the whole frame (~60 of
+  149 ms at 1080p). ``_plan_tiled`` is the host-side mirror of the table
+  math for the envelope/fallback decision.
+  """
+  s = pl.program_id(0)
+  t = pl.program_id(1)
+  p = pl.program_id(2)
+  n_t = pl.num_programs(1)
+  step = (s * n_t + t) * num_planes + p
+  total = pl.num_programs(0) * n_t * num_planes
+  slot = jax.lax.rem(step, 2)
+  hom = [hom_ref[p, k] for k in range(9)]
+  c_t = tw // CHUNK
+  ymin = pl.multiple_of(meta_ref[0, 0, 0, p], 8)
+  xmin = pl.multiple_of(meta_ref[0, 0, 1, p], WIN)
+
+  @pl.when(step == 0)
+  def _first_dma():
+    pltpu.make_async_copy(
+        planes_ref.at[p, :, pl.ds(ymin, bandg), pl.ds(xmin, tsrc)],
+        band_ref.at[0], sems.at[0]).start()
+
+  pltpu.make_async_copy(
+      planes_ref.at[p, :, pl.ds(ymin, bandg), pl.ds(xmin, tsrc)],
+      band_ref.at[slot], sems.at[slot]).wait()
+
+  @pl.when(step < total - 1)
+  def _next_dma():
+    same_tile = p + 1 < num_planes
+    p_n = jnp.where(same_tile, p + 1, 0)
+    ymin_n = pl.multiple_of(meta_next_ref[0, 0, 0, p_n], 8)
+    xmin_n = pl.multiple_of(meta_next_ref[0, 0, 1, p_n], WIN)
+    pltpu.make_async_copy(
+        planes_ref.at[p_n, :, pl.ds(ymin_n, bandg), pl.ds(xmin_n, tsrc)],
+        band_ref.at[1 - slot], sems.at[1 - slot]).start()
+
+  lane = jax.lax.broadcasted_iota(jnp.int32, (STRIP, tw), 1).astype(jnp.float32)
+  sub = jax.lax.broadcasted_iota(jnp.int32, (STRIP, tw), 0).astype(jnp.float32)
+  u, v = _uv(hom, lane + (t * tw).astype(jnp.float32),
+             sub + (s * STRIP).astype(jnp.float32))          # [STRIP, tw]
+  x0f = jnp.floor(u)
+  fxs = u - x0f
+  x0s = x0f.astype(jnp.int32)
+  qrow = jax.lax.broadcasted_iota(
+      jnp.int32, (G_SLICE, CHUNK), 0).astype(jnp.float32)
+
+  for r in range(STRIP):
+    for ci in range(c_t):
+      w0 = pl.multiple_of(wq_ref[0, 0, p, r, ci * 2], WIN)
+      q0 = pl.multiple_of(wq_ref[0, 0, p, r, ci * 2 + 1], 8)
+
+      sl = slice(ci * CHUNK, (ci + 1) * CHUNK)
+      fx = fxs[r:r + 1, sl]                                  # [1, CHUNK]
+      x0 = x0s[r:r + 1, sl]
+      v_r = v[r:r + 1, sl]
+      valid0 = (x0 >= 0) & (x0 <= width - 1)
+      valid1 = (x0 + 1 >= 0) & (x0 + 1 <= width - 1)
+      xrel = x0 - xmin
+
+      xles = None
+      for wi in range(n_windows):
+        base = pl.multiple_of(w0 + wi * WIN, WIN)
+        rel = xrel - base
+        in0 = (rel >= 0) & (rel < WIN) & valid0
+        in1 = (rel + 1 >= 0) & (rel + 1 < WIN) & valid1
+        a = jnp.where(in0, 1.0 - fx, 0.0)
+        b = jnp.where(in1, fx, 0.0)
+        i0 = jnp.broadcast_to(jnp.clip(rel, 0, WIN - 1), (G_SLICE, CHUNK))
+        i1 = jnp.broadcast_to(jnp.clip(rel + 1, 0, WIN - 1), (G_SLICE, CHUNK))
+        outs = []
+        for c in range(4):
+          win = band_ref[slot, c, pl.ds(q0, G_SLICE), pl.ds(base, WIN)]
+          g0 = jnp.take_along_axis(win, i0, axis=1)
+          g1 = jnp.take_along_axis(win, i1, axis=1)
+          outs.append(g0 * a + g1 * b)
+        xles = outs if xles is None else [x + o for x, o in zip(xles, outs)]
+
+      ky = jnp.maximum(
+          0.0, 1.0 - jnp.abs(v_r - (qrow + (ymin + q0).astype(jnp.float32))))
+      pix = [jnp.sum(x * ky, axis=0, keepdims=True) for x in xles]
+      rgb, alpha = pix[:3], pix[3]
+      cols = pl.ds(pl.multiple_of(ci * CHUNK, CHUNK), CHUNK)
+
+      for c in range(3):
+
+        @pl.when(p == 0)
+        def _init(c=c):
+          acc_ref[c, r:r + 1, cols] = rgb[c]
+
+        @pl.when(p > 0)
+        def _fold(c=c):
+          prev = acc_ref[c, r:r + 1, cols]
+          acc_ref[c, r:r + 1, cols] = rgb[c] * alpha + prev * (1.0 - alpha)
+
+  @pl.when(p == num_planes - 1)
+  def _emit():
+    out_ref[0] = acc_ref[:]
+
+
+def _tiled_tables(homs: jnp.ndarray, height: int, width: int,
+                  tw: int, tsrc: int, bandg: int, n_eff: int):
+  """Device-side (traceable) per-tile/per-row-chunk scalar tables.
+
+  Returns ``meta [S, T, P, 2]`` (tile band origin ymin, xmin) and
+  ``wq [P, H, C, 2]`` (per-row-chunk gather-window base relative to xmin,
+  and band-slice offset relative to ymin), all int32 and all aligned for
+  direct use as DMA/slice offsets. ``_plan_tiled`` mirrors this math on
+  the host for the envelope decision.
+  """
+  p = homs.shape[0]
+  h9 = homs.reshape(p, 3, 3).astype(jnp.float32)
+  c_t = tw // CHUNK
+  n_chunks = width // CHUNK
+  n_strips = height // STRIP
+  n_tiles = width // tw
+
+  def uv(ox, oy):
+    den = (h9[:, 2, 0, None, None] * ox + h9[:, 2, 1, None, None] * oy
+           + h9[:, 2, 2, None, None])
+    u = (h9[:, 0, 0, None, None] * ox + h9[:, 0, 1, None, None] * oy
+         + h9[:, 0, 2, None, None]) / den
+    v = (h9[:, 1, 0, None, None] * ox + h9[:, 1, 1, None, None] * oy
+         + h9[:, 1, 2, None, None]) / den
+    return (jnp.where(jnp.isfinite(u), u, 0.0),
+            jnp.where(jnp.isfinite(v), v, 0.0))
+
+  # Tile-corner extents -> per-tile band origins.
+  oyc = (jnp.arange(n_strips, dtype=jnp.float32)[:, None] * STRIP
+         + jnp.array([0.0, STRIP - 1.0])).reshape(-1)        # [S*2]
+  oxc = (jnp.arange(n_tiles, dtype=jnp.float32)[:, None] * tw
+         + jnp.array([0.0, tw - 1.0])).reshape(-1)           # [T*2]
+  u_c, v_c = uv(oxc[None, None, :], oyc[None, :, None])      # [P, S*2, T*2]
+  umin = u_c.reshape(p, n_strips, 2, n_tiles, 2).min(axis=(2, 4))
+  vmin = v_c.reshape(p, n_strips, 2, n_tiles, 2).min(axis=(2, 4))
+  ymin = jnp.clip(jnp.floor(vmin).astype(jnp.int32) - 1, 0,
+                  height - bandg) // 8 * 8                   # [P, S, T]
+  xmin = jnp.clip(jnp.floor(umin).astype(jnp.int32), 0,
+                  width - tsrc) // WIN * WIN
+
+  # Per-row chunk-boundary extents -> window base / band-slice offset.
+  rows = jnp.arange(height, dtype=jnp.float32)
+  oxb = jnp.arange(n_chunks + 1, dtype=jnp.float32) * CHUNK
+  u_b, v_b = uv(oxb[None, None, :], rows[None, :, None])     # [P, H, B]
+  x_lo = jnp.floor(
+      jnp.minimum(u_b[..., :-1], u_b[..., 1:])).astype(jnp.int32)
+  v_lo = jnp.minimum(v_b[..., :-1], v_b[..., 1:])            # [P, H, C]
+  tile_of_chunk = jnp.arange(n_chunks) // c_t
+  ymin_rc = jnp.repeat(ymin, STRIP, axis=1)[:, :, tile_of_chunk]
+  xmin_rc = jnp.repeat(xmin, STRIP, axis=1)[:, :, tile_of_chunk]
+  w0 = jnp.clip((x_lo - xmin_rc) // WIN * WIN, 0, tsrc - n_eff * WIN)
+  q0 = jnp.clip((jnp.floor(v_lo).astype(jnp.int32) - ymin_rc) // 8 * 8,
+                0, bandg - G_SLICE)
+  # Layouts put the per-step-blocked axes first (Pallas requires the last
+  # two block dims to equal the array dims for SMEM blocks).
+  meta = jnp.stack([ymin, xmin], axis=-1).transpose(1, 2, 3, 0)  # [S,T,2,P]
+  wq = (jnp.stack([w0, q0], axis=-1)                             # [P,H,C,2]
+        .reshape(p, n_strips, STRIP, n_tiles, c_t, 2)
+        .transpose(1, 3, 0, 2, 4, 5)
+        .reshape(n_strips, n_tiles, p, STRIP, c_t * 2))
+  return meta, wq
+
+
+@functools.partial(jax.jit, static_argnames=("n_windows", "interpret"))
+def _tiled_call(planes: jnp.ndarray, homs: jnp.ndarray,
+                n_windows: int, interpret: bool) -> jnp.ndarray:
+  num_planes, _, height, width = planes.shape
+  if height % STRIP or width % CHUNK:
+    raise ValueError(
+        f"H must be a multiple of {STRIP} and W of {CHUNK}; got "
+        f"{height}x{width} (pad the MPI, or use an XLA method)")
+  if height < BAND:
+    raise ValueError(f"H must be >= {BAND}, got {height}")
+  tw, tsrc, bandg, n_eff = _tile_sizes(height, width, n_windows)
+  c_t = tw // CHUNK
+  n_strips, n_tiles = height // STRIP, width // tw
+  homs32 = homs.reshape(num_planes, 9).astype(jnp.float32)
+  meta, wq = _tiled_tables(homs32, height, width, tw, tsrc, bandg, n_eff)
+
+  def next_index(s, t, p):
+    # The (s, t, p) grid steps with p innermost; clamp at the final step.
+    same_tile = p + 1 < num_planes
+    t_n = jnp.where(same_tile, t, jnp.where(t + 1 < n_tiles, t + 1, 0))
+    s_n = jnp.minimum(
+        jnp.where(same_tile | (t + 1 < n_tiles), s, s + 1), n_strips - 1)
+    return s_n, t_n, 0, 0
+
+  kernel = functools.partial(
+      _tiled_kernel, num_planes=num_planes, height=height, width=width,
+      n_windows=n_eff, tw=tw, tsrc=tsrc, bandg=bandg)
+  return pl.pallas_call(
+      kernel,
+      grid=(n_strips, n_tiles, num_planes),
+      in_specs=[
+          pl.BlockSpec(memory_space=pltpu.SMEM),   # [P, 9] homographies
+          pl.BlockSpec((1, 1, 2, num_planes), lambda s, t, p: (s, t, 0, 0),
+                       memory_space=pltpu.SMEM),   # meta (this step's tile)
+          pl.BlockSpec((1, 1, 2, num_planes), next_index,
+                       memory_space=pltpu.SMEM),   # meta (next step's tile)
+          pl.BlockSpec((1, 1, num_planes, STRIP, 2 * c_t),
+                       lambda s, t, p: (s, t, 0, 0, 0),
+                       memory_space=pltpu.SMEM),   # per-row-chunk w0/q0
+          pl.BlockSpec(memory_space=pl.ANY),       # [P, 4, H, W] planes (HBM)
+      ],
+      out_specs=pl.BlockSpec(
+          (1, 3, STRIP, tw), lambda s, t, p: (0, 0, s, t)),
+      out_shape=jax.ShapeDtypeStruct((1, 3, height, width), jnp.float32),
+      scratch_shapes=[
+          pltpu.VMEM((2, 4, bandg, tsrc), jnp.float32),
+          pltpu.VMEM((3, STRIP, tw), jnp.float32),
+          pltpu.SemaphoreType.DMA((2,)),
+      ],
+      interpret=interpret,
+  )(homs32, meta, meta, wq, planes.astype(jnp.float32))[0]
+
+
 def is_separable(homs, atol: float = 1e-6) -> bool:
   """Whether pixel homographies are axis-aligned (fast-path eligible).
 
@@ -451,6 +704,103 @@ def fits_envelope(homs, height: int, width: int,
   return bool(u_ok.all())
 
 
+def _plan_tiled(homs, height: int, width: int):
+  """Minimal window count (2 or 3) for the tiled general kernel, or None.
+
+  The host-side mirror of ``_tiled_tables``: every in-image bilinear tap
+  of every output pixel must land inside its tile's ``[bandg, tsrc]``
+  source rectangle, its row's ``G_SLICE`` band rows, and its row-chunk's
+  gather windows. Returns None (caller falls back to XLA) when the pose is
+  outside the kernel envelope or a homography denominator changes sign
+  over the image (poles break the edge-monotonicity both this plan and the
+  table math rely on). ``homs`` must be concrete ([P, 3, 3]).
+
+  Mirror precision: this runs in f64 while the device tables are f32, so a
+  floor() input within ~1 ulp of an integer can resolve differently. Such
+  divergence only ever drops a tap whose bilinear weight is the distance
+  to that same integer boundary (~1e-4 on 1080p-scale coordinates), so an
+  approved pose stays within the 1e-3 parity budget even on mismatch.
+  """
+  h = np.asarray(homs, np.float64).reshape(-1, 3, 3)
+  p = h.shape[0]
+  cx = np.array([0.0, width - 1.0])
+  cy = np.array([0.0, height - 1.0])
+  d_flat = (h[:, 2, 0, None, None] * cx[None, :, None]
+            + h[:, 2, 1, None, None] * cy[None, None, :]
+            + h[:, 2, 2, None, None]).reshape(p, 4)
+  if not np.isfinite(d_flat).all():
+    return None
+  if not np.all((d_flat > 0).all(1) | (d_flat < 0).all(1)):
+    return None
+
+  tw = next(t for t in (G_TILE_W, 256, CHUNK) if width % t == 0)
+  c_t = tw // CHUNK
+  n_chunks = width // CHUNK
+  n_strips = height // STRIP
+
+  def uv(ox, oy):
+    den = (h[:, 2, 0, None, None] * ox + h[:, 2, 1, None, None] * oy
+           + h[:, 2, 2, None, None])
+    u = (h[:, 0, 0, None, None] * ox + h[:, 0, 1, None, None] * oy
+         + h[:, 0, 2, None, None]) / den
+    v = (h[:, 1, 0, None, None] * ox + h[:, 1, 1, None, None] * oy
+         + h[:, 1, 2, None, None]) / den
+    return (np.where(np.isfinite(u), u, 0.0),
+            np.where(np.isfinite(v), v, 0.0))
+
+  # Tile-corner extents -> per-tile band/slab origins (mirrors tile_origin).
+  oyc = (np.arange(n_strips, dtype=np.float64)[:, None] * STRIP
+         + np.array([0.0, STRIP - 1.0])).reshape(-1)         # [S*2]
+  oxc = (np.arange(width // tw, dtype=np.float64)[:, None] * tw
+         + np.array([0.0, tw - 1.0])).reshape(-1)            # [T*2]
+  u_c, v_c = uv(oxc[None, None, :], oyc[None, :, None])      # [P, S*2, T*2]
+  u_c = u_c.reshape(p, n_strips, 2, -1, 2)
+  v_c = v_c.reshape(p, n_strips, 2, -1, 2)
+  umin_tile = u_c.min(axis=(2, 4))                           # [P, S, T]
+  vmin_tile = v_c.min(axis=(2, 4))
+  bandg = G_BAND if height >= G_BAND else BAND
+  ymin = np.clip(np.floor(vmin_tile).astype(np.int64) - 1, 0,
+                 height - bandg) // 8 * 8                    # [P, S, T]
+
+  # Per-row chunk-boundary evals (mirrors the kernel's bu/bv scalars).
+  rows = np.arange(height, dtype=np.float64)
+  oxb = np.arange(n_chunks + 1, dtype=np.float64) * CHUNK
+  u_b, v_b = uv(oxb[None, None, :], rows[None, :, None])     # [P, H, B]
+  x_lo = np.floor(np.minimum(u_b[..., :-1], u_b[..., 1:])).astype(np.int64)
+  x_hi = np.floor(np.maximum(u_b[..., :-1], u_b[..., 1:])).astype(np.int64) + 1
+  v_lo = np.minimum(v_b[..., :-1], v_b[..., 1:])             # [P, H, C]
+  v_hi = np.maximum(v_b[..., :-1], v_b[..., 1:])
+
+  # Chunk ci belongs to tile ci // c_t; row r to strip r // STRIP.
+  tile_of_chunk = np.arange(n_chunks) // c_t
+  ymin_rc = np.repeat(ymin, STRIP, axis=1)[:, :, tile_of_chunk]  # [P, H, C]
+
+  q0 = np.clip((np.floor(v_lo).astype(np.int64) - ymin_rc) // 8 * 8,
+               0, bandg - G_SLICE)
+  q_lo = np.maximum(np.floor(v_lo), 0)
+  q_hi = np.minimum(np.floor(v_hi) + 1, height - 1)
+  empty_v = (v_hi <= -1) | (v_lo >= height)
+  v_ok = empty_v | ((q_lo >= ymin_rc + q0)
+                    & (q_hi <= ymin_rc + q0 + G_SLICE - 1))
+  if not v_ok.all():
+    return None
+
+  empty_h = (x_hi < 0) | (x_lo > width - 1)
+  for n_windows in (2, 3):
+    tsrc = min(width, 640 if n_windows == 2 else 1024)
+    n_eff = min(n_windows, tsrc // WIN)
+    xmin = np.clip(np.floor(umin_tile).astype(np.int64), 0,
+                   width - tsrc) // WIN * WIN                # [P, S, T]
+    xmin_rc = np.repeat(xmin, STRIP, axis=1)[:, :, tile_of_chunk]
+    w0 = np.clip((x_lo - xmin_rc) // WIN * WIN, 0, tsrc - n_eff * WIN)
+    h_ok = empty_h | (
+        (np.maximum(x_lo, 0) >= xmin_rc)
+        & (np.minimum(x_hi, width - 1) <= xmin_rc + w0 + n_eff * WIN - 1))
+    if h_ok.all():
+      return n_windows
+  return None
+
+
 def _sep_tap_extents(h, width: int):
   """Per-chunk integer tap extents [x_lo, x_hi] for separable homographies.
 
@@ -551,6 +901,32 @@ _FUSED = {(sep, n): _make_fused(sep, n)
           for sep, n in ((False, 2), (True, 2), (True, SEP_WINDOWS))}
 
 
+def _make_tiled(n_windows: int):
+
+  @jax.custom_vjp
+  def tiled(planes, homs):
+    return _tiled_call(planes, homs, n_windows,
+                       jax.default_backend() != "tpu")
+
+  def fwd(planes, homs):
+    return tiled(planes, homs), (planes, homs)
+
+  def bwd(res, g):
+    planes, homs = res
+    _, vjp = jax.vjp(reference_render, planes, homs)
+    return vjp(g)
+
+  tiled.defvjp(fwd, bwd)
+  return tiled
+
+
+_TILED = {n: _make_tiled(n) for n in (2, 3)}
+
+# Jitted fallback: the eager reference path materializes per-op temporaries
+# (several GB at 1080p x 32 planes); under jit XLA schedules them.
+_reference_render_jit = jax.jit(reference_render)
+
+
 def _sep_windows_needed(homs, height: int, width: int) -> int:
   """Minimal separable-path window count (2 or 3) for concrete homographies.
 
@@ -597,10 +973,22 @@ def render_mpi_fused(planes: jnp.ndarray, homs: jnp.ndarray,
   _, _, height, width = planes.shape
   shapes_ok = not (height % STRIP or width % CHUNK) and height >= BAND
   homs_concrete = not isinstance(homs, jax.core.Tracer)
-  n_windows = SEP_WINDOWS if separable else 2
-  if separable and homs_concrete and shapes_ok:
-    n_windows = _sep_windows_needed(homs, height, width)
-  if (check and homs_concrete and shapes_ok
-      and not fits_envelope(homs, height, width, bool(separable))):
-    return reference_render(planes, homs)
-  return _FUSED[bool(separable), n_windows](planes, homs)
+  if separable:
+    n_windows = SEP_WINDOWS
+    if homs_concrete and shapes_ok:
+      n_windows = _sep_windows_needed(homs, height, width)
+    if (check and homs_concrete and shapes_ok
+        and not fits_envelope(homs, height, width, True)):
+      return _reference_render_jit(planes, homs)
+    return _FUSED[True, n_windows](planes, homs)
+
+  # General path: rotations go through the tiled kernel, planned eagerly
+  # (per-tile origins + window counts mirrored from concrete homographies).
+  if check and homs_concrete and shapes_ok:
+    plan = _plan_tiled(homs, height, width)
+    if plan is None:
+      return _reference_render_jit(planes, homs)
+    return _TILED[plan](planes, homs)
+  # Traced or opted-out general calls keep the legacy strip kernel (tiny
+  # rotation envelope; callers own it via fits_envelope).
+  return _FUSED[False, 2](planes, homs)

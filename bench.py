@@ -83,11 +83,15 @@ def main() -> None:
 
   # Guards so neither field can mislabel which kernel ran: the truck+dolly
   # case must take the separable fast path, and the pan must be general AND
-  # inside the tiled kernel's plan (else render_mpi_fused would silently
-  # time the XLA fallback while we report it as "rotation(tiled)").
-  assert render_pallas.is_separable(homs)
-  assert not render_pallas.is_separable(homs_rot)
-  assert render_pallas._plan_tiled(homs_rot, HEIGHT, WIDTH) is not None
+  # inside the shared kernel's plan (else render_mpi_fused would silently
+  # time the XLA fallback while we report it as "rotation"). Explicit
+  # raises, not asserts: python -O must not strip them.
+  if not render_pallas.is_separable(homs):
+    raise SystemExit("truck+dolly homographies unexpectedly non-separable")
+  if render_pallas.is_separable(homs_rot):
+    raise SystemExit("rotation homographies unexpectedly separable")
+  if render_pallas._plan_shared(homs_rot, HEIGHT, WIDTH) is None:
+    raise SystemExit("rotation pose fell out of the shared-kernel envelope")
   try:
     results["separable"] = _fps(
         lambda p, h: render_pallas.render_mpi_fused(p, h, separable=True),

@@ -98,6 +98,7 @@ def render_mpi(
     method: str = "fused",
     planes_leading: bool = False,
     separable: bool | None = None,
+    check: bool = True,
 ) -> jnp.ndarray:
   """Render a novel view from an MPI. The reference's ``mpi_render_view_torch``.
 
@@ -115,10 +116,15 @@ def render_mpi(
       TPU kernel (kernels/render_pallas.py — the fastest path; requires
       H % 8 == 0, H >= 24, W % 128 == 0, and W >= 256 for its separable
       fast path).
-    separable: for 'fused_pallas' only — select the shared-gather fast path
+    separable: for 'fused_pallas' only — select the separable fast path
       (valid when the warps are axis-aligned: camera translation/zoom, no
       rotation). None auto-detects when poses are concrete; under jit the
-      check cannot run, so pass True explicitly to keep the fast path.
+      detection cannot run and None raises — pass True/False explicitly
+      (with ``check=False``) or use an XLA method.
+    check: for 'fused_pallas' only — verify the kernel's coverage envelope
+      eagerly and fall back to XLA outside it (requires concrete poses;
+      raises under jit). ``check=False`` opts into the unchecked kernel:
+      the caller owns the envelope (see kernels/render_pallas.py).
 
   Returns:
     ``[B, H, W, 3]`` rendered view.
@@ -133,12 +139,15 @@ def render_mpi(
     homs = render_pallas.pixel_homographies(
         tgt_pose, depths, intrinsics, h, w, convention)    # [P, B, 3, 3]
     if separable is None:
-      try:
-        separable = render_pallas.is_separable(homs)
-      except jax.errors.TracerArrayConversionError:
-        separable = False  # inside jit the check can't run; pass explicitly
+      if isinstance(homs, jax.core.Tracer):
+        raise ValueError(
+            "method='fused_pallas' under jit cannot auto-detect "
+            "separability; pass separable=True/False explicitly (with "
+            "check=False) or jit method='scan'/'fused' instead.")
+      separable = render_pallas.is_separable(homs)
     planar = jnp.moveaxis(planes, -1, 2)                   # [P, B, 4, H, W]
-    outs = [render_pallas.render_mpi_fused(planar[:, b], homs[:, b], separable)
+    outs = [render_pallas.render_mpi_fused(
+        planar[:, b], homs[:, b], separable, check=check)
             for b in range(planar.shape[1])]
     return jnp.stack([jnp.moveaxis(o, 0, -1) for o in outs])
 

@@ -8,44 +8,43 @@ the TPU-native redesign that makes the 30 FPS target reachable: the whole
 render is ONE kernel with no warped-plane stack, no XLA gather, and HBM
 traffic within ~2x of the theoretical minimum (read each plane once).
 
-Per grid step (strip of 8 output rows, one plane; planes innermost):
+Two kernels share the architecture (strip of 8 output rows per grid step,
+planes innermost, double-buffered source-band DMA, running composite in a
+VMEM accumulator, farthest plane's alpha ignored per utils.py:152-153):
 
-  1. A *source band* — the 24 source rows that can influence this strip,
-     8-aligned so the HBM-tiling divisibility proof holds — is DMA'd into
-     VMEM as ``[4, 24, W]`` (channels planar).
-  2. For each 128-column output chunk, plane-homography coordinates (u, v)
-     are evaluated directly on the VPU from the 3x3 matrix (pixel-space; the
-     coordinate-normalization convention is folded into the matrix by
-     ``pixel_homographies``).
-  3. The bilinear x-taps come from ``tpu.dynamic_gather`` (the HW lane
-     gather, ~750 G elem/s measured): the gather window is limited to one
-     128-lane vreg, so taps are gathered from up to three 128-aligned
-     windows of the band chosen per output row (``lax.cond`` skips windows a
-     row does not touch), each tap gathering all 24 band rows at once.
-  4. The vertical lerp is a ``relu(1 - |v - row|)`` weighted sum over the 24
-     band rows — nonzero exactly at the two bilinear rows, so it reproduces
-     exact 2-tap vertical interpolation (and zeros padding for free: rows
-     outside the image are never in the clamped band) without a second
-     gather axis.
-  5. The running composite ``out = rgb*a + out*(1-a)`` lives in a VMEM f32
-     accumulator across the plane axis of the grid (farthest plane's alpha
-     ignored, utils.py:152-153), written to HBM once per strip.
+  - ``_separable_kernel``: axis-aligned homographies (any pure camera
+    translation/zoom). u depends only on the column and v only on the row,
+    so all 8 rows share their x-tap gathers over a full-width 24-row band
+    and the vertical 2-tap lerp is one small MXU matmul per chunk.
+  - ``_shared_kernel``: general homographies (rotations), on 2-D output
+    tiles with per-tile source rectangles. u at a fixed column is monotone
+    in the row (one-signed denominator), so a strip's x-taps per column
+    form a fan of 2-3 consecutive columns shared by all 8 rows — the
+    gathers amortize across the strip like the separable path. Vertical
+    taps are selected per pixel with single-vreg sublane gathers. All
+    data-dependent scalars come from SMEM tables computed vectorized (in
+    the same jit) from cell-corner homography evaluations.
+
+The bilinear x-taps come from ``tpu.dynamic_gather`` (the HW lane gather,
+~750 G elem/s measured); the gather window is one 128-lane vreg, so taps
+are gathered from 2-3 statically-planned 128-aligned windows per chunk.
 
 Restrictions (documented contract): H % 8 == 0, W % 128 == 0, H >= 24, and
-per-plane source extents bounded — a strip's source rows must fit the 24-row
-band (17 usable after alignment slack: vertical scale <= ~1.5 with modest
-tilt) and one output row's 128-column chunk must reach <= 2*128+1 = 257
-source columns from its leftmost tap (separable path, 3 windows: horizontal
-scale <= ~2.0) or <= 3*128+1 = 385 (general path, 4 windows: scale <= ~3.0).
-Window bases are 128-aligned *down* from the leftmost tap, so these bounds
-already include the worst-case (127-column) alignment slack.
-``fits_envelope`` checks the exact contract eagerly (cheap: the separable
-check is closed-form per strip/chunk) and ``render_mpi_fused`` uses it to
-fall back to the XLA path for out-of-envelope concrete poses. Outside the
-envelope (only reachable by jitting around the check) dropped taps produce
-PARTIAL bilinear sums — dimmed, wrong pixels, not black — and the backward
-pass (the XLA reference path via ``jax.custom_vjp``) does not match such a
-forward; inside the envelope forward and backward agree.
+per-plane source extents bounded: the separable strip band allows vertical
+scale <= ~1.5; windows cover <= 2*128+1 = 257 source columns per chunk from
+the leftmost tap (3 windows: <= ~2.0 horizontal scale). The shared kernel's
+per-tile rectangles allow several degrees of rotation at 1080p (per-column
+row-drift <= 2 for the 3-tap fan, vertical tap span <= 24 rows per strip-
+chunk, same window bounds). ``fits_envelope`` / ``_plan_shared`` check the
+exact contract eagerly — microseconds of host math — and
+``render_mpi_fused`` falls back to the XLA path for out-of-envelope
+concrete poses. Under jit no check is possible, so checked calls RAISE and
+the unchecked opt-in (``check=False``) is explicit: no code path renders
+unchecked taps by default. Outside the envelope (only reachable via that
+opt-in) dropped taps produce PARTIAL bilinear sums — dimmed, wrong pixels,
+not black — and the backward pass (the XLA reference path via
+``jax.custom_vjp``) does not match such a forward; inside the envelope
+forward and backward agree.
 """
 
 from __future__ import annotations
@@ -66,13 +65,14 @@ BAND = 24      # source rows held in VMEM (8-aligned start)
 CHUNK = 128    # output columns per inner step == one vreg of lanes
 WIN = 128      # gather window width == max lane-gather span
 SEP_WINDOWS = 3   # separable path: 2 unconditional + 1 conditional
-MAX_WINDOWS = 4   # legacy general strip path: all conditional
 
-# Tiled general path (rotations): 2-D output tiles with per-tile source
-# rectangles and per-row 16-row band slices for the vertical lerp.
+# Shared-gather general path (rotations): 2-D output tiles with per-tile
+# source rectangles; horizontal gathers shared by all STRIP rows of a chunk
+# (a small tap fan covers the rows' x-drift), vertical taps selected by
+# single-vreg sublane gathers.
 G_TILE_W = 384   # preferred output tile width (3 chunks)
 G_BAND = 32      # source rows per tile band (8-aligned start)
-G_SLICE = 16     # band rows gathered per output row (8-aligned offset)
+G_SHARED = 24    # band rows in the shared gather slice (3 sublane vregs)
 
 
 def pixel_homographies(
@@ -256,104 +256,8 @@ def _separable_kernel(hom_ref, planes_ref, out_ref, band_ref, acc_ref, sems,
     out_ref[0] = acc_ref[:]
 
 
-def _render_kernel(hom_ref, planes_ref, out_ref, band_ref, acc_ref, sem,
-                   *, num_planes, height, width):
-  s = pl.program_id(0)
-  p = pl.program_id(1)
-  oy0 = (s * STRIP).astype(jnp.float32)
-  hom = [hom_ref[p, k] for k in range(9)]
-  ymin = _ymin_of(hom, oy0, height, width)
-
-  # Band DMA: rows [ymin, ymin+BAND) of all 4 channels of plane p.
-  dma = pltpu.make_async_copy(
-      planes_ref.at[p, :, pl.ds(ymin, BAND), :], band_ref, sem)
-  dma.start()
-  dma.wait()
-
-  lane = jax.lax.broadcasted_iota(jnp.int32, (STRIP, CHUNK), 1).astype(jnp.float32)
-  sub = jax.lax.broadcasted_iota(jnp.int32, (STRIP, CHUNK), 0).astype(jnp.float32)
-  qrow = jax.lax.broadcasted_iota(jnp.int32, (BAND, CHUNK), 0).astype(jnp.float32)
-  zero4 = lambda: tuple(jnp.zeros((BAND, CHUNK), jnp.float32) for _ in range(4))
-
-  def chunk_body(h, carry):
-    ox = lane + (h * CHUNK).astype(jnp.float32)
-    oy = sub + oy0
-    u, v = _uv(hom, ox, oy)                        # [STRIP, CHUNK]
-    x0f = jnp.floor(u)
-    fxs = u - x0f
-    x0s = x0f.astype(jnp.int32)
-    cols = pl.ds(pl.multiple_of(h * CHUNK, CHUNK), CHUNK)
-
-    for r in range(STRIP):
-      fx = fxs[r:r + 1]                            # [1, CHUNK]
-      x0 = x0s[r:r + 1]
-      v_r = v[r:r + 1]
-      valid0 = (x0 >= 0) & (x0 <= width - 1)
-      valid1 = (x0 + 1 >= 0) & (x0 + 1 <= width - 1)
-
-      # This row's tap extent [x_lo, x_hi + 1] (u is monotone along the row).
-      oy_s = oy0 + float(r)
-      ua, _ = _uv(hom, (h * CHUNK).astype(jnp.float32), oy_s)
-      ub, _ = _uv(hom, (h * CHUNK + CHUNK - 1).astype(jnp.float32), oy_s)
-      ua = jnp.where(jnp.isfinite(ua), ua, 0.0)
-      ub = jnp.where(jnp.isfinite(ub), ub, 0.0)
-      x_lo = jnp.floor(jnp.minimum(ua, ub)).astype(jnp.int32)
-      x_hi = jnp.floor(jnp.maximum(ua, ub)).astype(jnp.int32) + 1
-      w0 = jnp.clip((x_lo // WIN) * WIN, 0, width - WIN)
-
-      xles = zero4()
-      for wi in range(MAX_WINDOWS):
-        base = pl.multiple_of(w0 + wi * WIN, WIN)
-
-        def hit(base=base, wi=wi):
-          rel = x0 - w0 - wi * WIN
-          in0 = (rel >= 0) & (rel < WIN) & valid0
-          in1 = (rel + 1 >= 0) & (rel + 1 < WIN) & valid1
-          i0 = jnp.broadcast_to(jnp.clip(rel, 0, WIN - 1), (BAND, CHUNK))
-          i1 = jnp.broadcast_to(jnp.clip(rel + 1, 0, WIN - 1), (BAND, CHUNK))
-          outs = []
-          for c in range(4):
-            win = band_ref[c, :, pl.ds(base, WIN)]  # [BAND, WIN]
-            g0 = jnp.take_along_axis(win, i0, axis=1)
-            g1 = jnp.take_along_axis(win, i1, axis=1)
-            outs.append(jnp.where(in0, g0, 0.0) * (1.0 - fx)
-                        + jnp.where(in1, g1, 0.0) * fx)
-          return tuple(outs)
-
-        need = ((base <= x_hi + 1) & (base + WIN > x_lo)
-                & (base <= width - WIN))
-        got = jax.lax.cond(need, hit, zero4)
-        xles = tuple(a + b for a, b in zip(xles, got))
-
-      # Vertical 2-tap lerp as a weighted band reduction; band rows outside
-      # the image are excluded by construction (band is clamped in-image).
-      ky = jnp.maximum(0.0, 1.0 - jnp.abs(v_r - (qrow + ymin.astype(jnp.float32))))
-      pix = [jnp.sum(x * ky, axis=0, keepdims=True) for x in xles]  # [1,CHUNK]
-      rgb, alpha = pix[:3], pix[3]
-
-      for c in range(3):
-
-        @pl.when(p == 0)
-        def _init(c=c):
-          # Farthest plane: alpha ignored (utils.py:152-153).
-          acc_ref[c, r:r + 1, cols] = rgb[c]
-
-        @pl.when(p > 0)
-        def _fold(c=c):
-          prev = acc_ref[c, r:r + 1, cols]
-          acc_ref[c, r:r + 1, cols] = rgb[c] * alpha + prev * (1.0 - alpha)
-
-    return carry
-
-  jax.lax.fori_loop(0, width // CHUNK, chunk_body, 0)
-
-  @pl.when(p == num_planes - 1)
-  def _emit():
-    out_ref[0] = acc_ref[:]
-
-
 def _tile_sizes(height: int, width: int, n_windows: int):
-  """Static tile geometry for the tiled general kernel."""
+  """Static tile geometry for the shared-gather general kernel."""
   tw = next(t for t in (G_TILE_W, 256, CHUNK) if width % t == 0)
   tsrc = min(width, 640 if n_windows == 2 else 1024)
   bandg = G_BAND if height >= G_BAND else BAND
@@ -361,32 +265,41 @@ def _tile_sizes(height: int, width: int, n_windows: int):
   return tw, tsrc, bandg, n_eff
 
 
-def _tiled_kernel(hom_ref, meta_ref, meta_next_ref, wq_ref, planes_ref,
-                  out_ref, band_ref, acc_ref, sems,
-                  *, num_planes, height, width, n_windows, tw, tsrc, bandg):
+def _shared_kernel(hom_ref, meta_ref, meta_next_ref, wq_ref, planes_ref,
+                   out_ref, band_ref, acc_ref, sems,
+                   *, num_planes, height, width, n_windows, n_taps, tw,
+                   tsrc, bandg):
   """General-homography render on 2-D output tiles (the rotation path).
 
-  The legacy strip path holds one 24-row source band for a full-width row
-  strip, so any rotation whose source rows drift more than ~16 over the
-  whole width (≈0.2° pan at 1080p) falls outside it. Tiling the output into
-  ``[STRIP, tw]`` blocks bounds the drift per tile: each (strip, tile,
-  plane) step DMAs its own ``[4, bandg, tsrc]`` source rectangle, raising
-  the envelope to ~2-3° of rotation at 1080p with exact bilinear output.
+  The key structural fact this kernel exploits: with a one-signed
+  denominator, ``u`` at a fixed column is monotone in the row, so across
+  the 8 rows of a strip the integer x-taps of a column span
+  ``floor(u_min)..floor(u_max)+1`` — for small rotations a fan of
+  ``n_taps`` (2 or 3) consecutive columns starting at
+  ``x̂(j) = floor(min_r u(r, j))``. All 8 rows therefore SHARE one lane
+  gather per (tap, window, channel) over a 24-row band slice, instead of
+  the ~8x gather traffic of a per-row formulation (a pure yaw pan has
+  h01 = h21 = 0: u is exactly row-independent and the fan is 2 — the
+  bilinear taps themselves).
 
-  Per output row the vertical lerp reads only a 16-row slice of the band
-  (``pl.ds(q0, G_SLICE)``, 8-aligned per row-chunk) — 2x fewer gathered
-  elements than a full-band gather. x-taps come from ``n_windows``
-  unconditional 128-lane windows per row-chunk, bases aligned down from
-  that row's leftmost tap relative to the tile origin.
+  The vertical 2-tap lerp picks, per output pixel, rows
+  ``floor(v), floor(v)+1`` of the gathered slice. Sublane-axis
+  ``take_along_axis`` is HW-supported for a single [8, 128] vreg with
+  per-sublane/per-lane indices, so each tap is selected with three
+  single-vreg sublane gathers + masks (one per 8-row group of the 24-row
+  slice) — O(1) per pixel, not an O(24) weighted reduction.
 
-  All data-dependent scalars (tile band origins ``ymin``/``xmin``, per-
-  row-chunk window base ``w0`` and band-slice offset ``q0``) are
-  precomputed VECTORIZED on the VPU by ``_tiled_call`` (inside the same
-  jit) and fed in as SMEM-blocked tables: an earlier revision derived them
-  in-kernel from chunk-boundary homography evaluations, and those ~48
-  scalar-core divides per grid step dominated the whole frame (~60 of
-  149 ms at 1080p). ``_plan_tiled`` is the host-side mirror of the table
-  math for the envelope/fallback decision.
+  Tiling the output into ``[STRIP, tw]`` blocks bounds source drift per
+  tile: each (strip, tile, plane) step DMAs its own ``[4, bandg, tsrc]``
+  source rectangle (double-buffered). All data-dependent scalars (tile
+  band origins ``ymin``/``xmin``, per-chunk window base ``w0`` and band-
+  slice offset ``q0``) are precomputed VECTORIZED on the VPU by
+  ``_shared_tables`` (inside the same jit, from cell-corner homography
+  evaluations — exact extrema for one-signed denominators) and fed in as
+  SMEM-blocked tables; in-kernel scalar-core divides measured ~60 of
+  149 ms at 1080p in an earlier revision. ``_plan_shared`` is the host-
+  side mirror of the table math for the envelope/fallback decision and
+  the static (n_taps, n_windows) choice.
   """
   s = pl.program_id(0)
   t = pl.program_id(1)
@@ -424,131 +337,176 @@ def _tiled_kernel(hom_ref, meta_ref, meta_next_ref, wq_ref, planes_ref,
   sub = jax.lax.broadcasted_iota(jnp.int32, (STRIP, tw), 0).astype(jnp.float32)
   u, v = _uv(hom, lane + (t * tw).astype(jnp.float32),
              sub + (s * STRIP).astype(jnp.float32))          # [STRIP, tw]
-  x0f = jnp.floor(u)
-  fxs = u - x0f
-  x0s = x0f.astype(jnp.int32)
-  qrow = jax.lax.broadcasted_iota(
-      jnp.int32, (G_SLICE, CHUNK), 0).astype(jnp.float32)
+  u = jnp.where(jnp.isfinite(u), u, 0.0)
+  v = jnp.where(jnp.isfinite(v), v, 0.0)
 
-  for r in range(STRIP):
-    for ci in range(c_t):
-      w0 = pl.multiple_of(wq_ref[0, 0, p, r, ci * 2], WIN)
-      q0 = pl.multiple_of(wq_ref[0, 0, p, r, ci * 2 + 1], 8)
+  for ci in range(c_t):
+    w0 = pl.multiple_of(wq_ref[0, 0, p, ci * 2], WIN)
+    q0 = pl.multiple_of(wq_ref[0, 0, p, ci * 2 + 1], 8)
+    sl = slice(ci * CHUNK, (ci + 1) * CHUNK)
+    usl = u[:, sl]                                           # [STRIP, CHUNK]
+    vsl = v[:, sl]
+    xhat_f = jnp.floor(jnp.min(usl, axis=0, keepdims=True))  # [1, CHUNK]
+    xhat = xhat_f.astype(jnp.int32)
 
-      sl = slice(ci * CHUNK, (ci + 1) * CHUNK)
-      fx = fxs[r:r + 1, sl]                                  # [1, CHUNK]
-      x0 = x0s[r:r + 1, sl]
-      v_r = v[r:r + 1, sl]
-      valid0 = (x0 >= 0) & (x0 <= width - 1)
-      valid1 = (x0 + 1 >= 0) & (x0 + 1 <= width - 1)
-      xrel = x0 - xmin
+    # Vertical taps: slice-relative row of floor(v) and its in-image lerp
+    # weights (off-image rows weight to 0 — zeros padding, utils.py:174).
+    y0f = jnp.floor(vsl)
+    fy = vsl - y0f
+    y0 = y0f.astype(jnp.int32)
+    qi = y0 - (ymin + q0)                                    # [STRIP, CHUNK]
+    w_a = jnp.where((y0 >= 0) & (y0 <= height - 1), 1.0 - fy, 0.0)
+    w_b = jnp.where((y0 + 1 >= 0) & (y0 + 1 <= height - 1), fy, 0.0)
 
-      xles = None
+    pix = [jnp.zeros((STRIP, CHUNK), jnp.float32) for _ in range(4)]
+    for tt in range(n_taps):
+      xt = xhat + tt
+      # Exact bilinear weight of integer tap column xt: nonzero (= 1-fx or
+      # fx) exactly when xt is one of the pixel's two taps.
+      ct = jnp.maximum(0.0, 1.0 - jnp.abs(usl - (xhat_f + float(tt))))
+      ct = jnp.where((xt >= 0) & (xt <= width - 1), ct, 0.0)
+
+      rel0 = xt - xmin - w0            # [1, CHUNK], window-0-relative
+      xle = None                       # per-channel [G_SHARED, CHUNK]
       for wi in range(n_windows):
+        rel = rel0 - wi * WIN
+        inw = (rel >= 0) & (rel < WIN)
+        idx = jnp.broadcast_to(jnp.clip(rel, 0, WIN - 1), (G_SHARED, CHUNK))
         base = pl.multiple_of(w0 + wi * WIN, WIN)
-        rel = xrel - base
-        in0 = (rel >= 0) & (rel < WIN) & valid0
-        in1 = (rel + 1 >= 0) & (rel + 1 < WIN) & valid1
-        a = jnp.where(in0, 1.0 - fx, 0.0)
-        b = jnp.where(in1, fx, 0.0)
-        i0 = jnp.broadcast_to(jnp.clip(rel, 0, WIN - 1), (G_SLICE, CHUNK))
-        i1 = jnp.broadcast_to(jnp.clip(rel + 1, 0, WIN - 1), (G_SLICE, CHUNK))
         outs = []
         for c in range(4):
-          win = band_ref[slot, c, pl.ds(q0, G_SLICE), pl.ds(base, WIN)]
-          g0 = jnp.take_along_axis(win, i0, axis=1)
-          g1 = jnp.take_along_axis(win, i1, axis=1)
-          outs.append(g0 * a + g1 * b)
-        xles = outs if xles is None else [x + o for x, o in zip(xles, outs)]
+          win = band_ref[slot, c, pl.ds(q0, G_SHARED), pl.ds(base, WIN)]
+          g = jnp.take_along_axis(win, idx, axis=1)
+          outs.append(jnp.where(inw, g, 0.0))
+        xle = outs if xle is None else [a + o for a, o in zip(xle, outs)]
 
-      ky = jnp.maximum(
-          0.0, 1.0 - jnp.abs(v_r - (qrow + (ymin + q0).astype(jnp.float32))))
-      pix = [jnp.sum(x * ky, axis=0, keepdims=True) for x in xles]
-      rgb, alpha = pix[:3], pix[3]
-      cols = pl.ds(pl.multiple_of(ci * CHUNK, CHUNK), CHUNK)
+      for c in range(4):
+        acc_a = jnp.zeros((STRIP, CHUNK), jnp.float32)
+        acc_b = jnp.zeros((STRIP, CHUNK), jnp.float32)
+        for k in range(G_SHARED // 8):
+          vreg = xle[c][8 * k:8 * (k + 1)]                   # [8, CHUNK]
+          ga = jnp.take_along_axis(vreg, jnp.clip(qi - 8 * k, 0, 7), axis=0)
+          gb = jnp.take_along_axis(
+              vreg, jnp.clip(qi + 1 - 8 * k, 0, 7), axis=0)
+          acc_a = jnp.where((qi >= 8 * k) & (qi < 8 * (k + 1)), ga, acc_a)
+          acc_b = jnp.where(
+              (qi + 1 >= 8 * k) & (qi + 1 < 8 * (k + 1)), gb, acc_b)
+        pix[c] += ct * (w_a * acc_a + w_b * acc_b)
 
-      for c in range(3):
+    rgb, alpha = pix[:3], pix[3]
+    cols = pl.ds(pl.multiple_of(ci * CHUNK, CHUNK), CHUNK)
+    for c in range(3):
 
-        @pl.when(p == 0)
-        def _init(c=c):
-          acc_ref[c, r:r + 1, cols] = rgb[c]
+      @pl.when(p == 0)
+      def _init(c=c):
+        # Farthest plane: alpha ignored (utils.py:152-153).
+        acc_ref[c, :, cols] = rgb[c]
 
-        @pl.when(p > 0)
-        def _fold(c=c):
-          prev = acc_ref[c, r:r + 1, cols]
-          acc_ref[c, r:r + 1, cols] = rgb[c] * alpha + prev * (1.0 - alpha)
+      @pl.when(p > 0)
+      def _fold(c=c):
+        prev = acc_ref[c, :, cols]
+        acc_ref[c, :, cols] = rgb[c] * alpha + prev * (1.0 - alpha)
 
   @pl.when(p == num_planes - 1)
   def _emit():
     out_ref[0] = acc_ref[:]
 
 
-def _tiled_tables(homs: jnp.ndarray, height: int, width: int,
-                  tw: int, tsrc: int, bandg: int, n_eff: int):
-  """Device-side (traceable) per-tile/per-row-chunk scalar tables.
+def _uv_vec(h9, ox, oy):
+  """Vectorized homography eval with non-finite guards (traceable)."""
+  den = (h9[:, 2, 0, None, None] * ox + h9[:, 2, 1, None, None] * oy
+         + h9[:, 2, 2, None, None])
+  u = (h9[:, 0, 0, None, None] * ox + h9[:, 0, 1, None, None] * oy
+       + h9[:, 0, 2, None, None]) / den
+  v = (h9[:, 1, 0, None, None] * ox + h9[:, 1, 1, None, None] * oy
+       + h9[:, 1, 2, None, None]) / den
+  return (jnp.where(jnp.isfinite(u), u, 0.0),
+          jnp.where(jnp.isfinite(v), v, 0.0))
 
-  Returns ``meta [S, T, P, 2]`` (tile band origin ymin, xmin) and
-  ``wq [P, H, C, 2]`` (per-row-chunk gather-window base relative to xmin,
-  and band-slice offset relative to ymin), all int32 and all aligned for
-  direct use as DMA/slice offsets. ``_plan_tiled`` mirrors this math on
-  the host for the envelope decision.
+
+def _corner_mins(h9, height: int, width: int, tw: int):
+  """Cell-corner u/v minima per (strip, chunk) and (strip, tile).
+
+  Cell corners are strip top/bottom rows x chunk-boundary columns — exact
+  extrema for one-signed denominators, because u and v are monotone in
+  each coordinate with the other fixed. Chunk cells aggregate to tile
+  cells (c_t chunks per tile). Shared by ``_shared_tables`` and
+  ``_plan_shared_stats`` so the plan cannot diverge from the tables.
   """
-  p = homs.shape[0]
-  h9 = homs.reshape(p, 3, 3).astype(jnp.float32)
+  p = h9.shape[0]
   c_t = tw // CHUNK
   n_chunks = width // CHUNK
   n_strips = height // STRIP
   n_tiles = width // tw
-
-  def uv(ox, oy):
-    den = (h9[:, 2, 0, None, None] * ox + h9[:, 2, 1, None, None] * oy
-           + h9[:, 2, 2, None, None])
-    u = (h9[:, 0, 0, None, None] * ox + h9[:, 0, 1, None, None] * oy
-         + h9[:, 0, 2, None, None]) / den
-    v = (h9[:, 1, 0, None, None] * ox + h9[:, 1, 1, None, None] * oy
-         + h9[:, 1, 2, None, None]) / den
-    return (jnp.where(jnp.isfinite(u), u, 0.0),
-            jnp.where(jnp.isfinite(v), v, 0.0))
-
-  # Tile-corner extents -> per-tile band origins.
   oyc = (jnp.arange(n_strips, dtype=jnp.float32)[:, None] * STRIP
          + jnp.array([0.0, STRIP - 1.0])).reshape(-1)        # [S*2]
-  oxc = (jnp.arange(n_tiles, dtype=jnp.float32)[:, None] * tw
-         + jnp.array([0.0, tw - 1.0])).reshape(-1)           # [T*2]
-  u_c, v_c = uv(oxc[None, None, :], oyc[None, :, None])      # [P, S*2, T*2]
-  umin = u_c.reshape(p, n_strips, 2, n_tiles, 2).min(axis=(2, 4))
-  vmin = v_c.reshape(p, n_strips, 2, n_tiles, 2).min(axis=(2, 4))
-  ymin = jnp.clip(jnp.floor(vmin).astype(jnp.int32) - 1, 0,
-                  height - bandg) // 8 * 8                   # [P, S, T]
-  xmin = jnp.clip(jnp.floor(umin).astype(jnp.int32), 0,
-                  width - tsrc) // WIN * WIN
+  oxb = (jnp.arange(n_chunks, dtype=jnp.float32)[:, None] * CHUNK
+         + jnp.array([0.0, CHUNK - 1.0])).reshape(-1)        # [C*2]
+  u_c, v_c = _uv_vec(h9, oxb[None, None, :], oyc[None, :, None])
+  u_c = u_c.reshape(p, n_strips, 2, n_chunks, 2)
+  v_c = v_c.reshape(p, n_strips, 2, n_chunks, 2)
+  umin_chunk = u_c.min(axis=(2, 4))                          # [P, S, C]
+  vmin_chunk = v_c.min(axis=(2, 4))
+  umin_tile = umin_chunk.reshape(p, n_strips, n_tiles, c_t).min(axis=3)
+  vmin_tile = vmin_chunk.reshape(p, n_strips, n_tiles, c_t).min(axis=3)
+  return umin_chunk, vmin_chunk, umin_tile, vmin_tile
 
-  # Per-row chunk-boundary extents -> window base / band-slice offset.
-  rows = jnp.arange(height, dtype=jnp.float32)
-  oxb = jnp.arange(n_chunks + 1, dtype=jnp.float32) * CHUNK
-  u_b, v_b = uv(oxb[None, None, :], rows[None, :, None])     # [P, H, B]
-  x_lo = jnp.floor(
-      jnp.minimum(u_b[..., :-1], u_b[..., 1:])).astype(jnp.int32)
-  v_lo = jnp.minimum(v_b[..., :-1], v_b[..., 1:])            # [P, H, C]
+
+def _table_scalars(mins, height: int, width: int, tw: int, tsrc: int,
+                   bandg: int, n_eff: int):
+  """Aligned table scalars (ymin, xmin [P,S,T]; w0, q0 [P,S,C]) from
+  cell-corner minima; the single source of truth for both the SMEM tables
+  and the plan's coverage checks."""
+  umin_chunk, vmin_chunk, umin_tile, vmin_tile = mins
+  c_t = tw // CHUNK
+  n_chunks = width // CHUNK
+  ymin = jnp.clip(jnp.floor(vmin_tile).astype(jnp.int32) - 1, 0,
+                  height - bandg) // 8 * 8                   # [P, S, T]
+  xmin = jnp.clip(jnp.floor(umin_tile).astype(jnp.int32), 0,
+                  width - tsrc) // WIN * WIN
   tile_of_chunk = jnp.arange(n_chunks) // c_t
-  ymin_rc = jnp.repeat(ymin, STRIP, axis=1)[:, :, tile_of_chunk]
-  xmin_rc = jnp.repeat(xmin, STRIP, axis=1)[:, :, tile_of_chunk]
-  w0 = jnp.clip((x_lo - xmin_rc) // WIN * WIN, 0, tsrc - n_eff * WIN)
-  q0 = jnp.clip((jnp.floor(v_lo).astype(jnp.int32) - ymin_rc) // 8 * 8,
-                0, bandg - G_SLICE)
+  ymin_c = ymin[:, :, tile_of_chunk]                         # [P, S, C]
+  xmin_c = xmin[:, :, tile_of_chunk]
+  w0 = jnp.clip((jnp.floor(umin_chunk).astype(jnp.int32) - xmin_c)
+                // WIN * WIN, 0, tsrc - n_eff * WIN)
+  q0 = jnp.clip((jnp.floor(vmin_chunk).astype(jnp.int32) - ymin_c)
+                // 8 * 8, 0, bandg - min(G_SHARED, bandg))
+  return ymin, xmin, ymin_c, xmin_c, w0, q0
+
+
+def _shared_tables(homs: jnp.ndarray, height: int, width: int,
+                   tw: int, tsrc: int, bandg: int, n_eff: int):
+  """Device-side (traceable) per-tile/per-chunk scalar tables.
+
+  Returns ``meta [S, T, 2, P]`` (tile band origin ymin, xmin) and
+  ``wq [S, T, P, 2*c_t]`` (per-chunk gather-window base relative to xmin
+  and band-slice offset relative to ymin, shared by the whole strip),
+  all int32 and aligned for direct use as DMA/slice offsets.
+  ``_plan_shared`` runs the same math (same helpers, same dtype) for the
+  envelope decision.
+  """
+  p = homs.shape[0]
+  h9 = homs.reshape(p, 3, 3).astype(jnp.float32)
+  c_t = tw // CHUNK
+  n_strips = height // STRIP
+  n_tiles = width // tw
+  mins = _corner_mins(h9, height, width, tw)
+  ymin, xmin, _, _, w0, q0 = _table_scalars(
+      mins, height, width, tw, tsrc, bandg, n_eff)
   # Layouts put the per-step-blocked axes first (Pallas requires the last
   # two block dims to equal the array dims for SMEM blocks).
   meta = jnp.stack([ymin, xmin], axis=-1).transpose(1, 2, 3, 0)  # [S,T,2,P]
-  wq = (jnp.stack([w0, q0], axis=-1)                             # [P,H,C,2]
-        .reshape(p, n_strips, STRIP, n_tiles, c_t, 2)
-        .transpose(1, 3, 0, 2, 4, 5)
-        .reshape(n_strips, n_tiles, p, STRIP, c_t * 2))
+  wq = (jnp.stack([w0, q0], axis=-1)                             # [P,S,C,2]
+        .reshape(p, n_strips, n_tiles, c_t, 2)
+        .transpose(1, 2, 0, 3, 4)
+        .reshape(n_strips, n_tiles, p, c_t * 2))
   return meta, wq
 
 
-@functools.partial(jax.jit, static_argnames=("n_windows", "interpret"))
-def _tiled_call(planes: jnp.ndarray, homs: jnp.ndarray,
-                n_windows: int, interpret: bool) -> jnp.ndarray:
+@functools.partial(
+    jax.jit, static_argnames=("n_taps", "n_windows", "interpret"))
+def _shared_call(planes: jnp.ndarray, homs: jnp.ndarray,
+                 n_taps: int, n_windows: int, interpret: bool) -> jnp.ndarray:
   num_planes, _, height, width = planes.shape
   if height % STRIP or width % CHUNK:
     raise ValueError(
@@ -560,7 +518,7 @@ def _tiled_call(planes: jnp.ndarray, homs: jnp.ndarray,
   c_t = tw // CHUNK
   n_strips, n_tiles = height // STRIP, width // tw
   homs32 = homs.reshape(num_planes, 9).astype(jnp.float32)
-  meta, wq = _tiled_tables(homs32, height, width, tw, tsrc, bandg, n_eff)
+  meta, wq = _shared_tables(homs32, height, width, tw, tsrc, bandg, n_eff)
 
   def next_index(s, t, p):
     # The (s, t, p) grid steps with p innermost; clamp at the final step.
@@ -571,8 +529,9 @@ def _tiled_call(planes: jnp.ndarray, homs: jnp.ndarray,
     return s_n, t_n, 0, 0
 
   kernel = functools.partial(
-      _tiled_kernel, num_planes=num_planes, height=height, width=width,
-      n_windows=n_eff, tw=tw, tsrc=tsrc, bandg=bandg)
+      _shared_kernel, num_planes=num_planes, height=height, width=width,
+      n_windows=n_eff, n_taps=n_taps, tw=tw, tsrc=tsrc,
+      bandg=bandg)
   return pl.pallas_call(
       kernel,
       grid=(n_strips, n_tiles, num_planes),
@@ -582,9 +541,9 @@ def _tiled_call(planes: jnp.ndarray, homs: jnp.ndarray,
                        memory_space=pltpu.SMEM),   # meta (this step's tile)
           pl.BlockSpec((1, 1, 2, num_planes), next_index,
                        memory_space=pltpu.SMEM),   # meta (next step's tile)
-          pl.BlockSpec((1, 1, num_planes, STRIP, 2 * c_t),
-                       lambda s, t, p: (s, t, 0, 0, 0),
-                       memory_space=pltpu.SMEM),   # per-row-chunk w0/q0
+          pl.BlockSpec((1, 1, num_planes, 2 * c_t),
+                       lambda s, t, p: (s, t, 0, 0),
+                       memory_space=pltpu.SMEM),   # per-chunk w0/q0
           pl.BlockSpec(memory_space=pl.ANY),       # [P, 4, H, W] planes (HBM)
       ],
       out_specs=pl.BlockSpec(
@@ -612,20 +571,28 @@ def is_separable(homs, atol: float = 1e-6) -> bool:
 
 def fits_envelope(homs, height: int, width: int,
                   separable: bool | None = None) -> bool:
-  """Eagerly check the fused kernel's exact coverage contract.
+  """Eagerly check the fused kernels' exact coverage contract.
 
-  Mirrors the kernel's band / gather-window arithmetic: every in-image
-  bilinear tap of every output pixel must land inside the 24-row source band
-  its strip DMAs and inside the gather windows its 128-column chunk reaches
-  (3 windows separable, 4 general, bases 128-aligned down from the leftmost
-  tap). Extrema are evaluated at strip/chunk boundaries, exact for
-  projective maps whose denominator keeps one sign over the image (checked);
-  sign-changing denominators reject. ``homs`` must be concrete ([P, 3, 3]).
+  For separable homographies, mirrors the separable strip kernel's band /
+  gather-window arithmetic: every in-image bilinear tap of every output
+  pixel must land inside the 24-row source band its strip DMAs and inside
+  the gather windows its 128-column chunk reaches (bases 128-aligned down
+  from the leftmost tap). Extrema are evaluated at strip/chunk boundaries,
+  exact for projective maps whose denominator keeps one sign over the image
+  (checked); sign-changing denominators reject. For general homographies,
+  delegates to ``_plan_shared`` (the shared-gather kernel's envelope).
+  ``homs`` must be concrete ([P, 3, 3]).
   """
   h = np.asarray(homs, np.float64).reshape(-1, 3, 3)
   if separable is None:
     separable = is_separable(homs)
-  n_win = SEP_WINDOWS if separable else MAX_WINDOWS
+  if not separable:
+    return _plan_shared(homs, height, width) is not None
+  if not is_separable(homs):
+    # A caller-asserted separable flag on non-separable homographies is a
+    # contract violation; reject so checked callers fall back safely.
+    return False
+  n_win = SEP_WINDOWS
   p = h.shape[0]
 
   # Denominator one-signed over the image (else u/v are not edge-monotone).
@@ -639,37 +606,19 @@ def fits_envelope(homs, height: int, width: int,
   if not np.all((d_flat > 0).all(1) | (d_flat < 0).all(1)):
     return False
 
-  def uv(ox, oy):
-    # ox [...,], oy [...] broadcastable against a trailing plane axis.
-    den = h[:, 2, 0] * ox + h[:, 2, 1] * oy + h[:, 2, 2]
-    u = (h[:, 0, 0] * ox + h[:, 0, 1] * oy + h[:, 0, 2]) / den
-    v = (h[:, 1, 0] * ox + h[:, 1, 1] * oy + h[:, 1, 2]) / den
-    return u, v
-
   # --- vertical: per strip, the kernel's corner-based band must hold all
-  # in-image taps of every row in the strip (row extrema at ox in {0, W-1}).
-  # Separable fast path: v is linear in the row (denominator constant), so
-  # strip-corner rows are exact extrema — O(P*S) instead of O(P*H).
+  # in-image taps of every row in the strip. v is linear in the row
+  # (denominator constant for separable maps), so strip-corner rows are
+  # exact extrema — O(P*S) instead of O(P*H).
   n_strips = height // STRIP
-  if separable:
-    oy = (np.arange(n_strips, dtype=np.float64)[:, None] * STRIP
-          + np.array([0.0, STRIP - 1.0]))                      # [S, 2]
-    v_c = ((h[:, 1, 1] * oy[..., None] + h[:, 1, 2])
-           / h[:, 2, 2]).transpose(2, 0, 1)                    # [P, S, 2]
-    v_c = np.where(np.isfinite(v_c), v_c, 0.0)
-    v_lo, v_hi = v_c.min(axis=2), v_c.max(axis=2)              # [P, S]
-    vmin_strip = v_lo
-  else:
-    rows = np.arange(height, dtype=np.float64)                 # [H]
-    _, v_edge = uv(cx[:, None, None], rows[None, :, None])     # [2, H, P]
-    v_lo = v_edge.min(axis=0).T                                # [P, H]
-    v_hi = v_edge.max(axis=0).T
-    vs = v_edge.reshape(2, n_strips, STRIP, p)[:, :, [0, STRIP - 1]]
-    vmin_strip = np.where(np.isfinite(vs), vs, 0.0).min(axis=(0, 2)).T
-  ymin = np.clip(np.floor(vmin_strip).astype(np.int64) - 1, 0,
-                 height - BAND) // 8 * 8                       # [P, S]
-  if not separable:
-    ymin = np.repeat(ymin, STRIP, axis=1)                      # [P, H]
+  oy = (np.arange(n_strips, dtype=np.float64)[:, None] * STRIP
+        + np.array([0.0, STRIP - 1.0]))                      # [S, 2]
+  v_c = ((h[:, 1, 1] * oy[..., None] + h[:, 1, 2])
+         / h[:, 2, 2]).transpose(2, 0, 1)                    # [P, S, 2]
+  v_c = np.where(np.isfinite(v_c), v_c, 0.0)
+  v_lo, v_hi = v_c.min(axis=2), v_c.max(axis=2)              # [P, S]
+  ymin = np.clip(np.floor(v_lo).astype(np.int64) - 1, 0,
+                 height - BAND) // 8 * 8                     # [P, S]
   q_lo = np.maximum(np.floor(v_lo), 0)
   q_hi = np.minimum(np.floor(v_hi) + 1, height - 1)
   # A row is tap-free only when every v is <= -1 or >= H: the boundary taps
@@ -679,125 +628,132 @@ def fits_envelope(homs, height: int, width: int,
   if not v_ok.all():
     return False
 
-  # --- horizontal: per row and 128-column chunk, all in-image taps must fit
-  # the window union [w0, w0 + n_win*WIN) ∩ [0, width) (chunk-edge extrema).
-  # Separable fast path: u is row-independent — O(P*C) instead of O(P*C*H).
-  if separable:
-    x_lo, x_hi = _sep_tap_extents(h, width)                    # [P, C]
-  else:
-    n_chunks = width // CHUNK
-    ox_edges = (np.arange(n_chunks, dtype=np.float64)[:, None] * CHUNK
-                + np.array([0.0, CHUNK - 1.0]))                # [C, 2]
-    rows = np.arange(height, dtype=np.float64)
-    u_e, _ = uv(ox_edges[:, :, None, None], rows[None, None, :, None])
-    u_e = np.moveaxis(u_e, -1, 0)                              # [P, C, 2, H]
-    u_lo = u_e.min(axis=2)                                     # [P, C, H]
-    u_hi = u_e.max(axis=2)
-    x_lo = np.floor(np.where(np.isfinite(u_lo), u_lo, 0.0)).astype(np.int64)
-    x_hi = np.floor(
-        np.where(np.isfinite(u_hi), u_hi, 0.0)).astype(np.int64) + 1
-  w0_max = width - 2 * WIN if separable else width - WIN
-  w0 = np.clip(x_lo // WIN * WIN, 0, max(w0_max, 0))
+  # --- horizontal: per 128-column chunk, all in-image taps must fit the
+  # window union [w0, w0 + n_win*WIN) ∩ [0, width) (chunk-edge extrema;
+  # u is row-independent for separable maps — O(P*C)).
+  x_lo, x_hi = _sep_tap_extents(h, width)                    # [P, C]
+  w0 = np.clip(x_lo // WIN * WIN, 0, max(width - 2 * WIN, 0))
   cover_end = np.minimum(w0 + n_win * WIN, width)
   chunk_empty = (x_hi < 0) | (x_lo > width - 1)
   u_ok = chunk_empty | (np.minimum(x_hi, width - 1) <= cover_end - 1)
   return bool(u_ok.all())
 
 
-def _plan_tiled(homs, height: int, width: int):
-  """Minimal window count (2 or 3) for the tiled general kernel, or None.
+@functools.partial(jax.jit, static_argnames=("height", "width"))
+def _plan_shared_stats(homs: jnp.ndarray, height: int, width: int):
+  """Device-side reductions behind ``_plan_shared`` (traceable, f32).
 
-  The host-side mirror of ``_tiled_tables``: every in-image bilinear tap
-  of every output pixel must land inside its tile's ``[bandg, tsrc]``
-  source rectangle, its row's ``G_SLICE`` band rows, and its row-chunk's
-  gather windows. Returns None (caller falls back to XLA) when the pose is
-  outside the kernel envelope or a homography denominator changes sign
-  over the image (poles break the edge-monotonicity both this plan and the
-  table math rely on). ``homs`` must be concrete ([P, 3, 3]).
+  Returns five scalars: denominator-one-signed, max per-column floor-span
+  of u across a strip's rows, vertical-coverage ok, and horizontal window
+  coverage ok for the 2- and 3-window variants. Runs the SAME table math
+  as ``_shared_tables`` (same helpers, same dtype), plus the per-COLUMN
+  checks the tables cannot express; per-column u/v extrema over a strip's
+  rows are evaluated at the strip's top/bottom rows — exact, because with
+  a one-signed denominator u and v are monotone in the row at a fixed
+  column. An earlier host-numpy f64 version of this took ~2 s per call at
+  1080p x 32 planes (the per-column [P, S, W] arrays); on-device it is
+  sub-millisecond and its floors see the very f32 values the tables use.
+  """
+  p = homs.shape[0]
+  h9 = homs.reshape(p, 3, 3).astype(jnp.float32)
+  cx = jnp.array([0.0, width - 1.0], jnp.float32)
+  cy = jnp.array([0.0, height - 1.0], jnp.float32)
+  d_flat = (h9[:, 2, 0, None, None] * cx[None, :, None]
+            + h9[:, 2, 1, None, None] * cy[None, None, :]
+            + h9[:, 2, 2, None, None]).reshape(p, 4)
+  den_ok = (jnp.isfinite(d_flat).all()
+            & ((d_flat > 0).all(1) | (d_flat < 0).all(1)).all())
 
-  Mirror precision: this runs in f64 while the device tables are f32, so a
-  floor() input within ~1 ulp of an integer can resolve differently. Such
+  tw, _, bandg, _ = _tile_sizes(height, width, 2)
+  n_strips = height // STRIP
+  slice_rows = min(G_SHARED, bandg)
+  mins = _corner_mins(h9, height, width, tw)
+
+  # Per-column strip extrema from the strip's top/bottom rows: [P, S, 2, W].
+  cols = jnp.arange(width, dtype=jnp.float32)
+  oyr = (jnp.arange(n_strips, dtype=jnp.float32)[:, None] * STRIP
+         + jnp.array([0.0, STRIP - 1.0])).reshape(-1)
+  u_r, v_r = _uv_vec(h9, cols[None, None, :], oyr[None, :, None])
+  u_r = u_r.reshape(p, n_strips, 2, width)
+  v_r = v_r.reshape(p, n_strips, 2, width)
+  xhat = jnp.floor(u_r.min(axis=2)).astype(jnp.int32)        # [P, S, W]
+  span = jnp.floor(u_r.max(axis=2)).astype(jnp.int32) - xhat
+  v_lo = v_r.min(axis=2)                                     # [P, S, W]
+  v_hi = v_r.max(axis=2)
+  span_max = span.max()
+
+  # Coverage comparisons run in VALUE space with tolerance TOL: f32 op
+  # reordering can wobble a per-column u/v a few ulps across the integer
+  # boundary its chunk-corner min floored at (observed: column minima one
+  # ulp below the corner value), and an integer-exact check would then
+  # spuriously reject. A tap within TOL of the boundary carries <= TOL
+  # bilinear weight, so accepting it changes the output by <= TOL — half
+  # the 1e-3 parity budget at TOL = 5e-4 (image coordinates <= ~2000 keep
+  # the f32 ulp <= ~1.2e-4 after the in-image clamps below).
+  tol = 5e-4
+  chunk_of_col = jnp.arange(width) // CHUNK
+  # Vertical coverage is n_windows-independent (any tsrc gives the same
+  # ymin/q0 formulas); evaluate it with the 2-window geometry.
+  _, _, ymin_c2, _, _, q0_2 = _table_scalars(
+      mins, height, width, tw, min(width, 640), bandg,
+      min(2, min(width, 640) // WIN))
+  ymq = ((ymin_c2 + q0_2)[:, :, chunk_of_col]).astype(jnp.float32)
+  # A column is tap-free only when every v is <= -1 or >= H: the boundary
+  # taps (row 0 for v in (-1, 0), row H-1 for v in (H-1, H)) carry weight.
+  empty_v = (v_hi <= -1) | (v_lo >= height)
+  v_ok = (empty_v | (
+      (jnp.maximum(v_lo, 0.0) >= ymq - tol)
+      & (jnp.minimum(v_hi, height - 1.0)
+         <= ymq + slice_rows - 1 + tol))).all()
+
+  # The tap fan [xhat, xhat + span + 1] covers each column's x-taps by
+  # construction; in-image taps must land in the chunk's window union.
+  u_lo = u_r.min(axis=2)                                     # [P, S, W]
+  u_hi = u_r.max(axis=2)
+  empty_h = (u_hi <= -1) | (u_lo >= width)
+  h_oks = []
+  for n_windows in (2, 3):
+    _, tsrc, _, n_eff = _tile_sizes(height, width, n_windows)
+    _, _, _, xmin_c, w0, _ = _table_scalars(
+        mins, height, width, tw, tsrc, bandg, n_eff)
+    xmw = ((xmin_c + w0)[:, :, chunk_of_col]).astype(jnp.float32)
+    h_oks.append((empty_h | (
+        (jnp.maximum(u_lo, 0.0) >= xmw - tol)
+        & (jnp.minimum(u_hi + 1.0, width - 1.0)
+           <= xmw + n_eff * WIN - 1 + tol))).all())
+  return den_ok, span_max, v_ok, h_oks[0], h_oks[1]
+
+
+def _plan_shared(homs, height: int, width: int):
+  """Static ``(n_taps, n_windows)`` for the shared-gather kernel, or None.
+
+  Thin host wrapper over the jitted ``_plan_shared_stats``: decides the
+  tap-fan width (``2 + max floor-span of u across a strip's rows``, capped
+  at 3) and the minimal window count (2 or 3) whose coverage holds, or
+  returns None (caller falls back to XLA) when the pose is outside the
+  envelope or a homography denominator changes sign over the image (poles
+  break the monotonicity the extrema rely on). ``homs`` must be concrete
+  ([P, 3, 3]).
+
+  Precision: the stats run in f32 with the same formulas (and helpers) as
+  the device tables, so plan and tables see identical values up to XLA op
+  reordering (~1 ulp). A floor() input that close to an integer can still
+  resolve differently from the kernel's in-kernel u/v evaluation; such
   divergence only ever drops a tap whose bilinear weight is the distance
   to that same integer boundary (~1e-4 on 1080p-scale coordinates), so an
   approved pose stays within the 1e-3 parity budget even on mismatch.
   """
-  h = np.asarray(homs, np.float64).reshape(-1, 3, 3)
-  p = h.shape[0]
-  cx = np.array([0.0, width - 1.0])
-  cy = np.array([0.0, height - 1.0])
-  d_flat = (h[:, 2, 0, None, None] * cx[None, :, None]
-            + h[:, 2, 1, None, None] * cy[None, None, :]
-            + h[:, 2, 2, None, None]).reshape(p, 4)
-  if not np.isfinite(d_flat).all():
+  den_ok, span_max, v_ok, h2, h3 = jax.device_get(
+      _plan_shared_stats(jnp.asarray(homs), height, width))
+  if not den_ok or not v_ok:
     return None
-  if not np.all((d_flat > 0).all(1) | (d_flat < 0).all(1)):
+  n_taps = int(span_max) + 2
+  if n_taps > 3:
     return None
-
-  tw = next(t for t in (G_TILE_W, 256, CHUNK) if width % t == 0)
-  c_t = tw // CHUNK
-  n_chunks = width // CHUNK
-  n_strips = height // STRIP
-
-  def uv(ox, oy):
-    den = (h[:, 2, 0, None, None] * ox + h[:, 2, 1, None, None] * oy
-           + h[:, 2, 2, None, None])
-    u = (h[:, 0, 0, None, None] * ox + h[:, 0, 1, None, None] * oy
-         + h[:, 0, 2, None, None]) / den
-    v = (h[:, 1, 0, None, None] * ox + h[:, 1, 1, None, None] * oy
-         + h[:, 1, 2, None, None]) / den
-    return (np.where(np.isfinite(u), u, 0.0),
-            np.where(np.isfinite(v), v, 0.0))
-
-  # Tile-corner extents -> per-tile band/slab origins (mirrors tile_origin).
-  oyc = (np.arange(n_strips, dtype=np.float64)[:, None] * STRIP
-         + np.array([0.0, STRIP - 1.0])).reshape(-1)         # [S*2]
-  oxc = (np.arange(width // tw, dtype=np.float64)[:, None] * tw
-         + np.array([0.0, tw - 1.0])).reshape(-1)            # [T*2]
-  u_c, v_c = uv(oxc[None, None, :], oyc[None, :, None])      # [P, S*2, T*2]
-  u_c = u_c.reshape(p, n_strips, 2, -1, 2)
-  v_c = v_c.reshape(p, n_strips, 2, -1, 2)
-  umin_tile = u_c.min(axis=(2, 4))                           # [P, S, T]
-  vmin_tile = v_c.min(axis=(2, 4))
-  bandg = G_BAND if height >= G_BAND else BAND
-  ymin = np.clip(np.floor(vmin_tile).astype(np.int64) - 1, 0,
-                 height - bandg) // 8 * 8                    # [P, S, T]
-
-  # Per-row chunk-boundary evals (mirrors the kernel's bu/bv scalars).
-  rows = np.arange(height, dtype=np.float64)
-  oxb = np.arange(n_chunks + 1, dtype=np.float64) * CHUNK
-  u_b, v_b = uv(oxb[None, None, :], rows[None, :, None])     # [P, H, B]
-  x_lo = np.floor(np.minimum(u_b[..., :-1], u_b[..., 1:])).astype(np.int64)
-  x_hi = np.floor(np.maximum(u_b[..., :-1], u_b[..., 1:])).astype(np.int64) + 1
-  v_lo = np.minimum(v_b[..., :-1], v_b[..., 1:])             # [P, H, C]
-  v_hi = np.maximum(v_b[..., :-1], v_b[..., 1:])
-
-  # Chunk ci belongs to tile ci // c_t; row r to strip r // STRIP.
-  tile_of_chunk = np.arange(n_chunks) // c_t
-  ymin_rc = np.repeat(ymin, STRIP, axis=1)[:, :, tile_of_chunk]  # [P, H, C]
-
-  q0 = np.clip((np.floor(v_lo).astype(np.int64) - ymin_rc) // 8 * 8,
-               0, bandg - G_SLICE)
-  q_lo = np.maximum(np.floor(v_lo), 0)
-  q_hi = np.minimum(np.floor(v_hi) + 1, height - 1)
-  empty_v = (v_hi <= -1) | (v_lo >= height)
-  v_ok = empty_v | ((q_lo >= ymin_rc + q0)
-                    & (q_hi <= ymin_rc + q0 + G_SLICE - 1))
-  if not v_ok.all():
-    return None
-
-  empty_h = (x_hi < 0) | (x_lo > width - 1)
-  for n_windows in (2, 3):
-    tsrc = min(width, 640 if n_windows == 2 else 1024)
-    n_eff = min(n_windows, tsrc // WIN)
-    xmin = np.clip(np.floor(umin_tile).astype(np.int64), 0,
-                   width - tsrc) // WIN * WIN                # [P, S, T]
-    xmin_rc = np.repeat(xmin, STRIP, axis=1)[:, :, tile_of_chunk]
-    w0 = np.clip((x_lo - xmin_rc) // WIN * WIN, 0, tsrc - n_eff * WIN)
-    h_ok = empty_h | (
-        (np.maximum(x_lo, 0) >= xmin_rc)
-        & (np.minimum(x_hi, width - 1) <= xmin_rc + w0 + n_eff * WIN - 1))
-    if h_ok.all():
-      return n_windows
+  if h2:
+    return n_taps, 2
+  if h3:
+    return n_taps, 3
   return None
 
 
@@ -824,6 +780,7 @@ def _sep_tap_extents(h, width: int):
 def _fused_call(planes: jnp.ndarray, homs: jnp.ndarray,
                 separable: bool, n_windows: int,
                 interpret: bool) -> jnp.ndarray:
+  assert separable, "general homographies go through _shared_call"
   num_planes, _, height, width = planes.shape
   if height % STRIP or width % CHUNK:
     raise ValueError(
@@ -831,17 +788,12 @@ def _fused_call(planes: jnp.ndarray, homs: jnp.ndarray,
         f"{height}x{width} (pad the MPI, or use an XLA method)")
   if height < BAND:
     raise ValueError(f"H must be >= {BAND}, got {height}")
-  if separable and width < 2 * WIN:
+  if width < 2 * WIN:
     raise ValueError(f"separable path needs W >= {2 * WIN}, got {width}")
-  if separable:
-    kernel = functools.partial(
-        _separable_kernel, num_planes=num_planes, height=height, width=width,
-        n_windows=min(n_windows, width // WIN))
-    band_shape, sems = (2, 4, BAND, width), pltpu.SemaphoreType.DMA((2,))
-  else:
-    kernel = functools.partial(
-        _render_kernel, num_planes=num_planes, height=height, width=width)
-    band_shape, sems = (4, BAND, width), pltpu.SemaphoreType.DMA
+  kernel = functools.partial(
+      _separable_kernel, num_planes=num_planes, height=height, width=width,
+      n_windows=min(n_windows, width // WIN))
+  band_shape, sems = (2, 4, BAND, width), pltpu.SemaphoreType.DMA((2,))
   return pl.pallas_call(
       kernel,
       grid=(height // STRIP, num_planes),
@@ -878,11 +830,11 @@ def reference_render(planes: jnp.ndarray, homs: jnp.ndarray) -> jnp.ndarray:
   return jnp.moveaxis(out[0], -1, 0)
 
 
-def _make_fused(separable: bool, n_windows: int):
+def _make_fused(n_windows: int):
 
   @jax.custom_vjp
   def fused(planes, homs):
-    return _fused_call(planes, homs, separable, n_windows,
+    return _fused_call(planes, homs, True, n_windows,
                        jax.default_backend() != "tpu")
 
   def fwd(planes, homs):
@@ -897,30 +849,29 @@ def _make_fused(separable: bool, n_windows: int):
   return fused
 
 
-_FUSED = {(sep, n): _make_fused(sep, n)
-          for sep, n in ((False, 2), (True, 2), (True, SEP_WINDOWS))}
+_FUSED = {n: _make_fused(n) for n in (2, SEP_WINDOWS)}
 
 
-def _make_tiled(n_windows: int):
+def _make_shared(n_taps: int, n_windows: int):
 
   @jax.custom_vjp
-  def tiled(planes, homs):
-    return _tiled_call(planes, homs, n_windows,
-                       jax.default_backend() != "tpu")
+  def shared(planes, homs):
+    return _shared_call(planes, homs, n_taps, n_windows,
+                        jax.default_backend() != "tpu")
 
   def fwd(planes, homs):
-    return tiled(planes, homs), (planes, homs)
+    return shared(planes, homs), (planes, homs)
 
   def bwd(res, g):
     planes, homs = res
     _, vjp = jax.vjp(reference_render, planes, homs)
     return vjp(g)
 
-  tiled.defvjp(fwd, bwd)
-  return tiled
+  shared.defvjp(fwd, bwd)
+  return shared
 
 
-_TILED = {n: _make_tiled(n) for n in (2, 3)}
+_SHARED = {(tt, n): _make_shared(tt, n) for tt in (2, 3) for n in (2, 3)}
 
 # Jitted fallback: the eager reference path materializes per-op temporaries
 # (several GB at 1080p x 32 planes); under jit XLA schedules them.
@@ -950,45 +901,62 @@ def render_mpi_fused(planes: jnp.ndarray, homs: jnp.ndarray,
     planes: ``[P, 4, H, W]`` planar RGBA MPI, back-to-front.
     homs: ``[P, 3, 3]`` target-pixel -> source-pixel homographies
       (``pixel_homographies(...)[:, b]`` for batch entry b).
-    separable: static flag selecting the shared-gather fast path; only valid
+    separable: static flag selecting the separable fast path; only valid
       when ``is_separable(homs)`` (axis-aligned warps, e.g. any pure camera
       translation/zoom). The result is identical either way; the fast path
-      is ~10x quicker.
-    check: when ``homs`` is concrete (not a jit tracer), verify the kernel's
-      coverage envelope with ``fits_envelope`` and transparently fall back
-      to the XLA ``reference_render`` path if the pose is outside it, so
-      out-of-envelope poses return correct pixels instead of silently
-      dropping taps. The separable check is O(P·(S+C)) host math —
-      microseconds against a ~30 ms 1080p render. The separable gather-
-      window count is also auto-tuned from the concrete homographies
-      (2 when the pose provably needs no third window — any horizontal
-      scale <= 1.0, the usual novel-view case — else 3). Under jit the
-      homographies are tracers: no check is possible, the separable path
-      conservatively uses 3 windows, and callers jitting over poses own the
-      envelope (or should use an XLA method).
+      is ~4x quicker than the shared-gather general kernel.
+    check: when True (the default) and ``homs`` is concrete, verify the
+      kernel's coverage envelope (``fits_envelope`` / ``_plan_shared``)
+      and transparently fall back to the XLA ``reference_render`` path if
+      the pose is outside it, so out-of-envelope poses return correct
+      pixels instead of silently dropping taps — microseconds of host math
+      against a ~30 ms 1080p render. The check also statically tunes the
+      gather-window count (and, on the general path, the tap-fan width)
+      from the concrete homographies. Under jit the homographies are
+      tracers and NO check is possible, so ``check=True`` raises: pass
+      ``check=False`` to run the Pallas kernel with conservative static
+      parameters — you then own the envelope (verify representative poses
+      eagerly with ``fits_envelope`` first) — or jit an XLA method
+      (``core.render.render_mpi(method='scan'|'fused')``) instead. No code
+      path renders unchecked taps by default.
 
   Returns:
     ``[3, H, W]`` rendered view, float32.
   """
   _, _, height, width = planes.shape
-  shapes_ok = not (height % STRIP or width % CHUNK) and height >= BAND
+  if height % STRIP or width % CHUNK:
+    raise ValueError(
+        f"H must be a multiple of {STRIP} and W of {CHUNK}; got "
+        f"{height}x{width} (pad the MPI, or use an XLA method)")
+  if height < BAND:
+    raise ValueError(f"H must be >= {BAND}, got {height}")
   homs_concrete = not isinstance(homs, jax.core.Tracer)
+  if check and not homs_concrete:
+    raise ValueError(
+        "render_mpi_fused(check=True) needs concrete homographies; under "
+        "jit pass check=False (you own the coverage envelope — verify "
+        "representative poses with fits_envelope eagerly first) or use an "
+        "XLA method (core.render.render_mpi(method='scan'|'fused')).")
   if separable:
+    if check and not is_separable(homs):
+      raise ValueError(
+          "separable=True but the homographies are not separable "
+          "(is_separable(homs) is False); the separable kernel would "
+          "silently render wrong pixels. Pass separable=False (the "
+          "shared-gather general kernel) or fix the pose.")
     n_windows = SEP_WINDOWS
-    if homs_concrete and shapes_ok:
+    if homs_concrete:
       n_windows = _sep_windows_needed(homs, height, width)
-    if (check and homs_concrete and shapes_ok
-        and not fits_envelope(homs, height, width, True)):
+    if check and not fits_envelope(homs, height, width, True):
       return _reference_render_jit(planes, homs)
-    return _FUSED[True, n_windows](planes, homs)
+    return _FUSED[n_windows](planes, homs)
 
-  # General path: rotations go through the tiled kernel, planned eagerly
-  # (per-tile origins + window counts mirrored from concrete homographies).
-  if check and homs_concrete and shapes_ok:
-    plan = _plan_tiled(homs, height, width)
+  # General path: the shared-gather kernel, planned eagerly (tap fan +
+  # window count mirrored from concrete homographies); traced opt-in calls
+  # get the conservative static maximum (3 taps, 3 windows).
+  if check:
+    plan = _plan_shared(homs, height, width)
     if plan is None:
       return _reference_render_jit(planes, homs)
-    return _TILED[plan](planes, homs)
-  # Traced or opted-out general calls keep the legacy strip kernel (tiny
-  # rotation envelope; callers own it via fits_envelope).
-  return _FUSED[False, 2](planes, homs)
+    return _SHARED[plan](planes, homs)
+  return _SHARED[3, 3](planes, homs)

@@ -151,16 +151,19 @@ class TestFusedKernel:
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(want), atol=1e-4, rtol=0)
 
-  def test_general_wide_scale_window_coverage(self, rng):
-    """Horizontal scale 2.5 (general path) needs the fourth window."""
+  def test_general_wide_scale_falls_back(self, rng):
+    """Horizontal scale 2.5 exceeds the shared kernel's window coverage.
+
+    A chunk's taps span ~320 source columns; with worst-case 128-alignment
+    the 3-window union cannot cover them, so the plan must reject and the
+    checked call must return exact XLA output instead of dropping taps."""
     p, h, w = 2, 24, 768
     planes = _mpi(rng, p, h, w)
-    # Chunk 1's x_lo = 330 (mod 128 = 74), taps reach 648, past the
-    # three-window coverage end 640.
     hom = np.array([[2.5, 0.01, 10.0], [0.01, 1, 2.0], [0, 0, 1]], np.float32)
     homs = jnp.asarray(np.broadcast_to(hom, (p, 3, 3)))
-    assert rp.fits_envelope(homs, h, w, separable=False)
-    got = rp.render_mpi_fused(planes, homs, separable=False, check=False)
+    assert rp._plan_shared(homs, h, w) is None
+    assert not rp.fits_envelope(homs, h, w, separable=False)
+    got = rp.render_mpi_fused(planes, homs, separable=False)
     want = rp.reference_render(planes, homs)
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(want), atol=1e-4, rtol=0)
@@ -194,7 +197,7 @@ class TestFusedKernel:
                    np.float32)
     homs = jnp.asarray(np.broadcast_to(hom, (p, 3, 3)))
     assert not rp.fits_envelope(homs, h, w, separable=False)
-    assert rp._plan_tiled(homs, h, w) is None
+    assert rp._plan_shared(homs, h, w) is None
     got = rp.render_mpi_fused(planes, homs, separable=False)
     want = rp.reference_render(planes, homs)
     np.testing.assert_allclose(
@@ -221,13 +224,14 @@ class TestFusedKernel:
         np.asarray(g_fused), np.asarray(g_ref), atol=1e-4, rtol=0)
 
 
-class TestTiledKernel:
-  """The 2-D-tile general path: rotations beyond the strip-band envelope."""
+class TestSharedKernel:
+  """The shared-gather general path: rotations, tiled 2-D output blocks."""
 
   @pytest.mark.parametrize("pose_kw,hw", [
       (ROTATION, (48, 384)),
       (dict(rx=0.03, ry=0.03, tx=0.05), (48, 384)),     # ~1.7 deg rotation
       (dict(rx=-0.02, ry=0.035, tz=-0.04), (40, 768)),  # two tiles wide
+      (dict(ry=0.0175), (64, 384)),                     # pure 1-deg yaw pan
       (TRANSLATION, (32, 256)),
   ])
   def test_parity_vs_reference(self, rng, pose_kw, hw):
@@ -237,9 +241,9 @@ class TestTiledKernel:
     depths = inv_depths(1.0, 100.0, p)
     homs = rp.pixel_homographies(
         _pose(**pose_kw), depths, _intrinsics(h, w), h, w)[:, 0]
-    plan = rp._plan_tiled(homs, h, w)
+    plan = rp._plan_shared(homs, h, w)
     assert plan is not None
-    got = rp._TILED[plan](planes, homs)
+    got = rp._SHARED[plan](planes, homs)
     want = rp.reference_render(planes, homs)
     # f32 tap coordinates can round across a bilinear boundary differently
     # than the oracle's float path on isolated pixels (<= ~2e-4 on a unit-
@@ -247,31 +251,113 @@ class TestTiledKernel:
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(want), atol=2e-4, rtol=0)
 
+  def test_yaw_pan_uses_two_tap_fan(self):
+    """A pure yaw pan has h01 = h21 = 0: u is row-independent, so the
+    strip-shared tap fan needs only the 2 bilinear taps."""
+    h, w = 64, 384
+    depths = inv_depths(1.0, 100.0, 3)
+    homs = rp.pixel_homographies(
+        _pose(ry=0.0175), depths, _intrinsics(h, w), h, w)[:, 0]
+    plan = rp._plan_shared(homs, h, w)
+    assert plan is not None and plan[0] == 2
+
   def test_plan_window_escalation(self, rng):
-    """Horizontal scale ~1.5 needs the 3-window tiled variant."""
+    """Horizontal scale ~1.5 needs the 3-window variant."""
     p, h, w = 2, 32, 768
     planes = _mpi(rng, p, h, w)
     hom = np.array([[1.5, 0.005, 20.0], [0.005, 1, 2.0], [0, 0, 1]],
                    np.float32)
     homs = jnp.asarray(np.broadcast_to(hom, (p, 3, 3)))
-    plan = rp._plan_tiled(homs, h, w)
-    assert plan == 3
-    got = rp._TILED[plan](planes, homs)
+    plan = rp._plan_shared(homs, h, w)
+    assert plan is not None and plan[1] == 3
+    got = rp._SHARED[plan](planes, homs)
     want = rp.reference_render(planes, homs)
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(want), atol=1e-4, rtol=0)
 
-  def test_gradients_through_tiled_vjp(self, rng):
+  def test_gradients_through_shared_vjp(self, rng):
     p, h, w = 2, 32, 256
     planes = _mpi(rng, p, h, w)
     depths = inv_depths(1.0, 100.0, p)
     homs = rp.pixel_homographies(
         _pose(**ROTATION), depths, _intrinsics(h, w), h, w)[:, 0]
-    g_tiled = jax.grad(
+    g_shared = jax.grad(
         lambda x: rp.render_mpi_fused(x, homs, separable=False).sum())(planes)
     g_ref = jax.grad(lambda x: rp.reference_render(x, homs).sum())(planes)
     np.testing.assert_allclose(
-        np.asarray(g_tiled), np.asarray(g_ref), atol=1e-4, rtol=0)
+        np.asarray(g_shared), np.asarray(g_ref), atol=1e-4, rtol=0)
+
+  def test_traced_checked_call_raises(self, rng):
+    """Under jit no envelope check can run: check=True must raise, never
+    silently render unchecked taps (the round-2 silent-wrong-pixels bug)."""
+    p, h, w = 2, 24, 256
+    planes = _mpi(rng, p, h, w)
+    depths = inv_depths(1.0, 100.0, p)
+
+    def render(pose):
+      homs = rp.pixel_homographies(
+          pose, depths, _intrinsics(h, w), h, w)[:, 0]
+      return rp.render_mpi_fused(planes, homs)
+
+    with pytest.raises(ValueError, match="concrete homographies"):
+      jax.jit(render)(_pose(**ROTATION))
+
+  def test_traced_unchecked_optin_matches_oracle(self, rng):
+    """check=False under jit runs the conservative (3, 3) shared kernel;
+    for an in-envelope pose it must match the oracle exactly."""
+    p, h, w = 2, 24, 256
+    planes = _mpi(rng, p, h, w)
+    depths = inv_depths(1.0, 100.0, p)
+
+    def render(pose):
+      homs = rp.pixel_homographies(
+          pose, depths, _intrinsics(h, w), h, w)[:, 0]
+      return rp.render_mpi_fused(planes, homs, check=False)
+
+    got = jax.jit(render)(_pose(**ROTATION))
+    homs = rp.pixel_homographies(
+        _pose(**ROTATION), depths, _intrinsics(h, w), h, w)[:, 0]
+    want = rp.reference_render(planes, homs)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=2e-4, rtol=0)
+
+  def test_property_random_poses_accepted_match_rejected_fallback(self, rng):
+    """Property sweep (VERDICT r2 item 5): for random poses, plan-accepted
+    => shared kernel output matches the oracle within the parity budget;
+    plan-rejected => the public entry point still matches (XLA fallback).
+    Either way no pose may render dropped-tap partial sums."""
+    p, h, w = 2, 32, 256
+    depths = inv_depths(1.0, 100.0, p)
+    accepted = rejected = 0
+    # 36 random modest poses + 4 extreme ones (large tilt/yaw) that must
+    # overflow the band/window coverage and exercise the rejection side.
+    extremes = [dict(rx=0.35), dict(ry=-0.5), dict(rx=-0.3, ry=0.3),
+                dict(rx=0.2, tz=0.9)]
+    for i in range(40):
+      r = np.random.default_rng(1000 + i)
+      planes = _mpi(r, p, h, w)
+      if i < len(extremes):
+        kw = extremes[i]
+      else:
+        kw = dict(
+            tx=float(r.uniform(-0.3, 0.3)), ty=float(r.uniform(-0.2, 0.2)),
+            tz=float(r.uniform(-0.3, 0.3)), rx=float(r.uniform(-0.08, 0.08)),
+            ry=float(r.uniform(-0.08, 0.08)))
+      homs = rp.pixel_homographies(
+          _pose(**kw), depths, _intrinsics(h, w), h, w)[:, 0]
+      plan = rp._plan_shared(homs, h, w)
+      want = np.asarray(rp.reference_render(planes, homs))
+      if plan is not None:
+        accepted += 1
+        got = np.asarray(rp._SHARED[plan](planes, homs))
+      else:
+        rejected += 1
+        got = np.asarray(rp.render_mpi_fused(planes, homs, separable=False))
+      np.testing.assert_allclose(got, want, atol=1e-3, rtol=0,
+                                 err_msg=f"pose {kw}, plan {plan}")
+    # The sweep must exercise both sides of the envelope.
+    assert accepted >= 5, f"only {accepted}/40 poses accepted"
+    assert rejected >= 1, f"no pose rejected; widen the sweep"
 
 
 class TestRenderMpiIntegration:

@@ -583,15 +583,16 @@ def fits_envelope(homs, height: int, width: int,
   delegates to ``_plan_shared`` (the shared-gather kernel's envelope).
   ``homs`` must be concrete ([P, 3, 3]).
   """
-  h = np.asarray(homs, np.float64).reshape(-1, 3, 3)
-  if separable is None:
+  auto = separable is None
+  if auto:
     separable = is_separable(homs)
   if not separable:
     return _plan_shared(homs, height, width) is not None
-  if not is_separable(homs):
+  if not auto and not is_separable(homs):
     # A caller-asserted separable flag on non-separable homographies is a
     # contract violation; reject so checked callers fall back safely.
     return False
+  h = np.asarray(homs, np.float64).reshape(-1, 3, 3)
   n_win = SEP_WINDOWS
   p = h.shape[0]
 
@@ -676,10 +677,21 @@ def _plan_shared_stats(homs: jnp.ndarray, height: int, width: int):
   u_r, v_r = _uv_vec(h9, cols[None, None, :], oyr[None, :, None])
   u_r = u_r.reshape(p, n_strips, 2, width)
   v_r = v_r.reshape(p, n_strips, 2, width)
-  xhat = jnp.floor(u_r.min(axis=2)).astype(jnp.int32)        # [P, S, W]
-  span = jnp.floor(u_r.max(axis=2)).astype(jnp.int32) - xhat
   v_lo = v_r.min(axis=2)                                     # [P, S, W]
   v_hi = v_r.max(axis=2)
+  # Tap-fan span with TOL slack on BOTH floors: the kernel recomputes the
+  # fan origin floor(min_r u) in Mosaic f32, which can resolve one lower
+  # than this XLA f32 evaluation when min u sits within an ulp of an
+  # integer — shifting the whole fan down and dropping the FAR-end tap,
+  # whose bilinear weight is frac(u_max), i.e. arbitrarily large. Widening
+  # the span whenever either extreme is within TOL of an integer makes the
+  # fan cover both floor resolutions (near-boundary poses may escalate to
+  # the 3-tap variant or the XLA fallback — correctness over speed).
+  tol = 5e-4
+  u_lo = u_r.min(axis=2)                                     # [P, S, W]
+  u_hi = u_r.max(axis=2)
+  span = (jnp.floor(u_hi + tol).astype(jnp.int32)
+          - jnp.floor(u_lo - tol).astype(jnp.int32))
   span_max = span.max()
 
   # Coverage comparisons run in VALUE space with tolerance TOL: f32 op
@@ -775,12 +787,11 @@ def _sep_tap_extents(h, width: int):
   return x_lo, x_hi
 
 
-@functools.partial(
-    jax.jit, static_argnames=("separable", "n_windows", "interpret"))
-def _fused_call(planes: jnp.ndarray, homs: jnp.ndarray,
-                separable: bool, n_windows: int,
+@functools.partial(jax.jit, static_argnames=("n_windows", "interpret"))
+def _fused_call(planes: jnp.ndarray, homs: jnp.ndarray, n_windows: int,
                 interpret: bool) -> jnp.ndarray:
-  assert separable, "general homographies go through _shared_call"
+  """Separable-path kernel call; general homographies go through
+  ``_shared_call``."""
   num_planes, _, height, width = planes.shape
   if height % STRIP or width % CHUNK:
     raise ValueError(
@@ -834,7 +845,7 @@ def _make_fused(n_windows: int):
 
   @jax.custom_vjp
   def fused(planes, homs):
-    return _fused_call(planes, homs, True, n_windows,
+    return _fused_call(planes, homs, n_windows,
                        jax.default_backend() != "tpu")
 
   def fwd(planes, homs):

@@ -1,0 +1,74 @@
+"""Torch mirror of the training losses, for scalar/trajectory parity tests.
+
+Mirrors ``VGGPerceptualLoss`` (fast-torch-stereo-vision.ipynb cell 12): the
+novel view is rendered through the oracle MPI path (the renderer sits inside
+the backward pass — SURVEY.md §1), both images are ImageNet-normalized (the
+constants applied DIRECTLY to [-1, 1] images, the reference quirk the
+published loss curve depends on), optionally resized to 224 with bilinear
+half-pixel semantics (cell 12:48-52), and compared with a pixel L1 plus the
+four VGG16 feature-block L1s weighted ``1/(1+i)`` (cell 12:55-59).
+
+Unlike ``torchref.vgg.extract_features`` (a ``no_grad`` helper for weight-
+transfer tests), the tap extraction here keeps gradients: training parity
+needs d(loss)/d(net output) to flow through the frozen features exactly as
+in the reference.
+"""
+
+from __future__ import annotations
+
+import torch
+import torch.nn.functional as F
+
+from mpi_vision_tpu.torchref import model as torch_model
+from mpi_vision_tpu.torchref import oracle
+from mpi_vision_tpu.torchref.vgg import _TAP_LAYERS
+from mpi_vision_tpu.train.vgg import IMAGENET_MEAN, IMAGENET_STD
+
+
+def render_novel_view(mpi_pred: torch.Tensor, batch) -> torch.Tensor:
+  """NCHW net output -> MPI -> rendered target view ``[B, H, W, 3]``
+  (cell 12:38-42)."""
+  rgba = torch_model.mpi_from_net_output(mpi_pred, batch["ref_img"])
+  rel_pose = batch["tgt_img_cfw"] @ batch["ref_img_wfc"]
+  planes = batch["mpi_planes"]
+  if planes.dim() == 2:            # collated [B, P]: reference takes [0]
+    planes = planes[0]
+  return oracle.render_mpi(rgba, rel_pose, planes, batch["intrinsics"])
+
+
+def l2_render_loss(mpi_pred: torch.Tensor, batch) -> torch.Tensor:
+  """The reference's ``test_loss`` metric (cell 12:3-15)."""
+  out = render_novel_view(mpi_pred, batch)
+  return ((out - batch["tgt_img"]) ** 2).mean()
+
+
+def _taps_with_grad(features: torch.nn.Sequential,
+                    x: torch.Tensor) -> list[torch.Tensor]:
+  taps = []
+  for i, layer in enumerate(features):
+    x = layer(x)
+    if i in _TAP_LAYERS:
+      taps.append(x)
+  return taps
+
+
+def vgg_perceptual_loss(mpi_pred: torch.Tensor, batch,
+                        features: torch.nn.Sequential,
+                        resize: int | None = 224) -> torch.Tensor:
+  """The reference training loss (cell 12:17-60), torch side."""
+  out = render_novel_view(mpi_pred, batch)    # [B, H, W, 3]
+  tgt = batch["tgt_img"]
+  mean = torch.as_tensor(IMAGENET_MEAN)
+  std = torch.as_tensor(IMAGENET_STD)
+  x = ((out - mean) / std).permute(0, 3, 1, 2)
+  y = ((tgt - mean) / std).permute(0, 3, 1, 2)
+  if resize is not None and (x.shape[-2] != resize or x.shape[-1] != resize):
+    x = F.interpolate(x, (resize, resize), mode="bilinear",
+                      align_corners=False)
+    y = F.interpolate(y, (resize, resize), mode="bilinear",
+                      align_corners=False)
+  loss = (x - y).abs().mean()                 # cell 12:54
+  for i, (fx, fy) in enumerate(
+      zip(_taps_with_grad(features, x), _taps_with_grad(features, y))):
+    loss = loss + (fx - fy).abs().mean() / (1.0 + i)   # cell 12:55-59
+  return loss

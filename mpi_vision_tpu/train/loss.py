@@ -10,8 +10,10 @@ compares to the target photo, so the renderer sits inside the backward pass.
   * ``vgg_perceptual_loss`` — the training loss (cell 12:17-60): L1 on
     pixels + L1 on four VGG16 feature blocks weighted ``1/(1+i)``, after
     ImageNet normalization and optional bilinear resize to 224 (jax.image
-    'linear' == torch ``interpolate(align_corners=False)`` half-pixel
-    semantics).
+    'linear' with ``antialias=False`` == torch
+    ``interpolate(align_corners=False)`` half-pixel semantics; scalar
+    parity with the torch mirror is tested to <= 1e-4 in
+    tests/test_train.py).
 
 Batch dict keys follow the reference dataset contract (cell 8:77-87):
 ``tgt_img_cfw`` [B,4,4] world->target-cam, ``ref_img_wfc`` [B,4,4]
@@ -75,9 +77,13 @@ def vgg_perceptual_loss(
   x = vgg.imagenet_normalize(out)
   y = vgg.imagenet_normalize(tgt)
   if resize is not None and (x.shape[1] != resize or x.shape[2] != resize):
+    # antialias=False: torch's F.interpolate(bilinear, align_corners=False)
+    # — the reference's resize (cell 12:50-52) — never antialiases, while
+    # jax.image.resize defaults to antialiasing on downscale (0.38 loss-
+    # value divergence measured at 32->24 before this was pinned).
     shape = (x.shape[0], resize, resize, x.shape[3])
-    x = jax.image.resize(x, shape, "linear")
-    y = jax.image.resize(y, shape, "linear")
+    x = jax.image.resize(x, shape, "linear", antialias=False)
+    y = jax.image.resize(y, shape, "linear", antialias=False)
 
   loss = jnp.mean(jnp.abs(x - y))                           # cell 12:54
   feats_x = vgg.VGG16Features().apply(vgg_params, x)

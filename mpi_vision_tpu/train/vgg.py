@@ -8,10 +8,14 @@ relu2_2, relu3_3 and relu4_3. This module reproduces exactly those taps.
 Pretrained weights: this environment has no torchvision model zoo and no
 network egress, so there is no baked-in ImageNet checkpoint. The supported
 flows are (a) ``params_from_torch_state`` — transfer a torchvision-format
-``state_dict`` (tensors or arrays, e.g. from an ``.npz``) once and save it
-with orbax; (b) ``init_params`` — deterministic He-style random features,
-which still yield a usable (if weaker) perceptual metric and keep every test
-hermetic. The torch mirror for parity tests lives in ``torchref/vgg.py``.
+``state_dict`` (tensors or arrays, e.g. from an ``.npz``) once, persist it
+with ``save_params`` (orbax), and point ``MPI_VISION_VGG16_CKPT`` at the
+directory — ``default_params`` then resolves it automatically; (b) the
+``default_params`` fallback — deterministic He-style random features
+(``init_params(0)``), which still yield a usable (if weaker) perceptual
+metric and keep every test hermetic. The torch mirror for parity tests
+lives in ``torchref/vgg.py``; ``state_dict_from_params`` maps back to it so
+both loss stacks can share weights (see bench/train_parity.py).
 """
 
 from __future__ import annotations
@@ -100,6 +104,63 @@ def params_from_torch_state(state: dict[str, Any]):
         "bias": get(torch_i, "bias"),
     }
   return {"params": params}
+
+
+def state_dict_from_params(params) -> dict[str, Any]:
+  """Inverse of ``params_from_torch_state``: flax params -> torchvision-style
+  ``{i}.weight/bias`` numpy state dict (for the torch mirror in
+  ``torchref/vgg.py``, e.g. to run both loss stacks with SHARED weights)."""
+  p = params["params"] if "params" in params else params
+  state = {}
+  for conv_i, torch_i in enumerate(_TORCH_CONV_INDICES):
+    leaf = p[f"conv{conv_i}"]
+    state[f"{torch_i}.weight"] = np.transpose(
+        np.asarray(leaf["kernel"]), (3, 2, 0, 1))
+    state[f"{torch_i}.bias"] = np.asarray(leaf["bias"])
+  return state
+
+
+def save_params(path: str, params) -> None:
+  """Persist VGG feature params with orbax (``path``: absolute directory).
+
+  The intended flow for REAL torchvision weights (reference cell 12:19 uses
+  ``vgg16(pretrained=True)``): on any machine with the torchvision zoo, run
+  ``save_params(path, params_from_torch_state(vgg16(pretrained=True)
+  .features.state_dict()))`` once, then ship the directory and point
+  ``MPI_VISION_VGG16_CKPT`` at it.
+  """
+  import orbax.checkpoint as ocp
+
+  with ocp.StandardCheckpointer() as ckptr:
+    ckptr.save(path, dict(params))
+
+
+def load_params(path: str):
+  """Restore params saved by ``save_params``."""
+  import orbax.checkpoint as ocp
+
+  with ocp.StandardCheckpointer() as ckptr:
+    return ckptr.restore(path)
+
+
+def default_params():
+  """The training default: a real checkpoint when available, else the
+  deterministic fallback.
+
+  Resolution order: (1) the ``MPI_VISION_VGG16_CKPT`` env var (an orbax dir
+  written by ``save_params`` — the supported route for true torchvision
+  ImageNet weights, which this zero-egress environment cannot download);
+  (2) ``init_params(0)`` — fixed He-style random features. Random VGG
+  features are a known-usable perceptual metric (random-weight VGG losses
+  train, just weaker than ImageNet features), and a FIXED seed keeps every
+  run/machine reproducible.
+  """
+  import os
+
+  path = os.environ.get("MPI_VISION_VGG16_CKPT", "")
+  if path:
+    return load_params(path)
+  return init_params(0)
 
 
 # ImageNet normalization constants (notebook cell 12, mean_const/std_const).

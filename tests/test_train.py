@@ -58,6 +58,69 @@ class TestVGGParity:
     want = (0.0 - jvgg.IMAGENET_MEAN) / jvgg.IMAGENET_STD
     np.testing.assert_allclose(got[0, 0, 0], want, atol=1e-6)
 
+  def test_state_dict_roundtrip(self):
+    """flax -> torch state dict -> flax must be the identity."""
+    params = jvgg.init_params(3)
+    back = jvgg.params_from_torch_state(jvgg.state_dict_from_params(params))
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)), params, back)
+
+  def test_save_load_default_params(self, tmp_path, monkeypatch):
+    """Orbax persistence + the MPI_VISION_VGG16_CKPT default resolution."""
+    params = jvgg.init_params(1)
+    path = str(tmp_path / "vgg16")
+    jvgg.save_params(path, params)
+    monkeypatch.setenv("MPI_VISION_VGG16_CKPT", path)
+    loaded = jvgg.default_params()
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)), params, loaded)
+    monkeypatch.delenv("MPI_VISION_VGG16_CKPT")
+    fallback = jvgg.default_params()
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)), jvgg.init_params(0), fallback)
+
+  def test_scalar_perceptual_loss_parity_with_torch(self, rng):
+    """End-to-end loss VALUE parity with shared weights (VERDICT r2 item 3):
+    net output -> MPI -> render -> normalize -> VGG taps -> weighted L1s,
+    |jax - torch| <= 1e-4."""
+    from mpi_vision_tpu.torchref import loss as torch_loss_lib
+
+    torch.manual_seed(0)
+    features = tvgg.build_features()
+    vgg_params = jvgg.params_from_torch_state(features.state_dict())
+    batch = _batch(rng)
+    p = 4
+    mpi_pred = rng.uniform(-1, 1, (1, 32, 32, 2 * p + 3)).astype(np.float32)
+
+    jax_loss = float(tloss.vgg_perceptual_loss(
+        jnp.asarray(mpi_pred), batch, vgg_params, resize=None))
+    tbatch = {k: torch.as_tensor(np.asarray(v)) for k, v in batch.items()}
+    torch_val = float(torch_loss_lib.vgg_perceptual_loss(
+        torch.from_numpy(mpi_pred).permute(0, 3, 1, 2), tbatch, features,
+        resize=None))
+    assert abs(jax_loss - torch_val) <= 1e-4, (jax_loss, torch_val)
+
+  def test_scalar_perceptual_loss_parity_resize_path(self, rng):
+    """Same, through the bilinear-resize branch (cell 12:48-52 semantics)."""
+    from mpi_vision_tpu.torchref import loss as torch_loss_lib
+
+    torch.manual_seed(1)
+    features = tvgg.build_features()
+    vgg_params = jvgg.params_from_torch_state(features.state_dict())
+    batch = _batch(rng)
+    mpi_pred = rng.uniform(-1, 1, (1, 32, 32, 11)).astype(np.float32)
+
+    jax_loss = float(tloss.vgg_perceptual_loss(
+        jnp.asarray(mpi_pred), batch, vgg_params, resize=24))
+    tbatch = {k: torch.as_tensor(np.asarray(v)) for k, v in batch.items()}
+    torch_val = float(torch_loss_lib.vgg_perceptual_loss(
+        torch.from_numpy(mpi_pred).permute(0, 3, 1, 2), tbatch, features,
+        resize=24))
+    assert abs(jax_loss - torch_val) <= 1e-4, (jax_loss, torch_val)
+
 
 class TestLosses:
 

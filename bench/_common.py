@@ -1,0 +1,47 @@
+"""Shared helpers for the BASELINE benchmark config scripts (BASELINE.md).
+
+Each config script prints ONE JSON line ``{"metric", "value", "unit",
+"vs_baseline", ...}`` on stdout (diagnostics on stderr), mirroring the
+repo-root ``bench.py`` contract. ``vs_baseline`` is oriented so that >= 1.0
+means "target met": ``value / target`` for throughput metrics (higher is
+better) and ``budget / value`` for error metrics (lower is better).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def repo_root() -> str:
+  return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def log(msg: str) -> None:
+  print(msg, file=sys.stderr, flush=True)
+
+
+def time_fn(fn, *args, iters: int = 10):
+  """(result, seconds_per_call) with a compile/warm-up call first."""
+  import jax
+
+  out = fn(*args)
+  jax.block_until_ready(out)
+  t0 = time.perf_counter()
+  for _ in range(iters):
+    out = fn(*args)
+  jax.block_until_ready(out)
+  return out, (time.perf_counter() - t0) / iters
+
+
+def emit(metric: str, value: float, unit: str, vs_baseline: float,
+         **extra) -> None:
+  print(json.dumps({
+      "metric": metric,
+      "value": round(float(value), 4),
+      "unit": unit,
+      "vs_baseline": round(float(vs_baseline), 4),
+      **extra,
+  }))

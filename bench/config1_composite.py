@@ -1,0 +1,64 @@
+"""BASELINE config 1: over-composite the scene_009 fixture MPI (10 planes,
+640x400 — the reference repo's ``test/rgba_00..09.png``) to one frontal
+view, and compare against the CPU-torch oracle.
+
+Metric: max per-pixel L1 vs torch (budget 1e-3, BASELINE.md). Also reports
+the jitted composite throughput as an extra field.
+
+Usage: python bench/config1_composite.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(1, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+from _common import emit, log, repo_root, time_fn
+
+L1_BUDGET = 1e-3
+
+
+def load_fixture_mpi() -> np.ndarray:
+  """[P, H, W, 4] float32 in [0, 1], back-to-front (index 0 = farthest,
+  matching the viewer's layer order, template:309-315)."""
+  from PIL import Image
+
+  base = os.path.join(repo_root(), "tests", "fixtures", "scene_009")
+  planes = [
+      np.asarray(Image.open(os.path.join(base, f"rgba_{i:02d}.png")),
+                 np.float32) / 255.0
+      for i in range(10)
+  ]
+  return np.stack(planes)
+
+
+def main() -> None:
+  import jax.numpy as jnp
+  import torch
+
+  from mpi_vision_tpu.core import compose
+  from mpi_vision_tpu.torchref import oracle
+
+  mpi = load_fixture_mpi()                     # [P, H, W, 4]
+  log(f"fixture MPI: {mpi.shape}")
+
+  want = oracle.over_composite(torch.from_numpy(mpi)).numpy()
+  got, sec = time_fn(
+      lambda x: compose.over_composite_scan(x[:, None])[0],
+      jnp.asarray(mpi), iters=20)
+  l1 = float(np.abs(np.asarray(got) - want).max())
+  log(f"composite: {1.0 / sec:.1f} frames/s, L1 vs torch {l1:.2e}")
+
+  emit("fixture_composite_l1_vs_torch", l1, "max_abs_diff",
+       L1_BUDGET / max(l1, 1e-12), frames_per_s=round(1.0 / sec, 2))
+  if l1 > L1_BUDGET:
+    raise SystemExit(f"L1 {l1} exceeds the {L1_BUDGET} parity budget")
+
+
+if __name__ == "__main__":
+  main()

@@ -1,0 +1,106 @@
+"""BASELINE config 4: 1080p 32-plane MPI, 64-view batch, shard_map DP mesh.
+
+Runs ``parallel.mesh.render_views_sharded`` (views sharded over the 'data'
+axis, MPI replicated, zero cross-chip traffic inside the render) with the
+fused Pallas kernel on each shard. Two modes, auto-selected by backend:
+
+  * TPU (one real chip here): a 1-device mesh times the PER-CHIP slice of
+    the config — 64 novel views at 1080p x 32 planes — and reports
+    views/s/chip (target: the 30 FPS north star per chip). A v5e-4 run is
+    this number x4, since views are embarrassingly parallel.
+  * CPU (virtual mesh, e.g. XLA_FLAGS=--xla_force_host_platform_device_count=8):
+    a dryrun at reduced size validating the sharded layout end to end
+    (also exercised by tests/test_parallel.py and __graft_entry__'s
+    multichip dryrun).
+
+The 64 poses alternate separable (truck/dolly) and small-pan views; the
+general-kernel plan is computed EAGERLY on the concrete pose set and passed
+through shard_map via the explicit plan override (inside shard_map the
+poses are tracers, so the checked path cannot run per view).
+
+Usage: python bench/config4_sharded.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(1, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+from _common import emit, log, time_fn
+
+VIEWS = 64
+TARGET_VIEWS_PER_S = 30.0
+
+
+def pan_poses(n: int) -> np.ndarray:
+  poses = []
+  for i in range(n):
+    pose = np.eye(4, dtype=np.float32)
+    ang = np.radians(1.0) * np.sin(2 * np.pi * i / n)
+    c, s = np.cos(ang), np.sin(ang)
+    pose[:3, :3] = [[c, 0, s], [0, 1, 0], [-s, 0, c]]
+    pose[0, 3] = 0.08 * np.cos(2 * np.pi * i / n)
+    pose[2, 3] = -0.05 * np.sin(2 * np.pi * i / n)
+    poses.append(pose)
+  return np.stack(poses)
+
+
+def main() -> None:
+  import jax
+  import jax.numpy as jnp
+
+  from mpi_vision_tpu.core.camera import inv_depths
+  from mpi_vision_tpu.kernels import render_pallas as rp
+  from mpi_vision_tpu.parallel import mesh as pmesh
+
+  on_tpu = jax.default_backend() == "tpu"
+  h, w, planes_n, views = (1080, 1920, 32, VIEWS) if on_tpu else (48, 256, 4, 8)
+  log(f"backend={jax.default_backend()} devices={len(jax.devices())} "
+      f"config: {views} views {h}x{w}x{planes_n}")
+
+  mesh = pmesh.make_mesh()
+  mpi = jax.jit(lambda k: jax.random.uniform(k, (h, w, planes_n, 4)))(
+      jax.random.PRNGKey(0))
+  jax.block_until_ready(mpi)
+  depths = jnp.asarray(np.asarray(inv_depths(1.0, 100.0, planes_n)))
+  k = np.array([[0.5 * w, 0, w / 2], [0, 0.5 * w, h / 2], [0, 0, 1]],
+               np.float32)
+  poses = pan_poses(views)
+
+  # Eager plan over the whole concrete pose set: the general kernel variant
+  # every shard will run (poses are tracers inside shard_map).
+  from mpi_vision_tpu.core.sampling import Convention
+  homs_all = rp.pixel_homographies(
+      jnp.asarray(poses), depths, jnp.asarray(k)[None].repeat(views, 0),
+      h, w).transpose(1, 0, 2, 3).reshape(-1, 3, 3)
+  plan = rp._plan_shared(homs_all, h, w)
+  log(f"eager plan over {views} poses: {plan}")
+  if plan is None:
+    raise SystemExit("pose set fell out of the shared-kernel envelope")
+
+  def run(mpi_, poses_):
+    return pmesh.render_views_sharded(
+        mpi_, poses_, depths, jnp.asarray(k), mesh,
+        method="fused_pallas", separable=False, check=False, plan=plan)
+
+  out, sec = time_fn(run, mpi, jnp.asarray(poses),
+                     iters=5 if on_tpu else 2)
+  vps = views / sec
+  per_chip = vps / len(jax.devices())
+  log(f"{views} views in {sec * 1e3:.1f} ms -> {vps:.2f} views/s "
+      f"({per_chip:.2f}/chip on {len(jax.devices())} devices)")
+
+  emit("mpi_render_1080p_32plane_64view_sharded_views_per_s_chip"
+       if on_tpu else "mpi_render_sharded_dryrun_views_per_s",
+       per_chip, "views/s/chip",
+       per_chip / TARGET_VIEWS_PER_S if on_tpu else 1.0,
+       views=views, devices=len(jax.devices()))
+
+
+if __name__ == "__main__":
+  main()

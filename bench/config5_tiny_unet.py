@@ -1,0 +1,105 @@
+"""BASELINE config 5: tiny per-plane RGBA predictor (DeepView-style) trained
+on a stereo pair, then inference.
+
+Trains ``models.tiny_unet.TinyPlaneUNet`` — direct per-plane RGBA
+prediction from the PSV, the DeepView-family parameterization — on ONE
+synthetic stereo pair (overfit, as the config prescribes) with the L2
+render loss, then times jitted inference (PSV -> MPI -> novel view).
+
+Metrics: inference fps (value; target 30 — the model must keep a live
+novel-view loop interactive) plus train seconds and final loss as fields.
+
+Usage: python bench/config5_tiny_unet.py [--steps 150]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(1, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+from _common import emit, log, time_fn
+
+TARGET_FPS = 30.0
+
+
+def main() -> None:
+  ap = argparse.ArgumentParser()
+  ap.add_argument("--steps", type=int, default=150)
+  ap.add_argument("--img-size", type=int, default=64)
+  ap.add_argument("--num-planes", type=int, default=8)
+  args = ap.parse_args()
+
+  import jax
+  import jax.numpy as jnp
+  import optax
+
+  from mpi_vision_tpu.core import render
+  from mpi_vision_tpu.data import realestate
+  from mpi_vision_tpu.models import tiny_unet
+
+  log(f"backend={jax.default_backend()}")
+  root = tempfile.mkdtemp(prefix="mpi_synth_")
+  realestate.synthesize_dataset(root, num_scenes=1, frames=3,
+                                img_size=args.img_size, seed=0)
+  ds = realestate.RealEstateDataset(root, img_size=args.img_size,
+                                    num_planes=args.num_planes, is_valid=True)
+  batch = next(realestate.iterate_batches(ds, shuffle=False))
+
+  model = tiny_unet.TinyPlaneUNet()
+  psv = tiny_unet.psv_from_net_input(batch["net_input"], args.num_planes)
+  params = model.init(jax.random.PRNGKey(0), psv)
+
+  def loss_fn(p, psv_, batch_):
+    mpi = model.apply(p, psv_)                       # [B, H, W, P, 4]
+    rel = batch_["tgt_img_cfw"] @ batch_["ref_img_wfc"]
+    out = render.render_mpi(mpi, rel, batch_["mpi_planes"][0],
+                            batch_["intrinsics"])
+    return jnp.mean((out - batch_["tgt_img"]) ** 2)
+
+  tx = optax.adam(1e-3)
+  opt_state = tx.init(params)
+
+  @jax.jit
+  def step(p, o, psv_, batch_):
+    loss, grads = jax.value_and_grad(loss_fn)(p, psv_, batch_)
+    updates, o = tx.update(grads, o)
+    return optax.apply_updates(p, updates), o, loss
+
+  t0 = time.time()
+  losses = []
+  for _ in range(args.steps):
+    params, opt_state, loss = step(params, opt_state, psv, batch)
+    losses.append(loss)
+  losses = [float(l) for l in jax.device_get(losses)]
+  train_s = time.time() - t0
+  log(f"train: {args.steps} steps in {train_s:.1f}s "
+      f"loss {losses[0]:.4f} -> {losses[-1]:.4f}")
+  if not losses[-1] < losses[0]:
+    raise SystemExit("tiny-UNet failed to overfit the stereo pair")
+
+  @jax.jit
+  def infer(p, psv_, batch_):
+    mpi = model.apply(p, psv_)
+    rel = batch_["tgt_img_cfw"] @ batch_["ref_img_wfc"]
+    return render.render_mpi(mpi, rel, batch_["mpi_planes"][0],
+                             batch_["intrinsics"])
+
+  _, sec = time_fn(infer, params, psv, batch, iters=20)
+  fps = 1.0 / sec
+  log(f"inference: {sec * 1e3:.2f} ms -> {fps:.1f} fps")
+  emit("tiny_unet_stereo_pair_inference_fps", fps, "frames/s",
+       fps / TARGET_FPS, train_seconds=round(train_s, 1),
+       first_loss=round(losses[0], 5), final_loss=round(losses[-1], 5),
+       steps=args.steps)
+
+
+if __name__ == "__main__":
+  main()

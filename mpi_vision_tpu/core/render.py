@@ -24,6 +24,11 @@ import jax.numpy as jnp
 from mpi_vision_tpu.core import compose, geometry, sampling
 from mpi_vision_tpu.core.sampling import Convention
 
+# "No plan supplied" marker for render_mpi's fused_pallas path; forwarded
+# plans (including a planner's None rejection) go through verbatim so
+# kernels.render_pallas.render_mpi_fused can reject None explicitly.
+_PLAN_UNSET = object()
+
 
 def plane_homographies(
     tgt_pose: jnp.ndarray,
@@ -99,6 +104,7 @@ def render_mpi(
     planes_leading: bool = False,
     separable: bool | None = None,
     check: bool = True,
+    plan: tuple[int, int] | None | object = _PLAN_UNSET,
 ) -> jnp.ndarray:
   """Render a novel view from an MPI. The reference's ``mpi_render_view_torch``.
 
@@ -125,6 +131,11 @@ def render_mpi(
       eagerly and fall back to XLA outside it (requires concrete poses;
       raises under jit). ``check=False`` opts into the unchecked kernel:
       the caller owns the envelope (see kernels/render_pallas.py).
+    plan: for 'fused_pallas' with ``check=False`` — explicit
+      ``(n_taps, n_windows)`` general-kernel variant from an eager
+      ``_plan_shared`` on representative poses. A planner ``None``
+      (pose set outside the envelope) raises rather than silently
+      running a tap-dropping kernel.
 
   Returns:
     ``[B, H, W, 3]`` rendered view.
@@ -146,8 +157,9 @@ def render_mpi(
             "check=False) or jit method='scan'/'fused' instead.")
       separable = render_pallas.is_separable(homs)
     planar = jnp.moveaxis(planes, -1, 2)                   # [P, B, 4, H, W]
+    plan_kw = {} if plan is _PLAN_UNSET else {"plan": plan}
     outs = [render_pallas.render_mpi_fused(
-        planar[:, b], homs[:, b], separable, check=check)
+        planar[:, b], homs[:, b], separable, check=check, **plan_kw)
             for b in range(planar.shape[1])]
     return jnp.stack([jnp.moveaxis(o, 0, -1) for o in outs])
 

@@ -903,9 +903,18 @@ def _sep_windows_needed(homs, height: int, width: int) -> int:
   return SEP_WINDOWS if bool(need3.any()) else 2
 
 
+# Default sentinel for render_mpi_fused's plan: distinguishes "no plan
+# supplied" (conservative kernel) from an explicit plan=None, which is what
+# _plan_shared returns for OUT-OF-ENVELOPE poses and must never silently
+# run a kernel that would drop taps.
+PLAN_UNSET = object()
+
+
 def render_mpi_fused(planes: jnp.ndarray, homs: jnp.ndarray,
                      separable: bool = False,
-                     check: bool = True) -> jnp.ndarray:
+                     check: bool = True,
+                     plan: tuple[int, int] | None | object = PLAN_UNSET
+                     ) -> jnp.ndarray:
   """Render an MPI to a novel view in one fused TPU kernel.
 
   Args:
@@ -930,6 +939,13 @@ def render_mpi_fused(planes: jnp.ndarray, homs: jnp.ndarray,
       eagerly with ``fits_envelope`` first) — or jit an XLA method
       (``core.render.render_mpi(method='scan'|'fused')``) instead. No code
       path renders unchecked taps by default.
+    plan: with ``check=False`` only — an explicit ``(n_taps, n_windows)``
+      from an eager ``_plan_shared`` call on representative poses, so
+      jitted/shard_mapped callers can run the planned general-kernel
+      variant instead of the conservative (3, 3) maximum. Passing the
+      planner's ``None`` result raises: None means the pose set is OUTSIDE
+      the envelope, and the only correct options are an XLA method or the
+      ``check=True`` fallback.
 
   Returns:
     ``[3, H, W]`` rendered view, float32.
@@ -964,10 +980,16 @@ def render_mpi_fused(planes: jnp.ndarray, homs: jnp.ndarray,
 
   # General path: the shared-gather kernel, planned eagerly (tap fan +
   # window count mirrored from concrete homographies); traced opt-in calls
-  # get the conservative static maximum (3 taps, 3 windows).
+  # get an explicit caller-supplied plan or the conservative static
+  # maximum (3 taps, 3 windows).
   if check:
     plan = _plan_shared(homs, height, width)
     if plan is None:
       return _reference_render_jit(planes, homs)
     return _SHARED[plan](planes, homs)
-  return _SHARED[3, 3](planes, homs)
+  if plan is None:
+    raise ValueError(
+        "plan=None: the planner rejected this pose set (outside the kernel "
+        "envelope) — rendering with any kernel variant would drop taps. "
+        "Use an XLA method or the check=True fallback.")
+  return _SHARED[(3, 3) if plan is PLAN_UNSET else plan](planes, homs)

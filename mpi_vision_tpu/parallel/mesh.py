@@ -60,6 +60,7 @@ def render_views_sharded(
     axis: str = "data",
     convention: Convention = Convention.REF_HOMOGRAPHY,
     method: str = "fused",
+    **render_kwargs,
 ) -> jnp.ndarray:
   """Render a batch of V target views, views sharded over a mesh axis.
 
@@ -72,6 +73,11 @@ def render_views_sharded(
     tgt_poses: ``[V, 4, 4]`` source-cam -> target-cam transforms.
     depths: ``[P]`` descending plane depths.
     intrinsics: ``[3, 3]`` shared camera intrinsics.
+    **render_kwargs: forwarded to ``core.render.render_mpi`` — for
+      ``method='fused_pallas'`` inside shard_map the poses are tracers, so
+      pass ``check=False`` with explicit ``separable`` (and optionally
+      ``plan`` from an eager ``_plan_shared`` on the concrete pose set);
+      see ``kernels.render_pallas.render_mpi_fused``.
 
   Returns:
     ``[V, H, W, 3]`` rendered views, sharded over ``axis``.
@@ -86,12 +92,16 @@ def render_views_sharded(
     vn = poses.shape[0]
     planes = jnp.broadcast_to(mpi, (vn,) + mpi.shape[1:])
     return render.render_mpi(planes, poses, depths, k.reshape(1, 3, 3).repeat(vn, 0),
-                             convention=convention, method=method)
+                             convention=convention, method=method,
+                             **render_kwargs)
 
+  # fused_pallas only: pallas_call outputs don't carry the vma metadata the
+  # checker needs (each shard's render is fully local, so nothing is lost);
+  # every XLA method keeps the replication checker on.
   fn = shard_map(
       local_render, mesh=mesh,
       in_specs=(P(), P(axis), P()),
-      out_specs=P(axis))
+      out_specs=P(axis), check_vma=(method != "fused_pallas"))
   return fn(rgba_layers[None], tgt_poses, intrinsics)
 
 

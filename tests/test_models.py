@@ -77,3 +77,78 @@ def test_mpi_assembly_blend_extremes():
   rgba = np.asarray(stereo_mag.mpi_from_net_output(jnp.asarray(pred), jnp.asarray(ref)))
   np.testing.assert_allclose(rgba[..., 0, :3], -0.25, atol=1e-6)
   np.testing.assert_allclose(rgba[..., 1, :3], 0.5, atol=1e-6)
+
+
+class TestTinyPlaneUNet:
+  """The DeepView-style direct per-plane RGBA predictor (BASELINE config 5;
+  bench/config5_tiny_unet.py is its workload)."""
+
+  def _psv(self, rng, b=1, hw=16, p=4):
+    from mpi_vision_tpu.models import tiny_unet
+    net_input = rng.uniform(-1, 1, (b, hw, hw, 3 + 3 * p)).astype(np.float32)
+    return tiny_unet.psv_from_net_input(jnp.asarray(net_input), p)
+
+  def test_psv_from_net_input_layout(self, rng):
+    from mpi_vision_tpu.models import tiny_unet
+    b, hw, p = 2, 8, 3
+    net_input = rng.uniform(-1, 1, (b, hw, hw, 3 + 3 * p)).astype(np.float32)
+    psv = tiny_unet.psv_from_net_input(jnp.asarray(net_input), p)
+    assert psv.shape == (b, hw, hw, p, 6)
+    # channels 0:3 = the PSV planes, channels 3:6 = broadcast ref image.
+    np.testing.assert_array_equal(
+        np.asarray(psv[..., 1, :3]), net_input[..., 6:9])
+    np.testing.assert_array_equal(
+        np.asarray(psv[..., 2, 3:]), net_input[..., :3])
+
+  def test_forward_shape_and_ranges(self, rng):
+    from mpi_vision_tpu.models import tiny_unet
+    model = tiny_unet.TinyPlaneUNet(width=8, mix=1)
+    psv = self._psv(rng)
+    params = model.init(jax.random.PRNGKey(0), psv)
+    mpi = model.apply(params, psv)
+    assert mpi.shape == (1, 16, 16, 4, 4)
+    out = np.asarray(mpi)
+    assert np.isfinite(out).all()
+    assert (out[..., :3] >= -1).all() and (out[..., :3] <= 1).all()  # tanh
+    assert (out[..., 3] >= 0).all() and (out[..., 3] <= 1).all()     # sigmoid
+
+  def test_overfits_render_loss(self, rng):
+    """A few Adam steps on one pair must reduce the render loss (the
+    renderer-in-the-loss design trains end to end)."""
+    import optax
+    from mpi_vision_tpu.core import render
+    from mpi_vision_tpu.core.camera import inv_depths
+    from mpi_vision_tpu.models import tiny_unet
+
+    p_n, hw = 4, 16
+    model = tiny_unet.TinyPlaneUNet(width=8, mix=1)
+    psv = self._psv(rng, hw=hw, p=p_n)
+    params = model.init(jax.random.PRNGKey(0), psv)
+    tgt = jnp.asarray(rng.uniform(-1, 1, (1, hw, hw, 3)).astype(np.float32))
+    pose = np.eye(4, dtype=np.float32)
+    pose[0, 3] = 0.03
+    pose_j = jnp.asarray(pose)[None]
+    depths = inv_depths(1.0, 100.0, p_n)
+    k = jnp.asarray(np.array(
+        [[hw / 2, 0, hw / 2], [0, hw / 2, hw / 2], [0, 0, 1]],
+        np.float32))[None]
+
+    def loss_fn(p):
+      mpi = model.apply(p, psv)
+      out = render.render_mpi(mpi, pose_j, depths, k)
+      return jnp.mean((out - tgt) ** 2)
+
+    tx = optax.adam(3e-3)
+    opt = tx.init(params)
+
+    @jax.jit
+    def step(p, o):
+      l, g = jax.value_and_grad(loss_fn)(p)
+      up, o = tx.update(g, o)
+      return optax.apply_updates(p, up), o, l
+
+    losses = []
+    for _ in range(12):
+      params, opt, l = step(params, opt)
+      losses.append(float(l))
+    assert losses[-1] < losses[0], losses

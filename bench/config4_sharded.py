@@ -84,8 +84,12 @@ def main() -> None:
     raise SystemExit("pose set fell out of the shared-kernel envelope")
 
   def run(mpi_, poses_):
+    # convention=EXACT matches the pixel_homographies call the plan was
+    # computed from (the default REF_HOMOGRAPHY would rescale differently
+    # on this non-square frame and void the envelope check).
     return pmesh.render_views_sharded(
         mpi_, poses_, depths, jnp.asarray(k), mesh,
+        convention=Convention.EXACT,
         method="fused_pallas", separable=False, check=False, plan=plan)
 
   out, sec = time_fn(run, mpi, jnp.asarray(poses),

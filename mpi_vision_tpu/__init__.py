@@ -13,10 +13,12 @@ from mpi_vision_tpu.core.camera import (
     crop_image_and_adjust_intrinsics,
     crop_to_bounding_box,
     deprocess_image,
+    depth_to_space,
     intrinsics_matrix,
     inv_depths,
     preprocess_image,
     scale_intrinsics,
+    space_to_depth,
 )
 from mpi_vision_tpu.core.compose import over_composite
 from mpi_vision_tpu.core.geometry import (
@@ -31,11 +33,13 @@ from mpi_vision_tpu.core.render import plane_homographies, render_mpi, warp_plan
 from mpi_vision_tpu.core.sampling import Convention, bilinear_sample
 from mpi_vision_tpu.core.sweep import (
     cam2pixel,
+    format_network_input,
     pixel2cam,
     plane_sweep,
     plane_sweep_one,
     projective_inverse_warp,
     projective_pixel_transform,
 )
+from mpi_vision_tpu.data.realestate import open_image, resize_with_intrinsics
 
 __version__ = "0.1.0"

@@ -1,0 +1,323 @@
+"""Reference-name compatibility surface with a ``backend`` kwarg.
+
+SURVEY.md §7's key API decision: the reference's helpers (the star-import
+surface of ``mpi_vision.utils``) are exposed under their original names with
+``backend={'jax', 'torch'}``, so notebook-style code ports by changing an
+import. ``backend='jax'`` (default) runs the TPU-native implementations on
+array-likes and returns jnp arrays; ``backend='torch'`` runs the CPU-torch
+oracle (``torchref/``) on torch tensors — the numerical spec the jax path is
+parity-tested against (<= 1e-3 L1).
+
+Reference quirks (SURVEY.md §2.8) and how this surface treats them:
+
+  * Q1 (``bilinear_wrapper_torch`` returns NCHW, contradicting its own
+    docstring): NOT reproduced — both backends return NHWC, what the
+    reference documented and its callers compensate back to
+    (utils.py:131-133, 288).
+  * Q2/Q3 (swapped x/y normalization scales): reproduced faithfully via the
+    REF_HOMOGRAPHY / REF_PROJECTION conventions inside the respective
+    pipelines — outputs match the reference bit-for-bit on its own (square)
+    inputs.
+  * Q4 (``format_network_input_torch`` stray ``self``): dropped; call
+    without the leading ``None``.
+
+Layouts follow the reference call sites: images NHWC, MPIs ``[B, H, W, P,
+4]``, plane-major stacks ``[P, B, H, W, C]``; ``SpaceToDepth`` /
+``DepthToSpace`` operate NCHW exactly like the torch modules they mirror
+(utils.py:803-820).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from mpi_vision_tpu.core import camera, compose, geometry, render, sampling, sweep
+from mpi_vision_tpu.core.sampling import Convention
+from mpi_vision_tpu.data.realestate import (  # noqa: F401  (host-side, backend-free)
+    open_image,
+    parse_camera_lines,
+    read_file_lines,
+)
+
+_BACKENDS = ("jax", "torch")
+
+
+def _check_backend(backend: str) -> bool:
+  """True for torch, False for jax; raises otherwise (import-guarded)."""
+  if backend not in _BACKENDS:
+    raise ValueError(f"backend must be one of {_BACKENDS}, got {backend!r}")
+  return backend == "torch"
+
+
+def _oracle():
+  from mpi_vision_tpu.torchref import oracle
+
+  return oracle
+
+
+# --- geometry -----------------------------------------------------------
+
+
+def meshgrid_abs_torch(batch: int, height: int, width: int,
+                       backend: str = "jax"):
+  """Homogeneous pixel grid ``[B, 3, H, W]`` (utils.py:18-33)."""
+  if _check_backend(backend):
+    return _oracle().meshgrid_abs(batch, height, width)
+  grid = geometry.homogeneous_grid(height, width)
+  return jnp.broadcast_to(grid, (batch,) + grid.shape)
+
+
+def divide_safe_torch(num, den, backend: str = "jax"):
+  """Division with the reference's eps-where-zero guard (utils.py:35-39)."""
+  if _check_backend(backend):
+    return _oracle().safe_divide(num, den)
+  return geometry.safe_divide(jnp.asarray(num), jnp.asarray(den))
+
+
+def inv_homography_torch(k_s, k_t, rot, t, n_hat, a, backend: str = "jax"):
+  """Plane-induced inverse homography ``[..., 3, 3]`` (utils.py:44-67)."""
+  if _check_backend(backend):
+    return _oracle().inverse_homography(k_s, k_t, rot, t, n_hat, a)
+  return geometry.inverse_homography(
+      jnp.asarray(k_s), jnp.asarray(k_t), jnp.asarray(rot), jnp.asarray(t),
+      jnp.asarray(n_hat), jnp.asarray(a))
+
+
+def inv_depths(start_depth, end_depth, num_depths, backend: str = "jax"):
+  """Inverse-depth-uniform plane depths, descending (utils.py:297-318)."""
+  depths = camera.inv_depths(start_depth, end_depth, num_depths)
+  if _check_backend(backend):
+    import torch
+
+    return torch.from_numpy(np.asarray(depths))
+  return depths
+
+
+def make_intrinsics_matrix(fx, fy, cx, cy, backend: str = "jax"):
+  """3x3 K from scalars (utils.py:576-581)."""
+  k = camera.intrinsics_matrix(fx, fy, cx, cy)
+  if _check_backend(backend):
+    import torch
+
+    return torch.from_numpy(np.asarray(k))
+  return k
+
+
+def scale_intrinsics(intrinsics, height, width, backend: str = "jax"):
+  """Elementwise intrinsics rescale (utils.py:535-546)."""
+  if _check_backend(backend):
+    import torch
+
+    return torch.from_numpy(np.asarray(camera.scale_intrinsics(
+        jnp.asarray(np.asarray(intrinsics)), height, width)))
+  return camera.scale_intrinsics(jnp.asarray(intrinsics), height, width)
+
+
+def preprocess_image_torch(image, backend: str = "jax"):
+  """[0, 1] -> [-1, 1] (utils.py:334-342)."""
+  if _check_backend(backend):
+    return image * 2.0 - 1.0
+  return camera.preprocess_image(jnp.asarray(image))
+
+
+def deprocess_image_torch(image, backend: str = "jax"):
+  """[-1, 1] -> uint8 [0, 255] (utils.py:344-352)."""
+  if _check_backend(backend):
+    return (((image + 1.0) / 2.0) * 255.0).to("cpu").to(
+        __import__("torch").uint8)
+  return camera.deprocess_image(jnp.asarray(image))
+
+
+# --- sampling & rendering (homography path) -----------------------------
+
+
+def resampler_wrapper_torch(imgs, coords, backend: str = "jax"):
+  """Bilinear sample NHWC images at (0, 1)-space (x, y) coords with zeros
+  padding (utils.py:395-407)."""
+  if _check_backend(backend):
+    return _oracle().grid_sample_01(imgs, coords)
+  return sampling.bilinear_sample(jnp.asarray(imgs), jnp.asarray(coords))
+
+
+def bilinear_wrapper_torch(imgs, coords, backend: str = "jax"):
+  """Same sampler as ``resampler_wrapper_torch`` — quirk Q1 (the NCHW
+  output leak, utils.py:131-133) deliberately not reproduced; output is
+  NHWC as the reference's own docstring claims."""
+  return resampler_wrapper_torch(imgs, coords, backend)
+
+
+def over_composite(rgbas, backend: str = "jax"):
+  """Back-to-front over-composite; accepts the reference's LIST of
+  ``[B, H, W, 4]`` planes or a stacked ``[P, B, H, W, 4]`` (utils.py:136-157).
+  Farthest plane's alpha ignored."""
+  if _check_backend(backend):
+    import torch
+
+    stack = torch.stack(list(rgbas)) if isinstance(rgbas, (list, tuple)) \
+        else rgbas
+    return _oracle().over_composite(stack)
+  stack = jnp.stack([jnp.asarray(r) for r in rgbas]) \
+      if isinstance(rgbas, (list, tuple)) else jnp.asarray(rgbas)
+  return compose.over_composite(stack)
+
+
+def projective_forward_homography_torch(src_images, intrinsics, pose, depths,
+                                        backend: str = "jax"):
+  """Warp all MPI planes into the target view: ``[P, B, H, W, C]`` in and
+  out (utils.py:237-265; n_hat = [0, 0, 1], a = -depth)."""
+  if _check_backend(backend):
+    import torch
+
+    o = _oracle()
+    p, b, h, w, _ = src_images.shape
+    rot = pose[:, :3, :3].expand(p, b, 3, 3)
+    t = pose[:, :3, 3:].expand(p, b, 3, 1)
+    n_hat = torch.tensor([0.0, 0.0, 1.0]).reshape(1, 1, 1, 3).expand(
+        p, b, 1, 3)
+    a = -depths.reshape(p, 1, 1, 1).expand(p, b, 1, 1)
+    k = intrinsics.expand(p, b, 3, 3)
+    hom = o.inverse_homography(k, k, rot, t, n_hat, a)
+    grid = o.meshgrid_abs(b, h, w).permute(0, 2, 3, 1)
+    pts = torch.einsum("pbij,bhwj->pbhwi", hom, grid)
+    xy = o.safe_divide(pts[..., :2], pts[..., 2:])
+    coords = xy / torch.tensor([h - 1.0, w - 1.0])   # Q2 (utils.py:188)
+    return o.grid_sample_01(src_images, coords)
+  return render.warp_planes(
+      jnp.asarray(src_images), jnp.asarray(pose), jnp.asarray(depths),
+      jnp.asarray(intrinsics))
+
+
+def mpi_render_view_torch(rgba_layers, tgt_pose, planes, intrinsics,
+                          backend: str = "jax"):
+  """Render a novel view from an MPI ``[B, H, W, P, 4]`` -> ``[B, H, W, 3]``
+  (utils.py:267-294)."""
+  if _check_backend(backend):
+    return _oracle().render_mpi(rgba_layers, tgt_pose, planes, intrinsics)
+  return render.render_mpi(
+      jnp.asarray(rgba_layers), jnp.asarray(tgt_pose), jnp.asarray(planes),
+      jnp.asarray(intrinsics))
+
+
+# --- projection path (plane sweep) --------------------------------------
+
+
+def pixel2cam_torch(depth, pixel_coords, intrinsics, backend: str = "jax"):
+  """Pixels -> homogeneous camera frame ``[B, 4, H, W]`` (utils.py:356-375)."""
+  if _check_backend(backend):
+    return _oracle().pixel2cam(depth, pixel_coords, intrinsics)
+  return sweep.pixel2cam(
+      jnp.asarray(depth), jnp.asarray(pixel_coords), jnp.asarray(intrinsics))
+
+
+def cam2pixel_torch(cam_coords, proj, backend: str = "jax"):
+  """Camera frame -> pixel (x, y) ``[B, H, W, 2]`` (utils.py:377-393)."""
+  if _check_backend(backend):
+    return _oracle().cam2pixel(cam_coords, proj)
+  return sweep.cam2pixel(jnp.asarray(cam_coords), jnp.asarray(proj))
+
+
+def projective_inverse_warp_torch(img, depth, pose, intrinsics,
+                                  backend: str = "jax"):
+  """Depth-based inverse warp (utils.py:409-450, convention Q3)."""
+  if _check_backend(backend):
+    return _oracle().projective_inverse_warp(img, depth, pose, intrinsics)
+  return sweep.projective_inverse_warp(
+      jnp.asarray(img), jnp.asarray(depth), jnp.asarray(pose),
+      jnp.asarray(intrinsics))
+
+
+def plane_sweep_torch(img, depth_planes, pose, intrinsics,
+                      backend: str = "jax"):
+  """PSV ``[B, H, W, 3P]`` (utils.py:452-471)."""
+  if _check_backend(backend):
+    return _oracle().plane_sweep(img, depth_planes, pose, intrinsics)
+  return sweep.plane_sweep(
+      jnp.asarray(img), jnp.asarray(depth_planes), jnp.asarray(pose),
+      jnp.asarray(intrinsics))
+
+
+def plane_sweep_torch_one(img, depth_planes, pose, intrinsics,
+                          backend: str = "jax"):
+  """Unbatched PSV variant (utils.py:513-533)."""
+  if _check_backend(backend):
+    o = _oracle()
+    return o.plane_sweep(img[None], depth_planes, pose[None],
+                         intrinsics[None])
+  return sweep.plane_sweep_one(
+      jnp.asarray(img), jnp.asarray(depth_planes), jnp.asarray(pose),
+      jnp.asarray(intrinsics))
+
+
+def format_network_input_torch(ref_image, src_images, ref_pose, psv_src_poses,
+                               planes, intrinsics, backend: str = "jax"):
+  """Reference image ++ one PSV per source (utils.py:473-498, minus the
+  stray ``self`` — quirk Q4). ``src_images``: list or ``[N, B, H, W, 3]``."""
+  if _check_backend(backend):
+    import torch
+
+    o = _oracle()
+    vols = [ref_image]
+    for img, pose in zip(src_images, psv_src_poses):
+      rel = pose @ torch.inverse(ref_pose)
+      vols.append(o.plane_sweep(img, planes, rel, intrinsics))
+    return torch.cat(vols, dim=-1)
+  srcs = jnp.stack([jnp.asarray(s) for s in src_images]) \
+      if isinstance(src_images, (list, tuple)) else jnp.asarray(src_images)
+  poses = jnp.stack([jnp.asarray(p) for p in psv_src_poses]) \
+      if isinstance(psv_src_poses, (list, tuple)) \
+      else jnp.asarray(psv_src_poses)
+  return sweep.format_network_input(
+      jnp.asarray(ref_image), srcs, jnp.asarray(ref_pose), poses,
+      jnp.asarray(planes), jnp.asarray(intrinsics))
+
+
+# --- pixel-shuffle modules (utils.py:803-820) ---------------------------
+
+
+class SpaceToDepth:
+  """NCHW ``[B, C, H, W] -> [B, C*b*b, H/b, W/b]``, torch unfold channel
+  order — the reference module's contract (utils.py:803-817). Torch inputs
+  stay in torch (``F.pixel_unshuffle``, same channel order, autograd
+  intact); everything else runs the NHWC jax op."""
+
+  def __init__(self, block_size: int):
+    self.block_size = block_size
+
+  def __call__(self, x):
+    if hasattr(x, "detach"):          # torch tensor in, torch tensor out
+      import torch.nn.functional as F
+
+      return F.pixel_unshuffle(x, self.block_size)
+    nhwc = jnp.moveaxis(jnp.asarray(x), 1, -1)
+    return jnp.moveaxis(camera.space_to_depth(nhwc, self.block_size), -1, 1)
+
+
+class DepthToSpace:
+  """NCHW ``[B, C*b*b, H, W] -> [B, C, H*b, W*b]`` (PixelShuffle order,
+  utils.py:820). Torch inputs use ``F.pixel_shuffle`` (autograd intact)."""
+
+  def __init__(self, block_size: int):
+    self.block_size = block_size
+
+  def __call__(self, x):
+    if hasattr(x, "detach"):
+      import torch.nn.functional as F
+
+      return F.pixel_shuffle(x, self.block_size)
+    nhwc = jnp.moveaxis(jnp.asarray(x), 1, -1)
+    return jnp.moveaxis(camera.depth_to_space(nhwc, self.block_size), -1, 1)
+
+
+def resize_with_intrinsics_torch(path, intrinsics, height, width,
+                                 backend: str = "jax"):
+  """Host-side open+resize with intrinsics rescale (utils.py:549-572)."""
+  from mpi_vision_tpu.data.realestate import resize_with_intrinsics
+
+  image, k = resize_with_intrinsics(path, np.asarray(intrinsics), height,
+                                    width)
+  if _check_backend(backend):
+    import torch
+
+    return torch.from_numpy(image), torch.from_numpy(k)
+  return jnp.asarray(image), jnp.asarray(k)

@@ -62,6 +62,48 @@ def deprocess_image(image: jnp.ndarray) -> jnp.ndarray:
   return (((image + 1.0) / 2.0) * 255.0).astype(jnp.uint8)
 
 
+def space_to_depth(image: jnp.ndarray, block_size: int) -> jnp.ndarray:
+  """``[..., H, W, C] -> [..., H/b, W/b, C*b*b]`` (NHWC).
+
+  Reference: the ``SpaceToDepth`` module (utils.py:803-817), an
+  ``F.unfold``-based ``tf.nn.space_to_depth`` equivalent. Its output
+  channel ordering is torch's unfold order — channel-major, then block row,
+  then block column (out channel ``c*b*b + dy*b + dx``) — which makes it the
+  exact inverse of ``depth_to_space`` (torch ``PixelShuffle`` ordering),
+  reproduced here on NHWC.
+  """
+  b = block_size
+  *lead, h, w, c = image.shape
+  if h % b or w % b:
+    raise ValueError(f"H, W must be divisible by block_size {b}; got {h}x{w}")
+  x = image.reshape(*lead, h // b, b, w // b, b, c)
+  n = len(lead)
+  # (..., hb, dy, wb, dx, c) -> (..., hb, wb, c, dy, dx)
+  x = jnp.transpose(
+      x, tuple(range(n)) + (n, n + 2, n + 4, n + 1, n + 3))
+  return x.reshape(*lead, h // b, w // b, c * b * b)
+
+
+def depth_to_space(image: jnp.ndarray, block_size: int) -> jnp.ndarray:
+  """``[..., H, W, C*b*b] -> [..., H*b, W*b, C]`` (NHWC).
+
+  Reference: ``DepthToSpace = torch.nn.PixelShuffle`` (utils.py:820); input
+  channel ``c*b*b + dy*b + dx`` maps to spatial offset (dy, dx) of output
+  channel c. Inverse of ``space_to_depth``.
+  """
+  b = block_size
+  *lead, h, w, cbb = image.shape
+  if cbb % (b * b):
+    raise ValueError(f"channels {cbb} not divisible by block_size^2 {b * b}")
+  c = cbb // (b * b)
+  x = image.reshape(*lead, h, w, c, b, b)
+  n = len(lead)
+  # (..., h, w, c, dy, dx) -> (..., h, dy, w, dx, c)
+  x = jnp.transpose(
+      x, tuple(range(n)) + (n, n + 3, n + 1, n + 4, n + 2))
+  return x.reshape(*lead, h * b, w * b, c)
+
+
 def crop_to_bounding_box(image: jnp.ndarray, offset_y, offset_x,
                          height: int, width: int) -> jnp.ndarray:
   """Differentiable crop via the bilinear sampler.

@@ -166,3 +166,43 @@ def projective_pixel_transform(
   proj = jnp.matmul(geometry.intrinsics_to_4x4(tgt_intrinsics), src_to_tgt,
                     precision=_HI)
   return cam2pixel(cam, proj)
+
+
+def format_network_input(
+    ref_image: jnp.ndarray,
+    src_images: jnp.ndarray,
+    ref_pose: jnp.ndarray,
+    src_poses: jnp.ndarray,
+    planes: jnp.ndarray,
+    intrinsics: jnp.ndarray,
+    **kwargs,
+) -> jnp.ndarray:
+  """Multi-source network input: reference image ++ one PSV per source.
+
+  Each source image is swept in the reference camera's frame (relative pose
+  ``src_pose @ ref_pose^-1``) and the volumes are channel-concatenated after
+  the reference image, in source order.
+
+  Args:
+    ref_image: ``[B, H, W, 3]``.
+    src_images: ``[N, B, H, W, 3]`` source images.
+    ref_pose: ``[B, 4, 4]`` world-to-camera.
+    src_poses: ``[N, B, 4, 4]`` world-to-camera.
+    planes: ``[P]`` descending plane depths.
+    intrinsics: ``[B, 3, 3]``.
+    **kwargs: forwarded to ``plane_sweep`` (e.g. ``convention``).
+
+  Returns:
+    ``[B, H, W, 3 + 3*P*N]``.
+
+  Reference: ``format_network_input_torch`` (utils.py:473-498) minus its
+  stray ``self`` first parameter (quirk Q4, SURVEY.md §2.8 — a copy-paste
+  leftover that forced callers to pass None; deliberately not reproduced).
+  """
+  rel = jnp.matmul(src_poses, jnp.linalg.inv(ref_pose)[None], precision=_HI)
+  psvs = jax.vmap(
+      lambda img, pose: plane_sweep(img, planes, pose, intrinsics, **kwargs)
+  )(src_images, rel)                                  # [N, B, H, W, 3P]
+  n, b, h, w, _ = psvs.shape
+  stacked = jnp.moveaxis(psvs, 0, 3).reshape(b, h, w, -1)
+  return jnp.concatenate([ref_image, stacked], axis=-1)

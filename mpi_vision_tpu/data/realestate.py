@@ -30,7 +30,8 @@ from typing import Any, Iterator, Mapping, Sequence
 import jax.numpy as jnp
 import numpy as np
 
-from mpi_vision_tpu.core.camera import inv_depths, intrinsics_matrix, preprocess_image
+from mpi_vision_tpu.core.camera import (
+    inv_depths, intrinsics_matrix, preprocess_image, scale_intrinsics)
 from mpi_vision_tpu.core.sweep import plane_sweep_one
 
 
@@ -40,6 +41,41 @@ def read_file_lines(path: str) -> list[str]:
   with open(path) as f:
     return [ln.rstrip("\n") for ln in f
             if ln.strip() and not ln.lstrip().startswith("#")]
+
+
+def open_image(path: str, size: tuple[int, int] | None = None,
+               scale: bool = True) -> np.ndarray:
+  """Open an image file -> RGB float array ``[H, W, 3]``.
+
+  ``size`` is (width, height) as PIL takes it; ``scale`` divides by 255
+  into [0, 1]. Reference: ``open_image`` (utils.py:324-332).
+  """
+  from PIL import Image
+
+  img = Image.open(path).convert("RGB")
+  if size is not None:
+    img = img.resize(size)
+  arr = np.asarray(img, np.float32)
+  return arr / 255.0 if scale else arr
+
+
+def resize_with_intrinsics(path: str, intrinsics, height: int,
+                           width: int) -> tuple[np.ndarray, np.ndarray]:
+  """Open + resize an image and scale its pixel-space intrinsics to match.
+
+  Returns ``(image [height, width, 3] in [-1, 1], intrinsics [3, 3])``.
+  Reference: ``resize_with_intrinsics_torch`` (utils.py:549-572): PIL
+  open/resize, K scaled by the size ratios, image preprocessed to [-1, 1].
+  """
+  from PIL import Image
+
+  with Image.open(path) as img:
+    w0, h0 = img.size
+  image = np.asarray(preprocess_image(
+      open_image(path, size=(width, height))))
+  k = np.asarray(scale_intrinsics(
+      np.asarray(intrinsics, np.float32), height / h0, width / w0))
+  return image, k
 
 
 @dataclass

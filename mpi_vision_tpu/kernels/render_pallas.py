@@ -964,6 +964,11 @@ def render_mpi_fused(planes: jnp.ndarray, homs: jnp.ndarray,
         "jit pass check=False (you own the coverage envelope — verify "
         "representative poses with fits_envelope eagerly first) or use an "
         "XLA method (core.render.render_mpi(method='scan'|'fused')).")
+  if plan is None:
+    raise ValueError(
+        "plan=None: the planner rejected this pose set (outside the kernel "
+        "envelope) — rendering with any kernel variant would drop taps. "
+        "Use an XLA method or the check=True fallback.")
   if separable:
     if check and not is_separable(homs):
       raise ValueError(
@@ -987,9 +992,4 @@ def render_mpi_fused(planes: jnp.ndarray, homs: jnp.ndarray,
     if plan is None:
       return _reference_render_jit(planes, homs)
     return _SHARED[plan](planes, homs)
-  if plan is None:
-    raise ValueError(
-        "plan=None: the planner rejected this pose set (outside the kernel "
-        "envelope) — rendering with any kernel variant would drop taps. "
-        "Use an XLA method or the check=True fallback.")
   return _SHARED[(3, 3) if plan is PLAN_UNSET else plan](planes, homs)

@@ -1,0 +1,203 @@
+"""Reference-API tail: compat shim, pixel-shuffle ops, multi-source input,
+public IO helpers (VERDICT r2 item 8 — rows 11/15/16/36)."""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+from mpi_vision_tpu import compat
+from mpi_vision_tpu.core import camera, sweep
+from mpi_vision_tpu.core.camera import inv_depths
+from mpi_vision_tpu.data import realestate
+from mpi_vision_tpu.torchref import oracle
+
+
+class TestSpaceToDepth:
+
+  def test_roundtrip_identity(self, rng):
+    x = rng.uniform(size=(2, 8, 12, 3)).astype(np.float32)
+    y = camera.space_to_depth(jnp.asarray(x), 2)
+    assert y.shape == (2, 4, 6, 12)
+    back = camera.depth_to_space(y, 2)
+    np.testing.assert_array_equal(np.asarray(back), x)
+
+  def test_matches_torch_unfold_reference(self, rng):
+    """The reference SpaceToDepth is F.unfold-based (utils.py:803-817):
+    channel-major (c*b*b + dy*b + dx) output ordering."""
+    import torch.nn.functional as F
+
+    b = 2
+    x = rng.uniform(size=(1, 4, 6, 3)).astype(np.float32)
+    nchw = torch.from_numpy(x).permute(0, 3, 1, 2)
+    want = F.unfold(nchw, b, stride=b).reshape(
+        1, 3 * b * b, 4 // b, 6 // b)
+    got = camera.space_to_depth(jnp.asarray(x), b)      # NHWC
+    np.testing.assert_allclose(
+        np.moveaxis(np.asarray(got), -1, 1), want.numpy(), atol=0)
+
+  def test_depth_to_space_matches_pixel_shuffle(self, rng):
+    """DepthToSpace == torch.nn.PixelShuffle (utils.py:820)."""
+    b = 2
+    x = rng.uniform(size=(1, 3, 4, 5 * b * b)).astype(np.float32)
+    want = torch.nn.PixelShuffle(b)(
+        torch.from_numpy(x).permute(0, 3, 1, 2))
+    got = camera.depth_to_space(jnp.asarray(x), b)
+    np.testing.assert_allclose(
+        np.moveaxis(np.asarray(got), -1, 1), want.numpy(), atol=0)
+
+  def test_compat_modules_nchw(self, rng):
+    x = rng.uniform(size=(1, 3, 4, 8)).astype(np.float32)  # NCHW
+    s2d = compat.SpaceToDepth(2)
+    d2s = compat.DepthToSpace(2)
+    y = s2d(jnp.asarray(x))
+    assert y.shape == (1, 12, 2, 4)
+    np.testing.assert_array_equal(np.asarray(d2s(y)), x)
+    # torch tensors in -> torch tensors out, same values
+    yt = s2d(torch.from_numpy(x))
+    np.testing.assert_array_equal(yt.numpy(), np.asarray(y))
+
+
+class TestFormatNetworkInput:
+
+  def test_matches_oracle_multi_source(self, rng):
+    n, b, hw, p = 2, 1, 24, 3
+    ref = rng.uniform(-1, 1, (b, hw, hw, 3)).astype(np.float32)
+    srcs = rng.uniform(-1, 1, (n, b, hw, hw, 3)).astype(np.float32)
+    ref_pose = np.eye(4, dtype=np.float32)[None].repeat(b, 0)
+    src_poses = np.stack([np.eye(4, dtype=np.float32)[None].repeat(b, 0)
+                          for _ in range(n)])
+    src_poses[0, :, 0, 3] = 0.05
+    src_poses[1, :, 1, 3] = -0.04
+    planes = np.asarray(inv_depths(1.0, 100.0, p), np.float32)
+    k = np.array([[hw / 2, 0, hw / 2], [0, hw / 2, hw / 2], [0, 0, 1]],
+                 np.float32)[None].repeat(b, 0)
+
+    got = sweep.format_network_input(
+        jnp.asarray(ref), jnp.asarray(srcs), jnp.asarray(ref_pose),
+        jnp.asarray(src_poses), jnp.asarray(planes), jnp.asarray(k))
+    assert got.shape == (b, hw, hw, 3 + 3 * p * n)
+
+    vols = [torch.from_numpy(ref)]
+    for i in range(n):
+      rel = torch.from_numpy(src_poses[i]) @ torch.inverse(
+          torch.from_numpy(ref_pose))
+      vols.append(oracle.plane_sweep(
+          torch.from_numpy(srcs[i]), torch.from_numpy(planes), rel,
+          torch.from_numpy(k)))
+    want = torch.cat(vols, dim=-1).numpy()
+    np.testing.assert_allclose(np.asarray(got), want, atol=1e-3, rtol=0)
+
+  def test_compat_shim_both_backends_agree(self, rng):
+    n, b, hw, p = 2, 1, 24, 3
+    ref = rng.uniform(-1, 1, (b, hw, hw, 3)).astype(np.float32)
+    srcs = rng.uniform(-1, 1, (n, b, hw, hw, 3)).astype(np.float32)
+    ref_pose = np.eye(4, dtype=np.float32)[None]
+    src_poses = np.stack([np.eye(4, dtype=np.float32)[None]] * n)
+    src_poses[0, :, 0, 3] = 0.06
+    planes = np.asarray(inv_depths(1.0, 100.0, p), np.float32)
+    k = np.array([[hw / 2, 0, hw / 2], [0, hw / 2, hw / 2], [0, 0, 1]],
+                 np.float32)[None]
+    got_j = compat.format_network_input_torch(
+        ref, srcs, ref_pose, src_poses, planes, k)
+    got_t = compat.format_network_input_torch(
+        torch.from_numpy(ref), torch.from_numpy(srcs),
+        torch.from_numpy(ref_pose), torch.from_numpy(src_poses),
+        torch.from_numpy(planes), torch.from_numpy(k), backend="torch")
+    np.testing.assert_allclose(
+        np.asarray(got_j), got_t.numpy(), atol=1e-3, rtol=0)
+
+
+class TestCompatShim:
+
+  def _mpi_args(self, rng, b=1, hw=24, p=3):
+    mpi = rng.uniform(0, 1, (b, hw, hw, p, 4)).astype(np.float32)
+    pose = np.eye(4, dtype=np.float32)
+    pose[0, 3] = 0.05
+    planes = np.asarray(inv_depths(1.0, 100.0, p), np.float32)
+    k = np.array([[hw / 2, 0, hw / 2], [0, hw / 2, hw / 2], [0, 0, 1]],
+                 np.float32)
+    return mpi, pose[None].repeat(b, 0), planes, k[None].repeat(b, 0)
+
+  def test_mpi_render_view_backends_agree(self, rng):
+    mpi, pose, planes, k = self._mpi_args(rng)
+    got_j = compat.mpi_render_view_torch(mpi, pose, planes, k)
+    got_t = compat.mpi_render_view_torch(
+        torch.from_numpy(mpi), torch.from_numpy(pose),
+        torch.from_numpy(planes), torch.from_numpy(k), backend="torch")
+    np.testing.assert_allclose(
+        np.asarray(got_j), got_t.numpy(), atol=1e-3, rtol=0)
+
+  def test_plane_sweep_backends_agree(self, rng):
+    img = rng.uniform(-1, 1, (1, 24, 24, 3)).astype(np.float32)
+    pose = np.eye(4, dtype=np.float32)
+    pose[0, 3] = 0.07
+    planes = np.asarray(inv_depths(1.0, 100.0, 4), np.float32)
+    k = np.array([[12., 0, 12], [0, 12., 12], [0, 0, 1]], np.float32)
+    got_j = compat.plane_sweep_torch(img, planes, pose[None], k[None])
+    got_t = compat.plane_sweep_torch(
+        torch.from_numpy(img), torch.from_numpy(planes),
+        torch.from_numpy(pose)[None], torch.from_numpy(k)[None],
+        backend="torch")
+    np.testing.assert_allclose(
+        np.asarray(got_j), got_t.numpy(), atol=1e-3, rtol=0)
+
+  def test_projective_forward_homography_backends_agree(self, rng):
+    mpi, pose, planes, k = self._mpi_args(rng)
+    stack = np.moveaxis(mpi, 3, 0)                    # [P, B, H, W, 4]
+    got_j = compat.projective_forward_homography_torch(stack, k, pose, planes)
+    got_t = compat.projective_forward_homography_torch(
+        torch.from_numpy(stack), torch.from_numpy(k),
+        torch.from_numpy(pose), torch.from_numpy(planes), backend="torch")
+    np.testing.assert_allclose(
+        np.asarray(got_j), got_t.numpy(), atol=1e-3, rtol=0)
+
+  def test_over_composite_accepts_list(self, rng):
+    planes = [rng.uniform(0, 1, (1, 8, 8, 4)).astype(np.float32)
+              for _ in range(3)]
+    got_j = compat.over_composite(planes)
+    got_t = compat.over_composite(
+        [torch.from_numpy(p) for p in planes], backend="torch")
+    np.testing.assert_allclose(
+        np.asarray(got_j), got_t.numpy(), atol=1e-5, rtol=0)
+
+  def test_small_helpers_backends_agree(self, rng):
+    d_j = np.asarray(compat.inv_depths(1.0, 100.0, 6))
+    d_t = compat.inv_depths(1.0, 100.0, 6, backend="torch").numpy()
+    np.testing.assert_allclose(d_j, d_t)
+    k_j = np.asarray(compat.make_intrinsics_matrix(2.0, 3.0, 4.0, 5.0))
+    k_t = compat.make_intrinsics_matrix(
+        2.0, 3.0, 4.0, 5.0, backend="torch").numpy()
+    np.testing.assert_allclose(k_j, k_t)
+    x = rng.uniform(size=(2, 3)).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(compat.preprocess_image_torch(x)), x * 2 - 1)
+
+  def test_unknown_backend_raises(self):
+    with pytest.raises(ValueError, match="backend"):
+      compat.inv_depths(1.0, 100.0, 4, backend="tf")
+
+
+class TestPublicIOHelpers:
+
+  def test_open_image_and_resize_with_intrinsics(self, rng, tmp_path):
+    from PIL import Image
+
+    arr = (rng.uniform(size=(20, 30, 3)) * 255).astype(np.uint8)
+    path = os.path.join(tmp_path, "img.png")
+    Image.fromarray(arr).save(path)
+
+    img = realestate.open_image(path)
+    assert img.shape == (20, 30, 3) and img.max() <= 1.0
+    img2 = realestate.open_image(path, size=(15, 10), scale=False)
+    assert img2.shape == (10, 15, 3) and img2.max() > 1.0
+
+    k = np.array([[30., 0, 15], [0, 20., 10], [0, 0, 1]], np.float32)
+    image, k2 = realestate.resize_with_intrinsics(path, k, 10, 15)
+    assert image.shape == (10, 15, 3)
+    assert image.min() >= -1.0 and image.max() <= 1.0
+    # fx scales by width ratio (15/30), fy by height ratio (10/20).
+    np.testing.assert_allclose(k2[0, 0], 15.0)
+    np.testing.assert_allclose(k2[1, 1], 10.0)

@@ -163,25 +163,29 @@ def render_mpi(
             for b in range(planar.shape[1])]
     return jnp.stack([jnp.moveaxis(o, 0, -1) for o in outs])
 
-  homs = plane_homographies(tgt_pose, depths, intrinsics)  # [P, B, 3, 3]
+  with jax.named_scope("render/homographies"):
+    homs = plane_homographies(tgt_pose, depths, intrinsics)  # [P, B, 3, 3]
 
   if method != "fused":
-    coords = warp_coordinates(homs, h, w, convention)
-    warped = sampling.bilinear_sample(planes, coords)
-    return compose.over_composite(warped, method=method)
+    with jax.named_scope("render/warp"):
+      coords = warp_coordinates(homs, h, w, convention)
+      warped = sampling.bilinear_sample(planes, coords)
+    with jax.named_scope("render/composite"):
+      return compose.over_composite(warped, method=method)
 
   def warp_one(plane, hom):
     coords = warp_coordinates(hom, h, w, convention)
     return sampling.bilinear_sample(plane, coords)
 
-  # Farthest plane: alpha ignored (utils.py:152-153).
-  out0 = warp_one(planes[0], homs[0])[..., :3]
+  with jax.named_scope("render/warp_composite_scan"):
+    # Farthest plane: alpha ignored (utils.py:152-153).
+    out0 = warp_one(planes[0], homs[0])[..., :3]
 
-  def step(out, xs):
-    plane, hom = xs
-    rgba = warp_one(plane, hom)
-    rgb, alpha = rgba[..., :3], rgba[..., 3:]
-    return rgb * alpha + out * (1.0 - alpha), None
+    def step(out, xs):
+      plane, hom = xs
+      rgba = warp_one(plane, hom)
+      rgb, alpha = rgba[..., :3], rgba[..., 3:]
+      return rgb * alpha + out * (1.0 - alpha), None
 
-  out, _ = jax.lax.scan(step, out0, (planes[1:], homs[1:]))
-  return out
+    out, _ = jax.lax.scan(step, out0, (planes[1:], homs[1:]))
+    return out

@@ -1,0 +1,65 @@
+"""Observability & numeric-safety hooks (SURVEY.md §5.1-5.2).
+
+The reference has no tracing/profiling and no numeric guards beyond ad-hoc
+eps constants (utils.py:36-38, 391 — both preserved in ``core.geometry`` /
+``core.sweep`` for parity). The idiomatic JAX equivalents supplied here:
+
+  * ``checked(fn)`` — wrap any jittable entry point (render, loss, train
+    step) with ``jax.experimental.checkify`` float checks, so NaN/inf
+    produced ANYWHERE inside raises a Python error with a located message
+    instead of silently poisoning downstream pixels/gradients.
+  * ``trace(logdir)`` — ``jax.profiler`` trace context for capturing a
+    device profile of a render/train region (view in TensorBoard/XProf).
+  * ``named_scope`` — re-export of ``jax.named_scope``; the core pipelines
+    annotate their stages with it so profiles and HLO dumps read as
+    ``render/warp``, ``render/composite``, ``loss/vgg`` instead of a flat
+    op soup.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+from typing import Callable
+
+import jax
+from jax.experimental import checkify
+
+named_scope = jax.named_scope
+
+
+def checked(fn: Callable, errors=checkify.float_checks) -> Callable:
+  """Wrap ``fn`` so NaN/inf anywhere inside raises ``JaxRuntimeError``.
+
+  The wrapped function jits the checkified body (checkify inserts the
+  error plumbing; jitting it keeps the overhead to the checks themselves)
+  and throws on the first failed check with the offending primitive named.
+
+  Example::
+
+      render = debug.checked(functools.partial(render_mpi, method="scan"))
+      out = render(mpi, pose, depths, k)   # raises if any NaN appears
+  """
+  cfn = jax.jit(checkify.checkify(fn, errors=errors))
+
+  @functools.wraps(fn)
+  def wrapper(*args, **kwargs):
+    err, out = cfn(*args, **kwargs)
+    checkify.check_error(err)
+    return out
+
+  return wrapper
+
+
+@contextlib.contextmanager
+def trace(logdir: str):
+  """Capture a ``jax.profiler`` device trace of the enclosed region.
+
+  Remember to ``jax.block_until_ready`` the region's outputs inside the
+  context, or the trace ends before the device work does.
+  """
+  jax.profiler.start_trace(logdir)
+  try:
+    yield
+  finally:
+    jax.profiler.stop_trace()

@@ -71,7 +71,8 @@ def vgg_perceptual_loss(
     convention: Convention = Convention.REF_HOMOGRAPHY,
 ) -> jnp.ndarray:
   """The reference training loss (cell 12): pixel L1 + weighted VGG L1s."""
-  out = render_novel_view(mpi_pred, batch, convention=convention)
+  with jax.named_scope("loss/render"):
+    out = render_novel_view(mpi_pred, batch, convention=convention)
   tgt = batch["tgt_img"]
 
   x = vgg.imagenet_normalize(out)
@@ -86,8 +87,9 @@ def vgg_perceptual_loss(
     y = jax.image.resize(y, shape, "linear", antialias=False)
 
   loss = jnp.mean(jnp.abs(x - y))                           # cell 12:54
-  feats_x = vgg.VGG16Features().apply(vgg_params, x)
-  feats_y = vgg.VGG16Features().apply(vgg_params, y)
-  for i, (fx, fy) in enumerate(zip(feats_x, feats_y)):
-    loss = loss + jnp.mean(jnp.abs(fx - fy)) / (1.0 + i)    # cell 12:55-59
+  with jax.named_scope("loss/vgg"):
+    feats_x = vgg.VGG16Features().apply(vgg_params, x)
+    feats_y = vgg.VGG16Features().apply(vgg_params, y)
+    for i, (fx, fy) in enumerate(zip(feats_x, feats_y)):
+      loss = loss + jnp.mean(jnp.abs(fx - fy)) / (1.0 + i)  # cell 12:55-59
   return loss

@@ -14,6 +14,16 @@ at ``translateZ(-z) scale((f+z)/f)`` with ``z = f * (d/d_near - 1)``, and
 pointer controls — move for parallax, drag to rotate, shift-drag to
 translate, wheel to dolly, digit keys to inspect single layers, ``a`` for
 alpha view.
+
+Inspection/motion features matching the reference template's surface:
+depth-colormap modes (``d`` cycles off/turbo/magma — procedural colormaps
+tinting each layer through its own alpha mask; template:220-267), sway and
+wander auto-motion (``s``/``w``; template:488-495, 620-639), a clickable
+per-layer minis bar with solo/under/over selection (``[``/``]``, ``m``;
+template:506-598), and URL parameters — ``url``/``n`` load an external
+``mpi$$.png`` sequence instead of the embedded MPI, plus
+``near``/``far``/``fov``/``move``/``depth``/``mini``/``solo`` overrides
+(template:641-686).
 """
 
 from __future__ import annotations
@@ -39,20 +49,50 @@ _HTML_TEMPLATE = """<!DOCTYPE html>
   .layer { position: absolute; left: 0; top: 0; width: 100%; height: 100%;
            transform-style: preserve-3d; backface-visibility: hidden;
            pointer-events: none; }
+  .layer .tint { position: absolute; inset: 0; display: none; }
+  .depthmap .layer img { visibility: hidden; }
+  .depthmap .layer .tint { display: block; }
   .alpha .layer img { filter: grayscale(1) contrast(0); }
   #hud { position: fixed; left: 8px; bottom: 8px; opacity: .7;
          user-select: none; }
+  #minis { position: fixed; right: 8px; top: 8px; bottom: 8px; width: 96px;
+           overflow-y: auto; display: flex; flex-direction: column;
+           gap: 4px; }
+  #minis img { width: 100%; border: 1px solid #333; cursor: pointer;
+               background: #222; }
+  #minis img.sel { border-color: #fc0; }
+  body.nominis #minis { display: none; }
 </style>
 </head>
 <body>
 <div id="stage"><div id="frustum"></div></div>
+<div id="minis"></div>
 <div id="hud">drag: rotate · shift-drag: pan · wheel: dolly ·
-1-9/0: solo layer · a: alpha · r: reset</div>
+1-9/0: solo · [: under · ]: over · a: alpha · d: depth map ·
+s: sway · w: wander · m: minis · r: reset</div>
 <script>
 "use strict";
-const mpiSources = __MPI_SOURCES__;
+const embeddedSources = __MPI_SOURCES__;
 const cfg = { w: __W__, h: __H__, near: __NEAR__, far: __FAR__,
-              fov: __FOV__ };
+              fov: __FOV__, move: "none", depth: 0, mini: 1, solo: -1 };
+
+// ---- URL parameters: viewing config + external mpi$$.png sequences -----
+// ?url=lores/scene/rgba_$$.png&n=10 loads an external MPI instead of the
+// embedded one ($$ -> zero-padded index); near/far/fov/move/depth/mini/solo
+// override the embedded defaults.
+const q = new URLSearchParams(location.search);
+let mpiSources = embeddedSources;
+if (q.get("url") && q.get("n")) {
+  const n = +q.get("n");
+  mpiSources = [];
+  for (let i = 0; i < n; i++) {
+    mpiSources.push(q.get("url").replace("$$", String(i).padStart(2, "0")));
+  }
+}
+for (const k of ["near", "far", "fov", "depth", "mini", "solo"]) {
+  if (q.get(k) !== null) cfg[k] = +q.get(k);
+}
+if (q.get("move")) cfg.move = q.get("move");
 
 const focal = 0.5 * cfg.w / Math.tan(cfg.fov * Math.PI / 360);
 const P = mpiSources.length;
@@ -63,13 +103,37 @@ for (let i = 0; i < P; i++) {
   depths.push(1 / inv);
 }
 
+// ---- depth colormaps (procedural; original implementations) ------------
+// turbo: rational-polynomial fit of the published colormap; magma: lerped
+// anchor table. t in [0, 1] -> "rgb(...)" (t = 0 far, t = 1 near).
+function turbo(t) {
+  t = Math.min(1, Math.max(0, t));
+  const r = 34.61 + t * (1172.33 + t * (-10793.56 + t * (33300.12 + t * (-38394.49 + t * 14825.05))));
+  const g = 23.31 + t * (557.33 + t * (1225.33 + t * (-3574.96 + t * (1073.77 + t * 707.56))));
+  const b = 27.2 + t * (3211.1 + t * (-15327.97 + t * (27814.0 + t * (-22569.18 + t * 6838.66))));
+  const c = v => Math.round(Math.min(255, Math.max(0, v)));
+  return `rgb(${c(r)},${c(g)},${c(b)})`;
+}
+const MAGMA_ANCHORS = [
+  [0, 0, 4], [28, 16, 68], [79, 18, 123], [129, 37, 129], [181, 54, 122],
+  [229, 80, 100], [251, 135, 97], [254, 194, 135], [252, 253, 191]];
+function magma(t) {
+  t = Math.min(1, Math.max(0, t)) * (MAGMA_ANCHORS.length - 1);
+  const i = Math.min(MAGMA_ANCHORS.length - 2, Math.floor(t)), f = t - i;
+  const mix = (a, b) => Math.round(a + (b - a) * f);
+  const lo = MAGMA_ANCHORS[i], hi = MAGMA_ANCHORS[i + 1];
+  return `rgb(${mix(lo[0], hi[0])},${mix(lo[1], hi[1])},${mix(lo[2], hi[2])})`;
+}
+const COLORMAPS = [null, turbo, magma];
+
 const frustum = document.getElementById("frustum");
 const stage = document.getElementById("stage");
+const minisBar = document.getElementById("minis");
 frustum.style.width = cfg.w + "px";
 frustum.style.height = cfg.h + "px";
 stage.style.perspective = focal + "px";
 
-const layers = [];
+const layers = [], minis = [];
 for (let i = 0; i < P; i++) {
   const div = document.createElement("div");
   div.className = "layer";
@@ -77,6 +141,14 @@ for (let i = 0; i < P; i++) {
   img.src = mpiSources[i];
   img.style.width = "100%"; img.style.height = "100%";
   div.appendChild(img);
+  // Depth-map tint: a colored pane masked by the layer's own alpha.
+  const tint = document.createElement("div");
+  tint.className = "tint";
+  tint.style.maskImage = `url("${mpiSources[i]}")`;
+  tint.style.webkitMaskImage = `url("${mpiSources[i]}")`;
+  tint.style.maskSize = "100% 100%";
+  tint.style.webkitMaskSize = "100% 100%";
+  div.appendChild(tint);
   // z grows with scene depth relative to the nearest layer; (f+z)/f undoes
   // the perspective shrink so the stack aligns exactly head-on.
   const z = focal * (depths[i] / depths[P - 1] - 1);
@@ -85,20 +157,85 @@ for (let i = 0; i < P; i++) {
   div.dataset.z = z;
   frustum.appendChild(div);
   layers.push(div);
+
+  // Layer mini: click = solo, shift-click = this-and-under,
+  // alt-click = this-and-over; click the selection again to clear.
+  const mini = document.createElement("img");
+  mini.src = mpiSources[i];
+  mini.title = `layer ${i} (depth ${depths[i].toFixed(2)})`;
+  mini.addEventListener("click", e => {
+    const mode = e.shiftKey ? "under" : (e.altKey ? "over" : "solo");
+    if (sel.index === i && sel.mode === mode) {
+      sel.index = -1;
+    } else {
+      sel.index = i; sel.mode = mode;
+    }
+    apply();
+  });
+  minisBar.prepend(mini);   // nearest layer on top, like the stack
+  minis.push(mini);
 }
 
-// Drag rotation accumulates into `base`; hover parallax is a small
-// additive offset on top, so releasing a drag never snaps the view back.
+// Drag rotation accumulates into `base`; hover parallax and the motion
+// modes are additive offsets on top, so neither snaps the view back.
 const base = { rx: 0, ry: 0, tx: 0, ty: 0, tz: 0 };
 const hover = { rx: 0, ry: 0 };
-let solo = -1, dragging = false, lastX = 0, lastY = 0;
+const auto = { rx: 0, ry: 0 };
+const sel = { index: cfg.solo, mode: "solo" };
+let depthMode = cfg.depth % COLORMAPS.length;
+let moveMode = cfg.move;          // none | sway | wander
+let dragging = false, lastX = 0, lastY = 0;
+if (!cfg.mini) document.body.classList.add("nominis");
+
+function visible(i) {
+  if (sel.index < 0) return true;
+  if (sel.mode === "solo") return i === sel.index;
+  if (sel.mode === "under") return i <= sel.index;
+  return i >= sel.index;          // over
+}
+
+function setDepthMode(mode) {
+  // Tint colors depend only on (layer index, mode): set them here once,
+  // not in the per-frame apply() path.
+  depthMode = mode % COLORMAPS.length;
+  document.body.classList.toggle("depthmap", depthMode > 0);
+  if (depthMode > 0) {
+    layers.forEach((l, i) => {
+      const t = P > 1 ? i / (P - 1) : 1;   // 0 = farthest
+      l.querySelector(".tint").style.background = COLORMAPS[depthMode](t);
+    });
+  }
+}
+
+function setMoveMode(mode) {
+  moveMode = mode;
+  if (mode === "none") { auto.rx = auto.ry = 0; }  // no stale swing offset
+}
 
 function apply() {
   frustum.style.transform =
       `translate3d(${base.tx}px, ${base.ty}px, ${base.tz}px) ` +
-      `rotateX(${base.rx + hover.rx}deg) rotateY(${base.ry + hover.ry}deg)`;
-  layers.forEach((l, i) =>
-      l.style.opacity = (solo < 0 || solo === i) ? 1 : 0.04);
+      `rotateX(${base.rx + hover.rx + auto.rx}deg) ` +
+      `rotateY(${base.ry + hover.ry + auto.ry}deg)`;
+  layers.forEach((l, i) => l.style.opacity = visible(i) ? 1 : 0.04);
+  minis.forEach((m, i) => m.classList.toggle("sel",
+      sel.index >= 0 && visible(i)));
+}
+
+// Motion modes: sway is a gentle fixed-frequency pan; wander is a slow
+// two-frequency Lissajous drift over both axes.
+function tick(ms) {
+  const t = ms / 1000;
+  if (moveMode === "sway") {
+    auto.ry = 4 * Math.sin(t * 1.1); auto.rx = 0;
+  } else if (moveMode === "wander") {
+    auto.ry = 3.5 * Math.sin(t * 0.53) + 1.5 * Math.sin(t * 1.31);
+    auto.rx = 2.0 * Math.sin(t * 0.71) + 1.0 * Math.cos(t * 0.37);
+  } else {
+    auto.rx = auto.ry = 0;
+  }
+  if (moveMode !== "none") apply();
+  requestAnimationFrame(tick);
 }
 
 window.addEventListener("pointerdown", e => {
@@ -126,15 +263,33 @@ window.addEventListener("wheel", e => {
 window.addEventListener("keydown", e => {
   if (e.key >= "0" && e.key <= "9") {
     const k = e.key === "0" ? 9 : +e.key - 1;
-    solo = (k < P && solo !== k) ? k : -1;
+    if (k < P && !(sel.index === k && sel.mode === "solo")) {
+      sel.index = k; sel.mode = "solo";
+    } else sel.index = -1;
+  } else if (e.key === "[" && sel.index >= 0) {
+    sel.mode = "under";
+  } else if (e.key === "]" && sel.index >= 0) {
+    sel.mode = "over";
   } else if (e.key === "a") {
     document.body.classList.toggle("alpha");
+  } else if (e.key === "d") {
+    setDepthMode(depthMode + 1);
+  } else if (e.key === "s") {
+    setMoveMode(moveMode === "sway" ? "none" : "sway");
+  } else if (e.key === "w") {
+    setMoveMode(moveMode === "wander" ? "none" : "wander");
+  } else if (e.key === "m") {
+    document.body.classList.toggle("nominis");
   } else if (e.key === "r") {
-    Object.assign(base, { rx: 0, ry: 0, tx: 0, ty: 0, tz: 0 }); solo = -1;
+    Object.assign(base, { rx: 0, ry: 0, tx: 0, ty: 0, tz: 0 });
+    sel.index = -1; setDepthMode(0); setMoveMode("none");
   }
   apply();
 });
+setDepthMode(depthMode);
+setMoveMode(moveMode);
 apply();
+requestAnimationFrame(tick);
 </script>
 </body>
 </html>

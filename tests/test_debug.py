@@ -1,0 +1,76 @@
+"""Observability hooks: checkify NaN guards, named scopes, profiler trace
+(SURVEY.md §5.1-5.2 — absent upstream, supplied idiomatically)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpi_vision_tpu import debug
+from mpi_vision_tpu.core import render
+from mpi_vision_tpu.core.camera import inv_depths
+
+
+def _args(rng, b=1, hw=24, p=3, poison=False):
+  mpi = rng.uniform(0, 1, (b, hw, hw, p, 4)).astype(np.float32)
+  if poison:
+    mpi[0, hw // 2, hw // 2, 1, 0] = np.nan
+  pose = np.eye(4, dtype=np.float32)
+  pose[0, 3] = 0.05
+  k = np.array([[hw / 2, 0, hw / 2], [0, hw / 2, hw / 2], [0, 0, 1]],
+               np.float32)
+  return (jnp.asarray(mpi), jnp.asarray(pose)[None],
+          inv_depths(1.0, 100.0, p), jnp.asarray(k)[None])
+
+
+class TestCheckify:
+
+  def test_clean_input_passes_and_matches(self, rng):
+    args = _args(rng)
+    checked = debug.checked(render.render_mpi)
+    np.testing.assert_allclose(
+        np.asarray(checked(*args)), np.asarray(render.render_mpi(*args)),
+        atol=1e-6)
+
+  def test_nan_injection_raises(self, rng):
+    args = _args(rng, poison=True)
+    checked = debug.checked(render.render_mpi)
+    with pytest.raises(Exception, match="nan"):
+      checked(*args)
+
+  def test_nan_in_loss_raises(self, rng):
+    from mpi_vision_tpu.train import loss as tloss
+
+    mpi_pred = jnp.asarray(
+        rng.uniform(-1, 1, (1, 24, 24, 9)).astype(np.float32))
+    batch = {
+        "ref_img": jnp.full((1, 24, 24, 3), jnp.nan),   # poisoned input
+        "tgt_img": jnp.zeros((1, 24, 24, 3)),
+        "tgt_img_cfw": jnp.eye(4)[None],
+        "ref_img_wfc": jnp.eye(4)[None],
+        "intrinsics": jnp.asarray(
+            np.array([[[12., 0, 12], [0, 12., 12], [0, 0, 1]]], np.float32)),
+        "mpi_planes": inv_depths(1.0, 100.0, 3),
+    }
+    checked = debug.checked(tloss.l2_render_loss)
+    with pytest.raises(Exception, match="nan"):
+      checked(mpi_pred, batch)
+
+
+class TestScopesAndTrace:
+
+  def test_named_scopes_in_lowered_hlo(self, rng):
+    args = _args(rng)
+    txt = jax.jit(render.render_mpi).lower(*args).as_text(debug_info=True)
+    assert "render/homographies" in txt
+    assert "render/warp_composite_scan" in txt
+
+  def test_profiler_trace_writes(self, rng, tmp_path):
+    logdir = str(tmp_path / "trace")
+    with debug.trace(logdir):
+      out = jax.jit(jnp.sin)(jnp.arange(8.0))
+      jax.block_until_ready(out)
+    found = [os.path.join(r, f) for r, _, fs in os.walk(logdir) for f in fs]
+    assert found, "profiler trace produced no files"

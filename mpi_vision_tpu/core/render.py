@@ -156,12 +156,13 @@ def render_mpi(
             "separability; pass separable=True/False explicitly (with "
             "check=False) or jit method='scan'/'fused' instead.")
       separable = render_pallas.is_separable(homs)
-    planar = jnp.moveaxis(planes, -1, 2)                   # [P, B, 4, H, W]
+    # One batched kernel launch for the whole batch (batch grid axis).
+    batched = jnp.moveaxis(jnp.moveaxis(planes, -1, 2), 1, 0)  # [B,P,4,H,W]
     plan_kw = {} if plan is _PLAN_UNSET else {"plan": plan}
-    outs = [render_pallas.render_mpi_fused(
-        planar[:, b], homs[:, b], separable, check=check, **plan_kw)
-            for b in range(planar.shape[1])]
-    return jnp.stack([jnp.moveaxis(o, 0, -1) for o in outs])
+    out = render_pallas.render_mpi_fused(
+        batched, jnp.moveaxis(homs, 1, 0), separable, check=check,
+        **plan_kw)                                             # [B, 3, H, W]
+    return jnp.moveaxis(out, 1, -1)
 
   with jax.named_scope("render/homographies"):
     homs = plane_homographies(tgt_pose, depths, intrinsics)  # [P, B, 3, 3]

@@ -8,8 +8,9 @@ eps constants (utils.py:36-38, 391 — both preserved in ``core.geometry`` /
     step) with ``jax.experimental.checkify`` float checks, so NaN/inf
     produced ANYWHERE inside raises a Python error with a located message
     instead of silently poisoning downstream pixels/gradients.
-  * ``trace(logdir)`` — ``jax.profiler`` trace context for capturing a
-    device profile of a render/train region (view in TensorBoard/XProf).
+  * ``trace(logdir)`` — re-export of ``jax.profiler.trace``: a trace
+    context capturing a device profile of a render/train region (view in
+    TensorBoard/XProf).
   * ``named_scope`` — re-export of ``jax.named_scope``; the core pipelines
     annotate their stages with it so profiles and HLO dumps read as
     ``render/warp``, ``render/composite``, ``loss/vgg`` instead of a flat
@@ -18,7 +19,6 @@ eps constants (utils.py:36-38, 391 — both preserved in ``core.geometry`` /
 
 from __future__ import annotations
 
-import contextlib
 import functools
 from typing import Callable
 
@@ -26,6 +26,9 @@ import jax
 from jax.experimental import checkify
 
 named_scope = jax.named_scope
+# Profiler trace context (start_trace/stop_trace around the region; remember
+# to block_until_ready the region's outputs inside it).
+trace = jax.profiler.trace
 
 
 def checked(fn: Callable, errors=checkify.float_checks) -> Callable:
@@ -49,17 +52,3 @@ def checked(fn: Callable, errors=checkify.float_checks) -> Callable:
     return out
 
   return wrapper
-
-
-@contextlib.contextmanager
-def trace(logdir: str):
-  """Capture a ``jax.profiler`` device trace of the enclosed region.
-
-  Remember to ``jax.block_until_ready`` the region's outputs inside the
-  context, or the trace ends before the device work does.
-  """
-  jax.profiler.start_trace(logdir)
-  try:
-    yield
-  finally:
-    jax.profiler.stop_trace()

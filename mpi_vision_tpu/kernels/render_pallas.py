@@ -143,7 +143,9 @@ def _separable_kernel(hom_ref, planes_ref, out_ref, band_ref, acc_ref, sems,
   x-taps, so each gather serves the whole strip, and the vertical 2-tap lerp
   for the full [8, CHUNK] tile is one small MXU matmul
   ``KY[8, BAND] @ xle[BAND, CHUNK]``. Band DMAs are double-buffered across
-  grid steps.
+  grid steps. The leading grid axis is the batch (one MPI + pose set per
+  entry — the whole batch is ONE kernel launch); the composite accumulator
+  resets at each entry's first plane.
 
   ``n_windows`` (static: 2 or 3) is the per-chunk gather-window count, all
   unconditional — branchless beats ``lax.cond`` here (a scalar cond in the
@@ -151,33 +153,38 @@ def _separable_kernel(hom_ref, planes_ref, out_ref, band_ref, acc_ref, sems,
   callers auto-select it from the concrete homographies (2 suffices for
   horizontal scale <= 1.0 at ANY alignment; 3 guarantees scale <= ~2.0).
   """
-  s = pl.program_id(0)
-  p = pl.program_id(1)
-  step = s * num_planes + p
-  total = pl.num_programs(0) * num_planes
+  bi = pl.program_id(0)
+  s = pl.program_id(1)
+  p = pl.program_id(2)
+  n_s = pl.num_programs(1)
+  step = (bi * n_s + s) * num_planes + p
+  total = pl.num_programs(0) * n_s * num_planes
   slot = jax.lax.rem(step, 2)
-  hom = [hom_ref[p, k] for k in range(9)]
+  hom = [hom_ref[bi, p, k] for k in range(9)]
   oy0 = (s * STRIP).astype(jnp.float32)
   ymin = _ymin_of(hom, oy0, height, width)
 
   @pl.when(step == 0)
   def _first_dma():
     pltpu.make_async_copy(
-        planes_ref.at[p, :, pl.ds(ymin, BAND), :],
+        planes_ref.at[bi, p, :, pl.ds(ymin, BAND), :],
         band_ref.at[0], sems.at[0]).start()
 
   pltpu.make_async_copy(
-      planes_ref.at[p, :, pl.ds(ymin, BAND), :],
+      planes_ref.at[bi, p, :, pl.ds(ymin, BAND), :],
       band_ref.at[slot], sems.at[slot]).wait()
 
   @pl.when(step < total - 1)
   def _next_dma():
-    p_n = jnp.where(p + 1 < num_planes, p + 1, 0)
-    s_n = jnp.where(p + 1 < num_planes, s, s + 1)
-    hom_n = [hom_ref[p_n, k] for k in range(9)]
+    same_strip = p + 1 < num_planes
+    p_n = jnp.where(same_strip, p + 1, 0)
+    s_wrap = jnp.where(s + 1 < n_s, s + 1, 0)
+    s_n = jnp.where(same_strip, s, s_wrap)
+    b_n = jnp.where(same_strip | (s + 1 < n_s), bi, bi + 1)
+    hom_n = [hom_ref[b_n, p_n, k] for k in range(9)]
     ymin_n = _ymin_of(hom_n, (s_n * STRIP).astype(jnp.float32), height, width)
     pltpu.make_async_copy(
-        planes_ref.at[p_n, :, pl.ds(ymin_n, BAND), :],
+        planes_ref.at[b_n, p_n, :, pl.ds(ymin_n, BAND), :],
         band_ref.at[1 - slot], sems.at[1 - slot]).start()
 
   # v depends only on the row: KY[r, q] = relu(1 - |v_r - (ymin + q)|) is the
@@ -301,36 +308,40 @@ def _shared_kernel(hom_ref, meta_ref, meta_next_ref, wq_ref, planes_ref,
   side mirror of the table math for the envelope/fallback decision and
   the static (n_taps, n_windows) choice.
   """
-  s = pl.program_id(0)
-  t = pl.program_id(1)
-  p = pl.program_id(2)
-  n_t = pl.num_programs(1)
-  step = (s * n_t + t) * num_planes + p
-  total = pl.num_programs(0) * n_t * num_planes
+  bi = pl.program_id(0)
+  s = pl.program_id(1)
+  t = pl.program_id(2)
+  p = pl.program_id(3)
+  n_s = pl.num_programs(1)
+  n_t = pl.num_programs(2)
+  step = ((bi * n_s + s) * n_t + t) * num_planes + p
+  total = pl.num_programs(0) * n_s * n_t * num_planes
   slot = jax.lax.rem(step, 2)
-  hom = [hom_ref[p, k] for k in range(9)]
+  hom = [hom_ref[bi, p, k] for k in range(9)]
   c_t = tw // CHUNK
-  ymin = pl.multiple_of(meta_ref[0, 0, 0, p], 8)
-  xmin = pl.multiple_of(meta_ref[0, 0, 1, p], WIN)
+  ymin = pl.multiple_of(meta_ref[0, 0, 0, 0, p], 8)
+  xmin = pl.multiple_of(meta_ref[0, 0, 0, 1, p], WIN)
 
   @pl.when(step == 0)
   def _first_dma():
     pltpu.make_async_copy(
-        planes_ref.at[p, :, pl.ds(ymin, bandg), pl.ds(xmin, tsrc)],
+        planes_ref.at[bi, p, :, pl.ds(ymin, bandg), pl.ds(xmin, tsrc)],
         band_ref.at[0], sems.at[0]).start()
 
   pltpu.make_async_copy(
-      planes_ref.at[p, :, pl.ds(ymin, bandg), pl.ds(xmin, tsrc)],
+      planes_ref.at[bi, p, :, pl.ds(ymin, bandg), pl.ds(xmin, tsrc)],
       band_ref.at[slot], sems.at[slot]).wait()
 
   @pl.when(step < total - 1)
   def _next_dma():
     same_tile = p + 1 < num_planes
     p_n = jnp.where(same_tile, p + 1, 0)
-    ymin_n = pl.multiple_of(meta_next_ref[0, 0, 0, p_n], 8)
-    xmin_n = pl.multiple_of(meta_next_ref[0, 0, 1, p_n], WIN)
+    last_tile = (t + 1 >= n_t) & (s + 1 >= n_s)
+    b_n = jnp.where(same_tile | ~last_tile, bi, bi + 1)
+    ymin_n = pl.multiple_of(meta_next_ref[0, 0, 0, 0, p_n], 8)
+    xmin_n = pl.multiple_of(meta_next_ref[0, 0, 0, 1, p_n], WIN)
     pltpu.make_async_copy(
-        planes_ref.at[p_n, :, pl.ds(ymin_n, bandg), pl.ds(xmin_n, tsrc)],
+        planes_ref.at[b_n, p_n, :, pl.ds(ymin_n, bandg), pl.ds(xmin_n, tsrc)],
         band_ref.at[1 - slot], sems.at[1 - slot]).start()
 
   lane = jax.lax.broadcasted_iota(jnp.int32, (STRIP, tw), 1).astype(jnp.float32)
@@ -341,8 +352,8 @@ def _shared_kernel(hom_ref, meta_ref, meta_next_ref, wq_ref, planes_ref,
   v = jnp.where(jnp.isfinite(v), v, 0.0)
 
   for ci in range(c_t):
-    w0 = pl.multiple_of(wq_ref[0, 0, p, ci * 2], WIN)
-    q0 = pl.multiple_of(wq_ref[0, 0, p, ci * 2 + 1], 8)
+    w0 = pl.multiple_of(wq_ref[0, 0, 0, p, ci * 2], WIN)
+    q0 = pl.multiple_of(wq_ref[0, 0, 0, p, ci * 2 + 1], 8)
     sl = slice(ci * CHUNK, (ci + 1) * CHUNK)
     usl = u[:, sl]                                           # [STRIP, CHUNK]
     vsl = v[:, sl]
@@ -507,7 +518,9 @@ def _shared_tables(homs: jnp.ndarray, height: int, width: int,
     jax.jit, static_argnames=("n_taps", "n_windows", "interpret"))
 def _shared_call(planes: jnp.ndarray, homs: jnp.ndarray,
                  n_taps: int, n_windows: int, interpret: bool) -> jnp.ndarray:
-  num_planes, _, height, width = planes.shape
+  """Shared-gather kernel call on a batch ``[B, P, 4, H, W]`` (one launch
+  for the whole batch)."""
+  batch, num_planes, _, height, width = planes.shape
   if height % STRIP or width % CHUNK:
     raise ValueError(
         f"H must be a multiple of {STRIP} and W of {CHUNK}; got "
@@ -517,16 +530,22 @@ def _shared_call(planes: jnp.ndarray, homs: jnp.ndarray,
   tw, tsrc, bandg, n_eff = _tile_sizes(height, width, n_windows)
   c_t = tw // CHUNK
   n_strips, n_tiles = height // STRIP, width // tw
-  homs32 = homs.reshape(num_planes, 9).astype(jnp.float32)
-  meta, wq = _shared_tables(homs32, height, width, tw, tsrc, bandg, n_eff)
+  homs32 = homs.reshape(batch, num_planes, 9).astype(jnp.float32)
+  meta, wq = jax.vmap(
+      lambda h: _shared_tables(h, height, width, tw, tsrc, bandg, n_eff)
+  )(homs32)                          # [B, S, T, 2, P], [B, S, T, P, 2c]
 
-  def next_index(s, t, p):
-    # The (s, t, p) grid steps with p innermost; clamp at the final step.
+  def next_index(b, s, t, p):
+    # The (b, s, t, p) grid steps with p innermost; clamp at the final step.
     same_tile = p + 1 < num_planes
     t_n = jnp.where(same_tile, t, jnp.where(t + 1 < n_tiles, t + 1, 0))
-    s_n = jnp.minimum(
-        jnp.where(same_tile | (t + 1 < n_tiles), s, s + 1), n_strips - 1)
-    return s_n, t_n, 0, 0
+    s_roll = jnp.where(t + 1 < n_tiles, s,
+                       jnp.where(s + 1 < n_strips, s + 1, 0))
+    s_n = jnp.where(same_tile, s, s_roll)
+    last_tile = (t + 1 >= n_tiles) & (s + 1 >= n_strips)
+    b_n = jnp.minimum(
+        jnp.where(same_tile | ~last_tile, b, b + 1), batch - 1)
+    return b_n, s_n, t_n, 0, 0
 
   kernel = functools.partial(
       _shared_kernel, num_planes=num_planes, height=height, width=width,
@@ -534,28 +553,29 @@ def _shared_call(planes: jnp.ndarray, homs: jnp.ndarray,
       bandg=bandg)
   return pl.pallas_call(
       kernel,
-      grid=(n_strips, n_tiles, num_planes),
+      grid=(batch, n_strips, n_tiles, num_planes),
       in_specs=[
-          pl.BlockSpec(memory_space=pltpu.SMEM),   # [P, 9] homographies
-          pl.BlockSpec((1, 1, 2, num_planes), lambda s, t, p: (s, t, 0, 0),
+          pl.BlockSpec(memory_space=pltpu.SMEM),   # [B, P, 9] homographies
+          pl.BlockSpec((1, 1, 1, 2, num_planes),
+                       lambda b, s, t, p: (b, s, t, 0, 0),
                        memory_space=pltpu.SMEM),   # meta (this step's tile)
-          pl.BlockSpec((1, 1, 2, num_planes), next_index,
+          pl.BlockSpec((1, 1, 1, 2, num_planes), next_index,
                        memory_space=pltpu.SMEM),   # meta (next step's tile)
-          pl.BlockSpec((1, 1, num_planes, 2 * c_t),
-                       lambda s, t, p: (s, t, 0, 0),
+          pl.BlockSpec((1, 1, 1, num_planes, 2 * c_t),
+                       lambda b, s, t, p: (b, s, t, 0, 0),
                        memory_space=pltpu.SMEM),   # per-chunk w0/q0
-          pl.BlockSpec(memory_space=pl.ANY),       # [P, 4, H, W] planes (HBM)
+          pl.BlockSpec(memory_space=pl.ANY),       # [B, P, 4, H, W] (HBM)
       ],
       out_specs=pl.BlockSpec(
-          (1, 3, STRIP, tw), lambda s, t, p: (0, 0, s, t)),
-      out_shape=jax.ShapeDtypeStruct((1, 3, height, width), jnp.float32),
+          (1, 3, STRIP, tw), lambda b, s, t, p: (b, 0, s, t)),
+      out_shape=jax.ShapeDtypeStruct((batch, 3, height, width), jnp.float32),
       scratch_shapes=[
           pltpu.VMEM((2, 4, bandg, tsrc), jnp.float32),
           pltpu.VMEM((3, STRIP, tw), jnp.float32),
           pltpu.SemaphoreType.DMA((2,)),
       ],
       interpret=interpret,
-  )(homs32, meta, meta, wq, planes.astype(jnp.float32))[0]
+  )(homs32, meta, meta, wq, planes.astype(jnp.float32))
 
 
 def is_separable(homs, atol: float = 1e-6) -> bool:
@@ -581,7 +601,8 @@ def fits_envelope(homs, height: int, width: int,
   exact for projective maps whose denominator keeps one sign over the image
   (checked); sign-changing denominators reject. For general homographies,
   delegates to ``_plan_shared`` (the shared-gather kernel's envelope).
-  ``homs`` must be concrete ([P, 3, 3]).
+  ``homs`` must be concrete; leading batch axes flatten into the plane axis
+  ([P, 3, 3] or [B, P, 3, 3]).
   """
   auto = separable is None
   if auto:
@@ -655,8 +676,8 @@ def _plan_shared_stats(homs: jnp.ndarray, height: int, width: int):
   1080p x 32 planes (the per-column [P, S, W] arrays); on-device it is
   sub-millisecond and its floors see the very f32 values the tables use.
   """
-  p = homs.shape[0]
-  h9 = homs.reshape(p, 3, 3).astype(jnp.float32)
+  h9 = homs.reshape(-1, 3, 3).astype(jnp.float32)
+  p = h9.shape[0]
   cx = jnp.array([0.0, width - 1.0], jnp.float32)
   cy = jnp.array([0.0, height - 1.0], jnp.float32)
   d_flat = (h9[:, 2, 0, None, None] * cx[None, :, None]
@@ -744,8 +765,9 @@ def _plan_shared(homs, height: int, width: int):
   at 3) and the minimal window count (2 or 3) whose coverage holds, or
   returns None (caller falls back to XLA) when the pose is outside the
   envelope or a homography denominator changes sign over the image (poles
-  break the monotonicity the extrema rely on). ``homs`` must be concrete
-  ([P, 3, 3]).
+  break the monotonicity the extrema rely on). ``homs`` must be concrete;
+  leading batch axes flatten into the plane axis ([P, 3, 3] or
+  [B, P, 3, 3] — the plan covers every entry).
 
   Precision: the stats run in f32 with the same formulas (and helpers) as
   the device tables, so plan and tables see identical values up to XLA op
@@ -790,9 +812,9 @@ def _sep_tap_extents(h, width: int):
 @functools.partial(jax.jit, static_argnames=("n_windows", "interpret"))
 def _fused_call(planes: jnp.ndarray, homs: jnp.ndarray, n_windows: int,
                 interpret: bool) -> jnp.ndarray:
-  """Separable-path kernel call; general homographies go through
-  ``_shared_call``."""
-  num_planes, _, height, width = planes.shape
+  """Separable-path kernel call on a batch ``[B, P, 4, H, W]`` (one launch
+  for the whole batch); general homographies go through ``_shared_call``."""
+  batch, num_planes, _, height, width = planes.shape
   if height % STRIP or width % CHUNK:
     raise ValueError(
         f"H must be a multiple of {STRIP} and W of {CHUNK}; got "
@@ -804,30 +826,31 @@ def _fused_call(planes: jnp.ndarray, homs: jnp.ndarray, n_windows: int,
   kernel = functools.partial(
       _separable_kernel, num_planes=num_planes, height=height, width=width,
       n_windows=min(n_windows, width // WIN))
-  band_shape, sems = (2, 4, BAND, width), pltpu.SemaphoreType.DMA((2,))
   return pl.pallas_call(
       kernel,
-      grid=(height // STRIP, num_planes),
+      grid=(batch, height // STRIP, num_planes),
       in_specs=[
-          pl.BlockSpec(memory_space=pltpu.SMEM),   # [P, 9] homographies
-          pl.BlockSpec(memory_space=pl.ANY),       # [P, 4, H, W] planes (HBM)
+          pl.BlockSpec(memory_space=pltpu.SMEM),   # [B, P, 9] homographies
+          pl.BlockSpec(memory_space=pl.ANY),       # [B, P, 4, H, W] (HBM)
       ],
-      out_specs=pl.BlockSpec((1, 3, STRIP, width), lambda s, p: (0, 0, s, 0)),
-      out_shape=jax.ShapeDtypeStruct((1, 3, height, width), jnp.float32),
+      out_specs=pl.BlockSpec((1, 3, STRIP, width),
+                             lambda b, s, p: (b, 0, s, 0)),
+      out_shape=jax.ShapeDtypeStruct((batch, 3, height, width), jnp.float32),
       scratch_shapes=[
-          pltpu.VMEM(band_shape, jnp.float32),
+          pltpu.VMEM((2, 4, BAND, width), jnp.float32),
           pltpu.VMEM((3, STRIP, width), jnp.float32),
-          sems,
+          pltpu.SemaphoreType.DMA((2,)),
       ],
       interpret=interpret,
-  )(homs.reshape(num_planes, 9).astype(jnp.float32),
-    planes.astype(jnp.float32))[0]
+  )(homs.reshape(batch, num_planes, 9).astype(jnp.float32),
+    planes.astype(jnp.float32))
 
 
 def reference_render(planes: jnp.ndarray, homs: jnp.ndarray) -> jnp.ndarray:
   """XLA gather-path render with the kernel's pixel-space contract.
 
   Used as the numerical oracle in tests and as the VJP of the fused kernel.
+  ``planes`` ``[P, 4, H, W]``, ``homs`` ``[P, 3, 3]``.
   """
   _, _, h, w = planes.shape
   nhwc = jnp.moveaxis(planes, 1, -1)[:, None]            # [P, 1, H, W, 4]
@@ -839,6 +862,11 @@ def reference_render(planes: jnp.ndarray, homs: jnp.ndarray) -> jnp.ndarray:
   warped = sampling.bilinear_sample(nhwc, coords)
   out = compose.over_composite_scan(warped)              # [1, H, W, 3]
   return jnp.moveaxis(out[0], -1, 0)
+
+
+# Batched oracle [B, P, 4, H, W] x [B, P, 3, 3] -> [B, 3, H, W]: the VJP of
+# both fused kernels and the fallback for batched out-of-envelope calls.
+_reference_render_batch = jax.vmap(reference_render)
 
 
 def _make_fused(n_windows: int):
@@ -853,7 +881,7 @@ def _make_fused(n_windows: int):
 
   def bwd(res, g):
     planes, homs = res
-    _, vjp = jax.vjp(reference_render, planes, homs)
+    _, vjp = jax.vjp(_reference_render_batch, planes, homs)
     return vjp(g)
 
   fused.defvjp(fwd, bwd)
@@ -875,7 +903,7 @@ def _make_shared(n_taps: int, n_windows: int):
 
   def bwd(res, g):
     planes, homs = res
-    _, vjp = jax.vjp(reference_render, planes, homs)
+    _, vjp = jax.vjp(_reference_render_batch, planes, homs)
     return vjp(g)
 
   shared.defvjp(fwd, bwd)
@@ -886,7 +914,7 @@ _SHARED = {(tt, n): _make_shared(tt, n) for tt in (2, 3) for n in (2, 3)}
 
 # Jitted fallback: the eager reference path materializes per-op temporaries
 # (several GB at 1080p x 32 planes); under jit XLA schedules them.
-_reference_render_jit = jax.jit(reference_render)
+_reference_render_jit = jax.jit(_reference_render_batch)
 
 
 def _sep_windows_needed(homs, height: int, width: int) -> int:
@@ -918,9 +946,14 @@ def render_mpi_fused(planes: jnp.ndarray, homs: jnp.ndarray,
   """Render an MPI to a novel view in one fused TPU kernel.
 
   Args:
-    planes: ``[P, 4, H, W]`` planar RGBA MPI, back-to-front.
+    planes: ``[P, 4, H, W]`` planar RGBA MPI, back-to-front — or a batch
+      ``[B, P, 4, H, W]`` (one MPI + pose per entry), rendered as ONE
+      kernel launch with a batch grid axis (the kernel-variant and
+      envelope decisions are made once over the whole batch's
+      homographies).
     homs: ``[P, 3, 3]`` target-pixel -> source-pixel homographies
-      (``pixel_homographies(...)[:, b]`` for batch entry b).
+      (``pixel_homographies(...)[:, b]`` for batch entry b); ``[B, P, 3,
+      3]`` when batched.
     separable: static flag selecting the separable fast path; only valid
       when ``is_separable(homs)`` (axis-aligned warps, e.g. any pure camera
       translation/zoom). The result is identical either way; the fast path
@@ -948,9 +981,17 @@ def render_mpi_fused(planes: jnp.ndarray, homs: jnp.ndarray,
       ``check=True`` fallback.
 
   Returns:
-    ``[3, H, W]`` rendered view, float32.
+    ``[3, H, W]`` rendered view, float32 (``[B, 3, H, W]`` when batched).
   """
-  _, _, height, width = planes.shape
+  single = planes.ndim == 4
+  if single:
+    planes, homs = planes[None], homs[None]
+  out = _render_mpi_fused_batch(planes, homs, separable, check, plan)
+  return out[0] if single else out
+
+
+def _render_mpi_fused_batch(planes, homs, separable, check, plan):
+  _, _, _, height, width = planes.shape
   if height % STRIP or width % CHUNK:
     raise ValueError(
         f"H must be a multiple of {STRIP} and W of {CHUNK}; got "

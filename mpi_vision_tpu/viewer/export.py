@@ -84,13 +84,25 @@ const q = new URLSearchParams(location.search);
 let mpiSources = embeddedSources;
 if (q.get("url") && q.get("n")) {
   const n = +q.get("n");
-  mpiSources = [];
-  for (let i = 0; i < n; i++) {
-    mpiSources.push(q.get("url").replace("$$", String(i).padStart(2, "0")));
+  if (Number.isInteger(n) && n > 0) {
+    mpiSources = [];
+    for (let i = 0; i < n; i++) {
+      mpiSources.push(q.get("url").replace("$$", String(i).padStart(2, "0")));
+    }
+  } else {
+    console.warn(`ignoring ?url: n=${q.get("n")} is not a positive integer`);
   }
 }
 for (const k of ["near", "far", "fov", "depth", "mini", "solo"]) {
-  if (q.get(k) !== null) cfg[k] = +q.get(k);
+  if (q.get(k) !== null) {
+    const v = +q.get(k);
+    // near/far/fov must be finite AND positive (1/near, tan(fov/2) blow up
+    // at 0); a bad value falls back to the embedded default with a warning.
+    const ok = Number.isFinite(v)
+        && (!["near", "far", "fov"].includes(k) || v > 0);
+    if (ok) cfg[k] = v;
+    else console.warn(`ignoring ?${k}=${q.get(k)}`);
+  }
 }
 if (q.get("move")) cfg.move = q.get("move");
 

@@ -1,0 +1,95 @@
+"""Train-step timing at the reference's configs (VERDICT r2 item 10).
+
+Times the jitted VGG-perceptual train step (renderer inside the backward
+pass) at the notebook's two published configs — 224^2 x 10 planes
+(40-41 s/epoch over 150 scenes on the reference's Colab GPU, i.e.
+~0.27 s/step) and the cell-7 "also works" 480^2 x 33 planes
+(~6 min/epoch, ~2.4 s/step) — to decide with numbers whether the
+XLA-gather backward through the renderer needs a Pallas backward kernel.
+
+Emits one JSON line per config with seconds/step and vs_baseline =
+reference_step_seconds / ours (>= 1.0 means we beat the Colab GPU).
+
+Usage: python bench/train_speed.py [--steps 8]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(1, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+from _common import emit, log
+
+# Reference wall-times (BASELINE.md): 40.5 s / 150 scenes and 360 s / 150.
+REF_STEP_S = {224: 40.5 / 150.0, 480: 360.0 / 150.0}
+
+
+def _batch(rng, hw: int, p: int):
+  pose = np.eye(4, dtype=np.float32)
+  pose[0, 3] = 0.05
+  return {
+      "net_input": rng.uniform(-1, 1, (1, hw, hw, 3 + 3 * p)).astype(
+          np.float32),
+      "ref_img": rng.uniform(-1, 1, (1, hw, hw, 3)).astype(np.float32),
+      "tgt_img": rng.uniform(-1, 1, (1, hw, hw, 3)).astype(np.float32),
+      "tgt_img_cfw": pose[None],
+      "ref_img_wfc": np.eye(4, dtype=np.float32)[None],
+      "intrinsics": np.asarray(
+          [[[hw / 2.0, 0, hw / 2.0], [0, hw / 2.0, hw / 2.0], [0, 0, 1]]],
+          np.float32),
+  }
+
+
+def time_config(hw: int, planes: int, steps: int) -> float:
+  import jax
+  import jax.numpy as jnp
+
+  from mpi_vision_tpu import config
+  from mpi_vision_tpu.core.camera import inv_depths
+
+  cfg = config.TrainConfig(
+      data=config.DataConfig(img_size=hw, num_planes=planes))
+  state = cfg.make_train_state(jax.random.PRNGKey(0))
+  step = cfg.make_train_step()        # default VGG weights, resize 224
+  rng = np.random.default_rng(0)
+  batch = {k: jnp.asarray(v) for k, v in _batch(rng, hw, planes).items()}
+  batch["mpi_planes"] = inv_depths(
+      cfg.data.depth_near, cfg.data.depth_far, planes)
+
+  state, metrics = step(state, batch)         # compile + warm-up
+  jax.block_until_ready(metrics["loss"])
+  t0 = time.perf_counter()
+  for _ in range(steps):
+    state, metrics = step(state, batch)
+  jax.block_until_ready(metrics["loss"])
+  return (time.perf_counter() - t0) / steps
+
+
+def main() -> None:
+  ap = argparse.ArgumentParser()
+  ap.add_argument("--steps", type=int, default=8)
+  args = ap.parse_args()
+
+  import jax
+
+  on_tpu = jax.default_backend() == "tpu"
+  log(f"backend={jax.default_backend()}")
+  configs = [(224, 10), (480, 33)] if on_tpu else [(64, 4)]
+  for hw, planes in configs:
+    sec = time_config(hw, planes, args.steps)
+    ref = REF_STEP_S.get(hw)
+    log(f"{hw}^2 x {planes} planes: {sec * 1e3:.0f} ms/step"
+        + (f" (reference Colab GPU ~{ref * 1e3:.0f} ms)" if ref else ""))
+    emit(f"train_step_{hw}px_{planes}planes_seconds", sec, "s/step",
+         (ref / sec) if ref else 1.0, img_size=hw, planes=planes)
+
+
+if __name__ == "__main__":
+  main()

@@ -1,0 +1,93 @@
+"""Dataclass configs with the reference's hyperparameters as THE defaults.
+
+The reference has no config system — every hyperparameter is a literal in
+the notebook (SURVEY.md §5.6): ``img_size=224, num_planes=10`` (cell
+8:89-90), plane depths 1 -> 100 (cell 8:73), triplet window ``min_dist=16e3,
+max_dist=500e3`` (cell 8:13), ``lr=2e-4`` + 20 epochs + bs=1 (cells 15/16),
+VGG-loss resize 224 (cell 12). These dataclasses collect them in one place
+so parity runs are zero-config (``TrainConfig()`` IS the reference setup)
+and scaled runs change one field (e.g. the "also works" 480px/33-plane
+config from cell 7's markdown is ``TrainConfig.scaled_480()``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class DataConfig:
+  """RealEstate10K-reduced pipeline (notebook cells 6/8)."""
+
+  dataset_path: str = "."
+  img_size: int = 224            # cell 8:89
+  num_planes: int = 10           # cell 8:90
+  depth_near: float = 1.0        # cell 8:73
+  depth_far: float = 100.0       # cell 8:73
+  min_dist: float = 16e3         # cell 8:13
+  max_dist: float = 500e3        # cell 8:13
+  batch_size: int = 1            # cell 8:97 (paper/InstanceNorm choice)
+
+  def make_dataset(self, is_valid: bool = False, rng=None):
+    import numpy as np
+
+    from mpi_vision_tpu.data.realestate import RealEstateDataset
+
+    return RealEstateDataset(
+        self.dataset_path, is_valid=is_valid, min_dist=self.min_dist,
+        max_dist=self.max_dist, img_size=self.img_size,
+        num_planes=self.num_planes,
+        rng=rng if rng is not None else np.random.default_rng())
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+  """The reference training run (cells 14-16): Adam lr 2e-4, 20 epochs,
+  VGG-perceptual loss with resize 224."""
+
+  data: DataConfig = DataConfig()
+  learning_rate: float = 2e-4    # cell 15 md / cell 16
+  epochs: int = 20               # cell 16
+  vgg_resize: int | None = 224   # cell 12:50-52
+  norm: str | None = "instance"  # cell 10 (ConvLayer InstanceNorm)
+
+  @classmethod
+  def scaled_480(cls) -> "TrainConfig":
+    """The cell-7 markdown's larger config: 480 px, 33 planes (~6 min/epoch
+    on the reference's Colab GPU)."""
+    return cls(data=DataConfig(img_size=480, num_planes=33))
+
+  def make_train_state(self, rng_key):
+    from mpi_vision_tpu.train.loop import create_train_state
+
+    return create_train_state(
+        rng_key, num_planes=self.data.num_planes,
+        image_size=(self.data.img_size, self.data.img_size),
+        learning_rate=self.learning_rate, norm=self.norm)
+
+  def make_train_step(self, vgg_params="default"):
+    """Jitted train step with the reference loss. ``vgg_params='default'``
+    resolves ``train.vgg.default_params()`` (a real checkpoint when
+    ``MPI_VISION_VGG16_CKPT`` points at one, else the fixed fallback);
+    pass ``None`` for the L2-only metric loss."""
+    from mpi_vision_tpu.train import vgg
+    from mpi_vision_tpu.train.loop import make_train_step
+
+    if isinstance(vgg_params, str) and vgg_params == "default":
+      vgg_params = vgg.default_params()
+    return make_train_step(vgg_params, resize=self.vgg_resize)
+
+
+@dataclasses.dataclass(frozen=True)
+class RenderConfig:
+  """Novel-view rendering defaults (the BASELINE north-star shape)."""
+
+  num_planes: int = 32
+  depth_near: float = 1.0
+  depth_far: float = 100.0
+  fov_deg: float = 60.0          # the viewer default (template:641-686)
+
+  def depths(self):
+    from mpi_vision_tpu.core.camera import inv_depths
+
+    return inv_depths(self.depth_near, self.depth_far, self.num_planes)

@@ -1,0 +1,59 @@
+"""Config system tests (SURVEY.md §5.6): the defaults ARE the reference."""
+
+import dataclasses
+
+import jax
+import numpy as np
+
+from mpi_vision_tpu import config
+
+
+def test_reference_defaults():
+  c = config.TrainConfig()
+  assert c.data.img_size == 224 and c.data.num_planes == 10
+  assert (c.data.depth_near, c.data.depth_far) == (1.0, 100.0)
+  assert (c.data.min_dist, c.data.max_dist) == (16e3, 500e3)
+  assert c.data.batch_size == 1
+  assert c.learning_rate == 2e-4 and c.epochs == 20
+  assert c.vgg_resize == 224
+
+
+def test_scaled_480():
+  c = config.TrainConfig.scaled_480()
+  assert c.data.img_size == 480 and c.data.num_planes == 33
+  assert c.learning_rate == 2e-4  # only the data shape changes
+
+
+def test_frozen():
+  import pytest
+  with pytest.raises(dataclasses.FrozenInstanceError):
+    config.TrainConfig().learning_rate = 1.0
+
+
+def test_make_train_state_and_step(rng):
+  c = config.TrainConfig(
+      data=config.DataConfig(img_size=32, num_planes=4), vgg_resize=None)
+  state = c.make_train_state(jax.random.PRNGKey(0))
+  step = c.make_train_step(vgg_params=None)   # L2 metric loss
+  hw, p = 32, 4
+  pose = np.eye(4, dtype=np.float32)
+  batch = {
+      "net_input": np.asarray(
+          rng.uniform(-1, 1, (1, hw, hw, 3 + 3 * p)), np.float32),
+      "ref_img": np.asarray(rng.uniform(-1, 1, (1, hw, hw, 3)), np.float32),
+      "tgt_img": np.asarray(rng.uniform(-1, 1, (1, hw, hw, 3)), np.float32),
+      "tgt_img_cfw": pose[None],
+      "ref_img_wfc": pose[None],
+      "intrinsics": np.asarray(
+          [[[16.0, 0, 16], [0, 16.0, 16], [0, 0, 1]]], np.float32),
+      "mpi_planes": np.asarray(config.RenderConfig(num_planes=p).depths()),
+  }
+  state2, metrics = step(state, batch)
+  assert np.isfinite(float(metrics["loss"]))
+  assert int(state2.step) == 1
+
+
+def test_render_config_depths_descending():
+  d = np.asarray(config.RenderConfig().depths())
+  assert d.shape == (32,) and (np.diff(d) < 0).all()
+  assert d[0] == 100.0 and d[-1] == 1.0

@@ -1,0 +1,7 @@
+"""``python -m mpi_vision_tpu`` — see cli.py."""
+
+import sys
+
+from mpi_vision_tpu.cli import main
+
+sys.exit(main())

@@ -1,0 +1,169 @@
+"""Command-line entry points: ``python -m mpi_vision_tpu <command>``.
+
+The reference's workflow lives in a notebook (train cells 14-16, viewer
+export cell 18). These commands make the same flow scriptable:
+
+  * ``train`` — train the stereo-magnification model on a RealEstate10K-
+    layout dataset (or ``--synthetic`` for the hermetic procedural scenes)
+    with the reference hyperparameters (``config.TrainConfig``), optionally
+    checkpointing (orbax) and exporting a viewer HTML of a validation MPI.
+  * ``export-viewer`` — render a baked PNG MPI directory (e.g. the
+    reference's ``test/rgba_*.png``) into the standalone HTML viewer.
+
+Both print a one-line JSON summary on stdout (diagnostics on stderr).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+
+def _log(msg: str) -> None:
+  print(msg, file=sys.stderr, flush=True)
+
+
+def cmd_train(args: argparse.Namespace) -> dict:
+  import jax
+  import jax.numpy as jnp
+  import numpy as np
+
+  from mpi_vision_tpu import config
+  from mpi_vision_tpu.data import realestate
+  from mpi_vision_tpu.train import loop as train_loop
+
+  root = args.dataset
+  tmp_holder = None
+  if args.synthetic:
+    if root == ".":
+      # No explicit destination: use a temp dir cleaned up at exit.
+      import atexit
+
+      tmp_holder = tempfile.TemporaryDirectory(prefix="mpi_synth_")
+      atexit.register(tmp_holder.cleanup)
+      root = tmp_holder.name
+    realestate.synthesize_dataset(
+        root, num_scenes=args.synthetic_scenes, frames=4,
+        img_size=args.img_size, seed=0)
+    _log(f"synthesized dataset at {root}")
+
+  cfg = config.TrainConfig(
+      data=config.DataConfig(dataset_path=root, img_size=args.img_size,
+                             num_planes=args.num_planes),
+      learning_rate=args.lr, epochs=args.epochs,
+      vgg_resize=args.vgg_resize if args.vgg_resize > 0 else None)
+  dataset = cfg.data.make_dataset(rng=np.random.default_rng(args.seed))
+  state = cfg.make_train_state(jax.random.PRNGKey(args.seed))
+  step = cfg.make_train_step("default" if args.vgg_loss else None)
+
+  order = np.random.default_rng(args.seed + 1)
+  t0 = time.time()
+  all_losses = []
+  for epoch in range(cfg.epochs):
+    state, losses = train_loop.fit(
+        state, realestate.iterate_batches(
+            dataset, batch_size=cfg.data.batch_size, rng=order),
+        step=step)
+    all_losses.extend(losses)
+    if losses:
+      _log(f"epoch {epoch}: mean loss {np.mean(losses):.4f} "
+           f"({time.time() - t0:.0f}s elapsed)")
+  if not all_losses:
+    raise SystemExit(
+        "no training steps ran: check --epochs and that the dataset has at "
+        "least batch_size scenes")
+
+  if args.ckpt:
+    train_loop.save_checkpoint(os.path.abspath(args.ckpt), state,
+                               overwrite=True)
+    _log(f"checkpoint saved to {args.ckpt}")
+
+  if args.export_html:
+    from mpi_vision_tpu.models.stereo_mag import mpi_from_net_output
+    from mpi_vision_tpu.viewer import export
+
+    valid = cfg.data.make_dataset(is_valid=True)
+    example = valid[0]
+    pred = state.apply_fn({"params": state.params},
+                          jnp.asarray(example["net_input"])[None])
+    rgba = mpi_from_net_output(pred, jnp.asarray(example["ref_img"])[None])
+    export.export_viewer_html(
+        np.asarray(rgba[0]), args.export_html,
+        near=cfg.data.depth_near, far=cfg.data.depth_far)
+    _log(f"viewer exported to {args.export_html}")
+
+  return {
+      "command": "train",
+      "epochs": cfg.epochs,
+      "steps": len(all_losses),
+      "first_loss": round(all_losses[0], 5),
+      "final_loss": round(all_losses[-1], 5),
+      "seconds": round(time.time() - t0, 1),
+  }
+
+
+def cmd_export_viewer(args: argparse.Namespace) -> dict:
+  from mpi_vision_tpu.viewer import export
+
+  mpi = export.load_fixture_mpi(args.mpi_dir, prefix=args.prefix)
+  out = export.export_viewer_html(
+      mpi, args.out, near=args.near, far=args.far, fov_deg=args.fov)
+  return {
+      "command": "export-viewer",
+      "layers": int(mpi.shape[2]),
+      "size": [int(mpi.shape[0]), int(mpi.shape[1])],
+      "out": out,
+  }
+
+
+def build_parser() -> argparse.ArgumentParser:
+  ap = argparse.ArgumentParser(
+      prog="mpi_vision_tpu",
+      description="TPU-native multi-plane-image framework CLI")
+  sub = ap.add_subparsers(dest="command", required=True)
+
+  t = sub.add_parser("train", help="train the stereo-magnification model")
+  t.add_argument("--dataset", default=".",
+                 help="RealEstate10K-layout root (see data/realestate.py)")
+  t.add_argument("--synthetic", action="store_true",
+                 help="train on the hermetic procedural dataset instead")
+  t.add_argument("--synthetic-scenes", type=int, default=4)
+  t.add_argument("--img-size", type=int, default=224)    # cell 8:89
+  t.add_argument("--num-planes", type=int, default=10)   # cell 8:90
+  t.add_argument("--epochs", type=int, default=20)       # cell 16
+  t.add_argument("--lr", type=float, default=2e-4)       # cell 15
+  t.add_argument("--vgg-loss", action=argparse.BooleanOptionalAction,
+                 default=True, help="VGG-perceptual loss (reference) or L2")
+  t.add_argument("--vgg-resize", type=int, default=224,
+                 help="loss resize (cell 12); <= 0 disables")
+  t.add_argument("--seed", type=int, default=0)
+  t.add_argument("--ckpt", default="", help="orbax checkpoint directory")
+  t.add_argument("--export-html", default="",
+                 help="write a viewer HTML of a validation MPI here")
+  t.set_defaults(fn=cmd_train)
+
+  e = sub.add_parser("export-viewer",
+                     help="bake a PNG MPI directory into the HTML viewer")
+  e.add_argument("--mpi-dir", required=True)
+  e.add_argument("--prefix", default="rgba_")
+  e.add_argument("--out", required=True)
+  e.add_argument("--near", type=float, default=1.0)
+  e.add_argument("--far", type=float, default=100.0)
+  e.add_argument("--fov", type=float, default=60.0)
+  e.set_defaults(fn=cmd_export_viewer)
+  return ap
+
+
+def main(argv=None) -> int:
+  args = build_parser().parse_args(argv)
+  summary = args.fn(args)
+  print(json.dumps(summary))
+  return 0
+
+
+if __name__ == "__main__":
+  sys.exit(main())

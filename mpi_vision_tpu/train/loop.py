@@ -130,14 +130,20 @@ def fit(state: TrainState, batches, step=None, log_every: int = 0):
 # --- Checkpointing (orbax) -------------------------------------------------
 
 
-def save_checkpoint(path: str, state: TrainState) -> None:
-  """Write params + opt state + step to ``path`` (an absolute directory)."""
+def save_checkpoint(path: str, state: TrainState,
+                    overwrite: bool = False) -> None:
+  """Write params + opt state + step to ``path`` (an absolute directory).
+
+  ``overwrite=False`` (the default) keeps orbax's refuse-to-clobber
+  behavior; pass True to replace an existing checkpoint (e.g. re-running a
+  CLI training job with the same --ckpt path).
+  """
   import orbax.checkpoint as ocp
 
   with ocp.StandardCheckpointer() as ckptr:
     ckptr.save(path, {"params": state.params,
                       "opt_state": state.opt_state,
-                      "step": state.step})
+                      "step": state.step}, force=overwrite)
 
 
 def restore_checkpoint(path: str, state: TrainState) -> TrainState:

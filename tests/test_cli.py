@@ -1,0 +1,71 @@
+"""CLI tests: the notebook workflow as commands (train / export-viewer)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from mpi_vision_tpu import cli
+
+
+def test_train_synthetic_l2(tmp_path, capsys):
+  rc = cli.main([
+      "train", "--synthetic", "--synthetic-scenes", "3",
+      "--img-size", "32", "--num-planes", "4", "--epochs", "2",
+      "--no-vgg-loss", "--ckpt", str(tmp_path / "ckpt"),
+      "--export-html", str(tmp_path / "v.html"),
+  ])
+  assert rc == 0
+  out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+  assert out["command"] == "train" and out["steps"] == 6
+  assert np.isfinite(out["final_loss"])
+  assert os.path.isdir(tmp_path / "ckpt")
+  html = open(tmp_path / "v.html").read()
+  assert html.count("data:image/png;base64,") == 4
+
+
+def test_train_synthetic_vgg_loss(capsys):
+  rc = cli.main([
+      "train", "--synthetic", "--synthetic-scenes", "2",
+      "--img-size", "32", "--num-planes", "4", "--epochs", "1",
+      "--vgg-resize", "0",
+  ])
+  assert rc == 0
+  out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+  assert out["steps"] == 2 and np.isfinite(out["final_loss"])
+
+
+def test_export_viewer_fixture(tmp_path, capsys):
+  fixtures = os.path.join(os.path.dirname(__file__), "fixtures", "scene_009")
+  rc = cli.main([
+      "export-viewer", "--mpi-dir", fixtures,
+      "--out", str(tmp_path / "scene.html"),
+  ])
+  assert rc == 0
+  out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+  assert out["layers"] == 10 and out["size"] == [400, 640]
+  assert os.path.exists(tmp_path / "scene.html")
+
+
+def test_unknown_command_exits():
+  with pytest.raises(SystemExit):
+    cli.main(["frobnicate"])
+
+
+def test_train_ckpt_overwrite(tmp_path, capsys):
+  """Re-running with the same --ckpt path must not crash (orbax force)."""
+  argv = ["train", "--synthetic", "--synthetic-scenes", "2",
+          "--img-size", "32", "--num-planes", "4", "--epochs", "1",
+          "--no-vgg-loss", "--ckpt", str(tmp_path / "ckpt")]
+  assert cli.main(argv) == 0
+  assert cli.main(argv) == 0
+  capsys.readouterr()
+
+
+def test_train_zero_epochs_errors(capsys):
+  with pytest.raises(SystemExit, match="no training steps"):
+    cli.main(["train", "--synthetic", "--synthetic-scenes", "2",
+              "--img-size", "32", "--num-planes", "4", "--epochs", "0",
+              "--no-vgg-loss"])
+  capsys.readouterr()

@@ -37,9 +37,8 @@ def cmd_train(args: argparse.Namespace) -> dict:
   from mpi_vision_tpu.train import loop as train_loop
 
   root = args.dataset
-  tmp_holder = None
   if args.synthetic:
-    if root == ".":
+    if root is None:
       # No explicit destination: use a temp dir cleaned up at exit.
       import atexit
 
@@ -50,6 +49,15 @@ def cmd_train(args: argparse.Namespace) -> dict:
         root, num_scenes=args.synthetic_scenes, frames=4,
         img_size=args.img_size, seed=0)
     _log(f"synthesized dataset at {root}")
+  elif root is None:
+    raise SystemExit("--dataset is required (or pass --synthetic)")
+  if args.export_html:
+    # Fail before hours of training, not after: the export needs a
+    # non-empty test split.
+    test_dir = os.path.join(root, "RealEstate10K", "test")
+    if not (os.path.isdir(test_dir) and os.listdir(test_dir)):
+      raise SystemExit(
+          f"--export-html needs a non-empty test split at {test_dir}")
 
   cfg = config.TrainConfig(
       data=config.DataConfig(dataset_path=root, img_size=args.img_size,
@@ -127,8 +135,10 @@ def build_parser() -> argparse.ArgumentParser:
   sub = ap.add_subparsers(dest="command", required=True)
 
   t = sub.add_parser("train", help="train the stereo-magnification model")
-  t.add_argument("--dataset", default=".",
-                 help="RealEstate10K-layout root (see data/realestate.py)")
+  t.add_argument("--dataset", default=None,
+                 help="RealEstate10K-layout root (see data/realestate.py); "
+                      "with --synthetic, the destination to write the "
+                      "procedural scenes to (default: auto-cleaned temp)")
   t.add_argument("--synthetic", action="store_true",
                  help="train on the hermetic procedural dataset instead")
   t.add_argument("--synthetic-scenes", type=int, default=4)

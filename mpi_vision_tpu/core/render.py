@@ -118,10 +118,9 @@ def render_mpi(
       exactly (utils.py:188), EXACT is correct for non-square frames.
     method: 'fused' scans warp+composite per plane with no [P,...] warped
       stack in HBM; 'scan'/'assoc'/'pallas' warp all planes then composite
-      (see core/compose.py); 'fused_pallas' runs warp+sample+composite as one
-      TPU kernel (kernels/render_pallas.py — the fastest path; requires
-      H % 8 == 0, H >= 24, W % 128 == 0, and W >= 256 for its separable
-      fast path).
+      (see core/compose.py); 'fused_pallas' runs warp+sample+composite as
+      one TPU kernel (kernels/render_pallas.py — the fastest path; sizes
+      off the 8x128 tile grid are zero-padded and cropped, exactly).
     separable: for 'fused_pallas' only — select the separable fast path
       (valid when the warps are axis-aligned: camera translation/zoom, no
       rotation). None auto-detects when poses are concrete; under jit the

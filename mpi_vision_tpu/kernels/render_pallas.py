@@ -29,8 +29,11 @@ The bilinear x-taps come from ``tpu.dynamic_gather`` (the HW lane gather,
 ~750 G elem/s measured); the gather window is one 128-lane vreg, so taps
 are gathered from 2-3 statically-planned 128-aligned windows per chunk.
 
-Restrictions (documented contract): H % 8 == 0, W % 128 == 0, H >= 24, and
-per-plane source extents bounded: the separable strip band allows vertical
+Restrictions (documented contract): tile geometry wants H % 8 == 0,
+W % 128 == 0, H >= 24, W >= 256 — other sizes are zero-padded
+bottom/right automatically and cropped back, which is EXACT under the
+sampler's zeros padding. Per-plane source extents bounded: the separable
+strip band allows vertical
 scale <= ~1.5; windows cover <= 2*128+1 = 257 source columns per chunk from
 the leftmost tap (3 windows: <= ~2.0 horizontal scale). The shared kernel's
 per-tile rectangles allow several degrees of rotation at 1080p (per-column
@@ -990,14 +993,44 @@ def render_mpi_fused(planes: jnp.ndarray, homs: jnp.ndarray,
   return out[0] if single else out
 
 
+def _pad_to_tiles(planes: jnp.ndarray):
+  """Zero-pad H to a multiple of 8 (>= BAND) and W to a multiple of 128.
+
+  EXACT under the sampler's zeros-padding semantics (utils.py:174): a tap
+  beyond the original extent contributed 0 before; with padding it reads a
+  zero plane value (and zero alpha) — identical pixels, identical
+  gradients. The output is cropped back by the caller.
+  """
+  _, _, _, height, width = planes.shape
+  h_tgt = max(-(-height // STRIP) * STRIP, BAND)      # BAND is 8-aligned
+  w_tgt = max(-(-width // CHUNK) * CHUNK, 2 * WIN)
+  padded = jnp.pad(
+      planes,
+      ((0, 0), (0, 0), (0, 0), (0, h_tgt - height), (0, w_tgt - width)))
+  return padded, height, width
+
+
 def _render_mpi_fused_batch(planes, homs, separable, check, plan):
   _, _, _, height, width = planes.shape
-  if height % STRIP or width % CHUNK:
-    raise ValueError(
-        f"H must be a multiple of {STRIP} and W of {CHUNK}; got "
-        f"{height}x{width} (pad the MPI, or use an XLA method)")
-  if height < BAND:
-    raise ValueError(f"H must be >= {BAND}, got {height}")
+  if (height % STRIP or width % CHUNK or height < BAND
+      or (separable and width < 2 * WIN)):
+    if not check:
+      # A check=False caller validated their envelope/plan at the ORIGINAL
+      # size; silently re-running the geometry at the padded size would
+      # void that validation (coverage tables shift with H/W). Make the
+      # mismatch loud instead.
+      raise ValueError(
+          f"{height}x{width} is off the kernel tile grid (H % {STRIP}, "
+          f"W % {CHUNK}, H >= {BAND}) and check=False: pad the MPI "
+          "yourself and validate fits_envelope/_plan_shared at the padded "
+          "size, or use check=True (which plans at the padded size), or "
+          "an XLA method.")
+    # Auto-pad to the kernel's tile geometry (exact; see _pad_to_tiles)
+    # and crop the render back to the requested size; the envelope check
+    # below then runs at the padded size the kernel actually executes.
+    padded, h0, w0 = _pad_to_tiles(planes)
+    out = _render_mpi_fused_batch(padded, homs, separable, check, plan)
+    return out[..., :h0, :w0]
   homs_concrete = not isinstance(homs, jax.core.Tracer)
   if check and not homs_concrete:
     raise ValueError(

@@ -100,3 +100,26 @@ class TestExamples:
                                   rng=np.random.default_rng(1))
     exs = [ds[0]["tgt_img_cfw"] for _ in range(6)]
     assert any(not np.array_equal(exs[0], e) for e in exs[1:])
+
+
+class TestPrefetch:
+
+  def test_prefetch_preserves_order_and_content(self):
+    from mpi_vision_tpu.data.realestate import prefetch_batches
+
+    items = [{"x": i} for i in range(7)]
+    got = list(prefetch_batches(iter(items), size=3))
+    assert got == items
+
+  def test_prefetch_propagates_worker_exception(self):
+    from mpi_vision_tpu.data.realestate import prefetch_batches
+
+    def gen():
+      yield 1
+      raise RuntimeError("decode failed")
+
+    it = prefetch_batches(gen(), size=2)
+    assert next(it) == 1
+    import pytest as _pytest
+    with _pytest.raises(RuntimeError, match="decode failed"):
+      list(it)

@@ -124,14 +124,22 @@ class TestFusedKernel:
         np.asarray(rp.render_mpi_fused(planes, homs)),
         np.asarray(rp.reference_render(planes, homs)), atol=1e-4, rtol=0)
 
-  def test_shape_validation(self, rng):
-    depths = inv_depths(1.0, 100.0, 2)
+  @pytest.mark.parametrize("hw", [(30, 200), (25, 300), (16, 640)])
+  def test_untiled_shapes_auto_pad(self, rng, hw):
+    """Arbitrary sizes auto-pad to the tile geometry and crop back —
+    exact under zeros-padding semantics (utils.py:174), so e.g. the 224^2
+    training scale can use the fused path."""
+    h, w = hw
+    p = 2
+    planes = _mpi(rng, p, h, w)
+    depths = inv_depths(1.0, 100.0, p)
     homs = rp.pixel_homographies(
-        _pose(), depths, _intrinsics(24, 256), 24, 256)[:, 0]
-    with pytest.raises(ValueError, match="multiple"):
-      rp.render_mpi_fused(jnp.zeros((2, 4, 30, 256)), homs)
-    with pytest.raises(ValueError, match="multiple"):
-      rp.render_mpi_fused(jnp.zeros((2, 4, 24, 200)), homs)
+        _pose(**ROTATION), depths, _intrinsics(h, w), h, w)[:, 0]
+    got = rp.render_mpi_fused(planes, homs, separable=False)
+    assert got.shape == (3, h, w)
+    want = rp.reference_render(planes, homs)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=2e-4, rtol=0)
 
   def test_separable_wide_scale_window_coverage(self, rng):
     """Horizontal scale 1.3 with worst-case window alignment (regression).

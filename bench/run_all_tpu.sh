@@ -1,0 +1,25 @@
+#!/bin/bash
+# Run the full TPU bench battery, writing one artifact per script into
+# artifacts/. Intended for an idle host (contention skews the axon-tunnel
+# dispatch numbers). Each script prints its JSON line on stdout; stderr
+# diagnostics go to the matching .log file.
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p artifacts
+stamp=$(date -u +%Y%m%dT%H%M%SZ)
+run() {
+  name=$1; shift
+  echo "=== $name ($(date -u +%H:%M:%SZ)) ==="
+  timeout 1800 python "$@" >"artifacts/${name}.json" 2>"artifacts/${name}.log"
+  rc=$?
+  echo "rc=$rc $(cat artifacts/${name}.json 2>/dev/null | tail -1)"
+}
+echo "battery start $stamp"
+run tpu_r03_headline bench.py
+run tpu_r03_config1 bench/config1_composite.py
+run tpu_r03_config2 bench/config2_render512.py
+run tpu_r03_config3 bench/config3_sweep.py
+run tpu_r03_config4 bench/config4_sharded.py
+run tpu_r03_config5 bench/config5_tiny_unet.py
+run tpu_r03_train_speed bench/train_speed.py
+echo "battery done $(date -u +%H:%M:%SZ)"

@@ -1,0 +1,26 @@
+#!/bin/bash
+# Probe the TPU tunnel every 3 minutes; when a trivial device program
+# succeeds, run the full bench battery (bench/run_all_tpu.sh) once and exit.
+# Survives tunnel flaps during the battery: if the headline artifact is
+# missing or empty afterwards, keep watching and retry.
+set -u
+cd "$(dirname "$0")/.."
+log=artifacts/tpu_watch.log
+mkdir -p artifacts
+echo "watch start $(date -u +%H:%M:%SZ)" >>"$log"
+while true; do
+  if timeout 120 python -c "
+import jax, jax.numpy as jnp
+jnp.ones((128,128)).sum().block_until_ready()
+print(jax.devices())
+" >>"$log" 2>&1; then
+    echo "tunnel up $(date -u +%H:%M:%SZ); running battery" >>"$log"
+    bash bench/run_all_tpu.sh >>"$log" 2>&1
+    if [ -s artifacts/tpu_r03_headline.json ]; then
+      echo "battery complete $(date -u +%H:%M:%SZ)" >>"$log"
+      exit 0
+    fi
+    echo "headline artifact empty; tunnel likely flapped — rewatching" >>"$log"
+  fi
+  sleep 180
+done

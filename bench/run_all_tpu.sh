@@ -9,9 +9,21 @@ mkdir -p artifacts
 stamp=$(date -u +%Y%m%dT%H%M%SZ)
 run() {
   name=$1; shift
+  if [ -s "artifacts/${name}.json" ]; then
+    echo "=== $name already done; skipping ==="
+    return 0
+  fi
   echo "=== $name ($(date -u +%H:%M:%SZ)) ==="
-  timeout 1800 python "$@" >"artifacts/${name}.json" 2>"artifacts/${name}.log"
+  # Write to .tmp and move into place only on success, so the done-marker
+  # path can never hold a partial artifact (even if this shell is killed
+  # mid-run, the tunnel-flap scenario this script exists for).
+  timeout 1800 python "$@" >"artifacts/${name}.json.tmp" 2>"artifacts/${name}.log"
   rc=$?
+  if [ $rc -eq 0 ]; then
+    mv -f "artifacts/${name}.json.tmp" "artifacts/${name}.json"
+  else
+    mv -f "artifacts/${name}.json.tmp" "artifacts/${name}.json.failed" 2>/dev/null
+  fi
   echo "rc=$rc $(cat artifacts/${name}.json 2>/dev/null | tail -1)"
 }
 echo "battery start $stamp"

@@ -8,6 +8,7 @@ cd "$(dirname "$0")/.."
 log=artifacts/tpu_watch.log
 mkdir -p artifacts
 echo "watch start $(date -u +%H:%M:%SZ)" >>"$log"
+batteries=0
 while true; do
   if timeout 120 python -c "
 import jax, jax.numpy as jnp
@@ -16,11 +17,24 @@ print(jax.devices())
 " >>"$log" 2>&1; then
     echo "tunnel up $(date -u +%H:%M:%SZ); running battery" >>"$log"
     bash bench/run_all_tpu.sh >>"$log" 2>&1
-    if [ -s artifacts/tpu_r03_headline.json ]; then
+    batteries=$((batteries + 1))
+    # Complete only when EVERY artifact landed (run_all skips ones already
+    # done, so a mid-battery tunnel flap resumes where it left off).
+    missing=0
+    for n in headline config1 config2 config3 config4 config5 train_speed; do
+      [ -s "artifacts/tpu_r03_${n}.json" ] || missing=$((missing + 1))
+    done
+    if [ "$missing" -eq 0 ]; then
       echo "battery complete $(date -u +%H:%M:%SZ)" >>"$log"
       exit 0
     fi
-    echo "headline artifact empty; tunnel likely flapped — rewatching" >>"$log"
+    if [ "$batteries" -ge 5 ]; then
+      # A benchmark that still has no artifact after 5 batteries is failing
+      # deterministically, not flapping; stop hogging the TPU host.
+      echo "giving up after $batteries batteries; $missing missing" >>"$log"
+      exit 1
+    fi
+    echo "$missing artifacts still empty; tunnel likely flapped — rewatching" >>"$log"
   fi
   sleep 180
 done

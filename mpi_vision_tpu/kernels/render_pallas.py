@@ -137,6 +137,118 @@ def _ymin_of(hom, oy0, height, width):
   return pl.multiple_of((ymin // 8) * 8, 8)
 
 
+def _sep_band_dma(src_ref, band_ref, sems, band0_of, *, step, total, slot,
+                  bi, s, p, n_s, num_planes):
+  """Double-buffered full-width band DMA for separable-grid kernels.
+
+  Grid contract: ``(batch, strip, plane)`` with plane innermost. Waits for
+  this step's ``[4, BAND, W]`` band (from ``src_ref[b, p]`` rows
+  ``band0_of(b, p, s)``) in ``band_ref[slot]`` and prefetches the next
+  step's into the other slot. ``band0_of`` maps grid indices to the band's
+  8-aligned first row (reading homography scalars itself). Shared by the
+  forward separable kernel and the backward warp/adjoint kernels
+  (render_pallas_bwd) so the prefetch roll-over logic cannot fork.
+  """
+
+  @pl.when(step == 0)
+  def _first_dma():
+    pltpu.make_async_copy(
+        src_ref.at[bi, p, :, pl.ds(band0_of(bi, p, s), BAND), :],
+        band_ref.at[0], sems.at[0]).start()
+
+  pltpu.make_async_copy(
+      src_ref.at[bi, p, :, pl.ds(band0_of(bi, p, s), BAND), :],
+      band_ref.at[slot], sems.at[slot]).wait()
+
+  @pl.when(step < total - 1)
+  def _next_dma():
+    same_strip = p + 1 < num_planes
+    p_n = jnp.where(same_strip, p + 1, 0)
+    s_wrap = jnp.where(s + 1 < n_s, s + 1, 0)
+    s_n = jnp.where(same_strip, s, s_wrap)
+    b_n = jnp.where(same_strip | (s + 1 < n_s), bi, bi + 1)
+    pltpu.make_async_copy(
+        src_ref.at[b_n, p_n, :, pl.ds(band0_of(b_n, p_n, s_n), BAND), :],
+        band_ref.at[1 - slot], sems.at[1 - slot]).start()
+
+
+def _sep_ky(hom, oy0, ymin):
+  """Vertical bilinear weight matrix for a separable strip.
+
+  v depends only on the row: ``KY[r, q] = relu(1 - |v_r - (ymin + q)|)``
+  is the exact vertical weight of band row ``q`` for strip row ``r``
+  (zeros padding included: band rows are always in-image, rows outside
+  the band weight to 0). Shared by the forward separable kernel and the
+  backward warp kernel. Only the first BAND of the CHUNK lane columns are
+  meaningful (consumers index ``ky[:, q]`` for q < BAND).
+  """
+  sub8 = jax.lax.broadcasted_iota(
+      jnp.int32, (STRIP, CHUNK), 0).astype(jnp.float32)
+  lane = jax.lax.broadcasted_iota(
+      jnp.int32, (STRIP, CHUNK), 1).astype(jnp.float32)
+  v8 = (hom[4] * (sub8 + oy0) + hom[5]) / hom[8]
+  return jnp.maximum(
+      0.0, 1.0 - jnp.abs(v8 - (lane + ymin.astype(jnp.float32))))
+
+
+def _sep_chunk_sample(hom, band_ref, slot, h, ky, n_windows, width):
+  """Warp-sample one [STRIP, CHUNK] output chunk from a separable band.
+
+  The per-chunk sampling core of the separable path, shared by the forward
+  kernel and the backward-pass warp kernel (render_pallas_bwd): horizontal
+  bilinear taps gathered from ``n_windows`` 128-aligned windows of the
+  ``[4, BAND, W]`` band at ``band_ref[slot]``, then the vertical lerp
+  ``ky`` (``[STRIP, >=BAND]``: per-row weights over band rows) applied as
+  an outer-product accumulation. Returns 4 ``[STRIP, CHUNK]`` channels.
+  """
+  lane1 = jax.lax.broadcasted_iota(jnp.int32, (1, CHUNK), 1).astype(jnp.float32)
+  ox0 = (h * CHUNK).astype(jnp.float32)
+  u = (hom[0] * (lane1 + ox0) + hom[2]) / hom[8]        # [1, CHUNK]
+  x0f = jnp.floor(u)
+  fx = u - x0f
+  x0 = x0f.astype(jnp.int32)
+  valid0 = (x0 >= 0) & (x0 <= width - 1)
+  valid1 = (x0 + 1 >= 0) & (x0 + 1 <= width - 1)
+
+  ua = (hom[0] * ox0 + hom[2]) / hom[8]
+  ub = (hom[0] * (ox0 + CHUNK - 1) + hom[2]) / hom[8]
+  ua = jnp.where(jnp.isfinite(ua), ua, 0.0)
+  ub = jnp.where(jnp.isfinite(ub), ub, 0.0)
+  x_lo = jnp.floor(jnp.minimum(ua, ub)).astype(jnp.int32)
+  # Clamp so all n_windows gather windows are always in-range; window
+  # bases align DOWN from x_lo, so guaranteed coverage from the leftmost
+  # tap is (n_windows-1)*WIN + 1 columns.
+  w0 = jnp.clip((x_lo // WIN) * WIN, 0, width - n_windows * WIN)
+
+  xles = None
+  for wi in range(n_windows):
+    base = pl.multiple_of(w0 + wi * WIN, WIN)
+    rel = x0 - base
+    in0 = (rel >= 0) & (rel < WIN) & valid0
+    in1 = (rel + 1 >= 0) & (rel + 1 < WIN) & valid1
+    # Masks and lerp weights folded into two per-lane coefficients
+    # (shared across channels and band rows; 0 * garbage == 0 exactly).
+    a = jnp.where(in0, 1.0 - fx, 0.0)
+    b = jnp.where(in1, fx, 0.0)
+    i0 = jnp.broadcast_to(jnp.clip(rel, 0, WIN - 1), (BAND, CHUNK))
+    i1 = jnp.broadcast_to(jnp.clip(rel + 1, 0, WIN - 1), (BAND, CHUNK))
+    outs = []
+    for c in range(4):
+      win = band_ref[slot, c, :, pl.ds(base, WIN)]      # [BAND, WIN]
+      g0 = jnp.take_along_axis(win, i0, axis=1)
+      g1 = jnp.take_along_axis(win, i1, axis=1)
+      outs.append(g0 * a + g1 * b)
+    xles = outs if xles is None else [x + o for x, o in zip(xles, outs)]
+
+  # Vertical lerp for the whole strip: outer-product accumulation over the
+  # band rows, exact in f32 (ky columns are nonzero for <= 2 rows each).
+  pix = [jnp.zeros((STRIP, CHUNK), jnp.float32) for _ in range(4)]
+  for q in range(BAND):
+    kyq = ky[:, q:q + 1]                                 # [STRIP, 1]
+    pix = [acc + kyq * x[q:q + 1] for acc, x in zip(pix, xles)]
+  return pix
+
+
 def _separable_kernel(hom_ref, planes_ref, out_ref, band_ref, acc_ref, sems,
                       *, num_planes, height, width, n_windows):
   """Fast path for axis-aligned (separable) homographies.
@@ -165,84 +277,20 @@ def _separable_kernel(hom_ref, planes_ref, out_ref, band_ref, acc_ref, sems,
   slot = jax.lax.rem(step, 2)
   hom = [hom_ref[bi, p, k] for k in range(9)]
   oy0 = (s * STRIP).astype(jnp.float32)
-  ymin = _ymin_of(hom, oy0, height, width)
 
-  @pl.when(step == 0)
-  def _first_dma():
-    pltpu.make_async_copy(
-        planes_ref.at[bi, p, :, pl.ds(ymin, BAND), :],
-        band_ref.at[0], sems.at[0]).start()
+  def band0_of(b_, p_, s_):
+    return _ymin_of([hom_ref[b_, p_, k] for k in range(9)],
+                    (s_ * STRIP).astype(jnp.float32), height, width)
 
-  pltpu.make_async_copy(
-      planes_ref.at[bi, p, :, pl.ds(ymin, BAND), :],
-      band_ref.at[slot], sems.at[slot]).wait()
+  ymin = band0_of(bi, p, s)
+  _sep_band_dma(planes_ref, band_ref, sems, band0_of, step=step,
+                total=total, slot=slot, bi=bi, s=s, p=p, n_s=n_s,
+                num_planes=num_planes)
 
-  @pl.when(step < total - 1)
-  def _next_dma():
-    same_strip = p + 1 < num_planes
-    p_n = jnp.where(same_strip, p + 1, 0)
-    s_wrap = jnp.where(s + 1 < n_s, s + 1, 0)
-    s_n = jnp.where(same_strip, s, s_wrap)
-    b_n = jnp.where(same_strip | (s + 1 < n_s), bi, bi + 1)
-    hom_n = [hom_ref[b_n, p_n, k] for k in range(9)]
-    ymin_n = _ymin_of(hom_n, (s_n * STRIP).astype(jnp.float32), height, width)
-    pltpu.make_async_copy(
-        planes_ref.at[b_n, p_n, :, pl.ds(ymin_n, BAND), :],
-        band_ref.at[1 - slot], sems.at[1 - slot]).start()
-
-  # v depends only on the row: KY[r, q] = relu(1 - |v_r - (ymin + q)|) is the
-  # exact vertical bilinear weight matrix (zeros padding included: band rows
-  # are always in-image, rows outside the band weight to 0).
-  sub8 = jax.lax.broadcasted_iota(jnp.int32, (STRIP, CHUNK), 0).astype(jnp.float32)
-  lane = jax.lax.broadcasted_iota(jnp.int32, (STRIP, CHUNK), 1).astype(jnp.float32)
-  v8 = (hom[4] * (sub8 + oy0) + hom[5]) / hom[8]
-  ky = jnp.maximum(0.0, 1.0 - jnp.abs(v8 - (lane + ymin.astype(jnp.float32))))
+  ky = _sep_ky(hom, oy0, ymin)
 
   def chunk_body(h, carry):
-    ox0 = (h * CHUNK).astype(jnp.float32)
-    u = (hom[0] * (lane[:1] + ox0) + hom[2]) / hom[8]     # [1, CHUNK]
-    x0f = jnp.floor(u)
-    fx = u - x0f
-    x0 = x0f.astype(jnp.int32)
-    valid0 = (x0 >= 0) & (x0 <= width - 1)
-    valid1 = (x0 + 1 >= 0) & (x0 + 1 <= width - 1)
-
-    ua = (hom[0] * ox0 + hom[2]) / hom[8]
-    ub = (hom[0] * (ox0 + CHUNK - 1) + hom[2]) / hom[8]
-    ua = jnp.where(jnp.isfinite(ua), ua, 0.0)
-    ub = jnp.where(jnp.isfinite(ub), ub, 0.0)
-    x_lo = jnp.floor(jnp.minimum(ua, ub)).astype(jnp.int32)
-    # Clamp so all n_windows gather windows are always in-range; window
-    # bases align DOWN from x_lo, so guaranteed coverage from the leftmost
-    # tap is (n_windows-1)*WIN + 1 columns.
-    w0 = jnp.clip((x_lo // WIN) * WIN, 0, width - n_windows * WIN)
-
-    xles = None
-    for wi in range(n_windows):
-      base = pl.multiple_of(w0 + wi * WIN, WIN)
-      rel = x0 - base
-      in0 = (rel >= 0) & (rel < WIN) & valid0
-      in1 = (rel + 1 >= 0) & (rel + 1 < WIN) & valid1
-      # Masks and lerp weights folded into two per-lane coefficients
-      # (shared across channels and band rows; 0 * garbage == 0 exactly).
-      a = jnp.where(in0, 1.0 - fx, 0.0)
-      b = jnp.where(in1, fx, 0.0)
-      i0 = jnp.broadcast_to(jnp.clip(rel, 0, WIN - 1), (BAND, CHUNK))
-      i1 = jnp.broadcast_to(jnp.clip(rel + 1, 0, WIN - 1), (BAND, CHUNK))
-      outs = []
-      for c in range(4):
-        win = band_ref[slot, c, :, pl.ds(base, WIN)]      # [BAND, WIN]
-        g0 = jnp.take_along_axis(win, i0, axis=1)
-        g1 = jnp.take_along_axis(win, i1, axis=1)
-        outs.append(g0 * a + g1 * b)
-      xles = outs if xles is None else [x + o for x, o in zip(xles, outs)]
-
-    # Vertical lerp for the whole strip: outer-product accumulation over the
-    # band rows, exact in f32 (ky columns are nonzero for <= 2 rows each).
-    pix = [jnp.zeros((STRIP, CHUNK), jnp.float32) for _ in range(4)]
-    for q in range(BAND):
-      kyq = ky[:, q:q + 1]                                 # [STRIP, 1]
-      pix = [acc + kyq * x[q:q + 1] for acc, x in zip(pix, xles)]
+    pix = _sep_chunk_sample(hom, band_ref, slot, h, ky, n_windows, width)
     rgb, alpha = pix[:3], pix[3]
     cols = pl.ds(pl.multiple_of(h * CHUNK, CHUNK), CHUNK)
 
@@ -273,6 +321,70 @@ def _tile_sizes(height: int, width: int, n_windows: int):
   bandg = G_BAND if height >= G_BAND else BAND
   n_eff = min(n_windows, tsrc // WIN)
   return tw, tsrc, bandg, n_eff
+
+
+def _shr_chunk_sample(usl, vsl, band_ref, slot, ymin, xmin, q0, w0,
+                      n_taps, n_windows, height, width):
+  """Warp-sample one [STRIP, CHUNK] output chunk from a 2-D source band.
+
+  The per-chunk sampling core of the shared-gather general path, shared by
+  the forward kernel and the backward-pass warp kernel (render_pallas_bwd).
+  ``usl``/``vsl`` are the chunk's source coords; the band at
+  ``band_ref[slot]`` is the ``[4, bandg, tsrc]`` rectangle whose origin is
+  ``(ymin, xmin)``; ``q0``/``w0`` are the chunk's band-slice offset and
+  gather-window base within it. Horizontal taps are a fan of ``n_taps``
+  consecutive columns from ``floor(min_row u)`` shared by all strip rows;
+  vertical taps are selected per pixel with single-vreg sublane gathers.
+  Returns 4 ``[STRIP, CHUNK]`` channels.
+  """
+  xhat_f = jnp.floor(jnp.min(usl, axis=0, keepdims=True))  # [1, CHUNK]
+  xhat = xhat_f.astype(jnp.int32)
+
+  # Vertical taps: slice-relative row of floor(v) and its in-image lerp
+  # weights (off-image rows weight to 0 — zeros padding, utils.py:174).
+  y0f = jnp.floor(vsl)
+  fy = vsl - y0f
+  y0 = y0f.astype(jnp.int32)
+  qi = y0 - (ymin + q0)                                    # [STRIP, CHUNK]
+  w_a = jnp.where((y0 >= 0) & (y0 <= height - 1), 1.0 - fy, 0.0)
+  w_b = jnp.where((y0 + 1 >= 0) & (y0 + 1 <= height - 1), fy, 0.0)
+
+  pix = [jnp.zeros(usl.shape, jnp.float32) for _ in range(4)]
+  for tt in range(n_taps):
+    xt = xhat + tt
+    # Exact bilinear weight of integer tap column xt: nonzero (= 1-fx or
+    # fx) exactly when xt is one of the pixel's two taps.
+    ct = jnp.maximum(0.0, 1.0 - jnp.abs(usl - (xhat_f + float(tt))))
+    ct = jnp.where((xt >= 0) & (xt <= width - 1), ct, 0.0)
+
+    rel0 = xt - xmin - w0            # [1, CHUNK], window-0-relative
+    xle = None                       # per-channel [G_SHARED, CHUNK]
+    for wi in range(n_windows):
+      rel = rel0 - wi * WIN
+      inw = (rel >= 0) & (rel < WIN)
+      idx = jnp.broadcast_to(jnp.clip(rel, 0, WIN - 1),
+                             (G_SHARED,) + usl.shape[1:])
+      base = pl.multiple_of(w0 + wi * WIN, WIN)
+      outs = []
+      for c in range(4):
+        win = band_ref[slot, c, pl.ds(q0, G_SHARED), pl.ds(base, WIN)]
+        g = jnp.take_along_axis(win, idx, axis=1)
+        outs.append(jnp.where(inw, g, 0.0))
+      xle = outs if xle is None else [a + o for a, o in zip(xle, outs)]
+
+    for c in range(4):
+      acc_a = jnp.zeros(usl.shape, jnp.float32)
+      acc_b = jnp.zeros(usl.shape, jnp.float32)
+      for k in range(G_SHARED // 8):
+        vreg = xle[c][8 * k:8 * (k + 1)]                   # [8, CHUNK]
+        ga = jnp.take_along_axis(vreg, jnp.clip(qi - 8 * k, 0, 7), axis=0)
+        gb = jnp.take_along_axis(
+            vreg, jnp.clip(qi + 1 - 8 * k, 0, 7), axis=0)
+        acc_a = jnp.where((qi >= 8 * k) & (qi < 8 * (k + 1)), ga, acc_a)
+        acc_b = jnp.where(
+            (qi + 1 >= 8 * k) & (qi + 1 < 8 * (k + 1)), gb, acc_b)
+      pix[c] += ct * (w_a * acc_a + w_b * acc_b)
+  return pix
 
 
 def _shared_kernel(hom_ref, meta_ref, meta_next_ref, wq_ref, planes_ref,
@@ -358,55 +470,8 @@ def _shared_kernel(hom_ref, meta_ref, meta_next_ref, wq_ref, planes_ref,
     w0 = pl.multiple_of(wq_ref[0, 0, 0, p, ci * 2], WIN)
     q0 = pl.multiple_of(wq_ref[0, 0, 0, p, ci * 2 + 1], 8)
     sl = slice(ci * CHUNK, (ci + 1) * CHUNK)
-    usl = u[:, sl]                                           # [STRIP, CHUNK]
-    vsl = v[:, sl]
-    xhat_f = jnp.floor(jnp.min(usl, axis=0, keepdims=True))  # [1, CHUNK]
-    xhat = xhat_f.astype(jnp.int32)
-
-    # Vertical taps: slice-relative row of floor(v) and its in-image lerp
-    # weights (off-image rows weight to 0 — zeros padding, utils.py:174).
-    y0f = jnp.floor(vsl)
-    fy = vsl - y0f
-    y0 = y0f.astype(jnp.int32)
-    qi = y0 - (ymin + q0)                                    # [STRIP, CHUNK]
-    w_a = jnp.where((y0 >= 0) & (y0 <= height - 1), 1.0 - fy, 0.0)
-    w_b = jnp.where((y0 + 1 >= 0) & (y0 + 1 <= height - 1), fy, 0.0)
-
-    pix = [jnp.zeros((STRIP, CHUNK), jnp.float32) for _ in range(4)]
-    for tt in range(n_taps):
-      xt = xhat + tt
-      # Exact bilinear weight of integer tap column xt: nonzero (= 1-fx or
-      # fx) exactly when xt is one of the pixel's two taps.
-      ct = jnp.maximum(0.0, 1.0 - jnp.abs(usl - (xhat_f + float(tt))))
-      ct = jnp.where((xt >= 0) & (xt <= width - 1), ct, 0.0)
-
-      rel0 = xt - xmin - w0            # [1, CHUNK], window-0-relative
-      xle = None                       # per-channel [G_SHARED, CHUNK]
-      for wi in range(n_windows):
-        rel = rel0 - wi * WIN
-        inw = (rel >= 0) & (rel < WIN)
-        idx = jnp.broadcast_to(jnp.clip(rel, 0, WIN - 1), (G_SHARED, CHUNK))
-        base = pl.multiple_of(w0 + wi * WIN, WIN)
-        outs = []
-        for c in range(4):
-          win = band_ref[slot, c, pl.ds(q0, G_SHARED), pl.ds(base, WIN)]
-          g = jnp.take_along_axis(win, idx, axis=1)
-          outs.append(jnp.where(inw, g, 0.0))
-        xle = outs if xle is None else [a + o for a, o in zip(xle, outs)]
-
-      for c in range(4):
-        acc_a = jnp.zeros((STRIP, CHUNK), jnp.float32)
-        acc_b = jnp.zeros((STRIP, CHUNK), jnp.float32)
-        for k in range(G_SHARED // 8):
-          vreg = xle[c][8 * k:8 * (k + 1)]                   # [8, CHUNK]
-          ga = jnp.take_along_axis(vreg, jnp.clip(qi - 8 * k, 0, 7), axis=0)
-          gb = jnp.take_along_axis(
-              vreg, jnp.clip(qi + 1 - 8 * k, 0, 7), axis=0)
-          acc_a = jnp.where((qi >= 8 * k) & (qi < 8 * (k + 1)), ga, acc_a)
-          acc_b = jnp.where(
-              (qi + 1 >= 8 * k) & (qi + 1 < 8 * (k + 1)), gb, acc_b)
-        pix[c] += ct * (w_a * acc_a + w_b * acc_b)
-
+    pix = _shr_chunk_sample(u[:, sl], v[:, sl], band_ref, slot, ymin, xmin,
+                            q0, w0, n_taps, n_windows, height, width)
     rgb, alpha = pix[:3], pix[3]
     cols = pl.ds(pl.multiple_of(ci * CHUNK, CHUNK), CHUNK)
     for c in range(3):
@@ -517,12 +582,13 @@ def _shared_tables(homs: jnp.ndarray, height: int, width: int,
   return meta, wq
 
 
-@functools.partial(
-    jax.jit, static_argnames=("n_taps", "n_windows", "interpret"))
-def _shared_call(planes: jnp.ndarray, homs: jnp.ndarray,
-                 n_taps: int, n_windows: int, interpret: bool) -> jnp.ndarray:
-  """Shared-gather kernel call on a batch ``[B, P, 4, H, W]`` (one launch
-  for the whole batch)."""
+def _shared_grid_setup(planes: jnp.ndarray, homs: jnp.ndarray,
+                       n_windows: int):
+  """Everything a shared-gather-style pallas_call needs besides its kernel
+  body and out specs: tile geometry, SMEM tables, grid, in_specs (incl.
+  the subtle next-step prefetch index map), and operands. Shared by the
+  forward ``_shared_call`` and the backward warp (render_pallas_bwd) so
+  the prefetch logic cannot fork."""
   batch, num_planes, _, height, width = planes.shape
   if height % STRIP or width % CHUNK:
     raise ValueError(
@@ -550,35 +616,52 @@ def _shared_call(planes: jnp.ndarray, homs: jnp.ndarray,
         jnp.where(same_tile | ~last_tile, b, b + 1), batch - 1)
     return b_n, s_n, t_n, 0, 0
 
+  grid = (batch, n_strips, n_tiles, num_planes)
+  in_specs = [
+      pl.BlockSpec(memory_space=pltpu.SMEM),   # [B, P, 9] homographies
+      pl.BlockSpec((1, 1, 1, 2, num_planes),
+                   lambda b, s, t, p: (b, s, t, 0, 0),
+                   memory_space=pltpu.SMEM),   # meta (this step's tile)
+      pl.BlockSpec((1, 1, 1, 2, num_planes), next_index,
+                   memory_space=pltpu.SMEM),   # meta (next step's tile)
+      pl.BlockSpec((1, 1, 1, num_planes, 2 * c_t),
+                   lambda b, s, t, p: (b, s, t, 0, 0),
+                   memory_space=pltpu.SMEM),   # per-chunk w0/q0
+      pl.BlockSpec(memory_space=pl.ANY),       # [B, P, 4, H, W] (HBM)
+  ]
+  operands = (homs32, meta, meta, wq, planes.astype(jnp.float32))
+  geom = dict(tw=tw, tsrc=tsrc, bandg=bandg, n_eff=n_eff, c_t=c_t,
+              batch=batch, num_planes=num_planes, height=height,
+              width=width)
+  return grid, in_specs, operands, geom
+
+
+@functools.partial(
+    jax.jit, static_argnames=("n_taps", "n_windows", "interpret"))
+def _shared_call(planes: jnp.ndarray, homs: jnp.ndarray,
+                 n_taps: int, n_windows: int, interpret: bool) -> jnp.ndarray:
+  """Shared-gather kernel call on a batch ``[B, P, 4, H, W]`` (one launch
+  for the whole batch)."""
+  grid, in_specs, operands, g = _shared_grid_setup(planes, homs, n_windows)
   kernel = functools.partial(
-      _shared_kernel, num_planes=num_planes, height=height, width=width,
-      n_windows=n_eff, n_taps=n_taps, tw=tw, tsrc=tsrc,
-      bandg=bandg)
+      _shared_kernel, num_planes=g["num_planes"], height=g["height"],
+      width=g["width"], n_windows=g["n_eff"], n_taps=n_taps, tw=g["tw"],
+      tsrc=g["tsrc"], bandg=g["bandg"])
   return pl.pallas_call(
       kernel,
-      grid=(batch, n_strips, n_tiles, num_planes),
-      in_specs=[
-          pl.BlockSpec(memory_space=pltpu.SMEM),   # [B, P, 9] homographies
-          pl.BlockSpec((1, 1, 1, 2, num_planes),
-                       lambda b, s, t, p: (b, s, t, 0, 0),
-                       memory_space=pltpu.SMEM),   # meta (this step's tile)
-          pl.BlockSpec((1, 1, 1, 2, num_planes), next_index,
-                       memory_space=pltpu.SMEM),   # meta (next step's tile)
-          pl.BlockSpec((1, 1, 1, num_planes, 2 * c_t),
-                       lambda b, s, t, p: (b, s, t, 0, 0),
-                       memory_space=pltpu.SMEM),   # per-chunk w0/q0
-          pl.BlockSpec(memory_space=pl.ANY),       # [B, P, 4, H, W] (HBM)
-      ],
+      grid=grid,
+      in_specs=in_specs,
       out_specs=pl.BlockSpec(
-          (1, 3, STRIP, tw), lambda b, s, t, p: (b, 0, s, t)),
-      out_shape=jax.ShapeDtypeStruct((batch, 3, height, width), jnp.float32),
+          (1, 3, STRIP, g["tw"]), lambda b, s, t, p: (b, 0, s, t)),
+      out_shape=jax.ShapeDtypeStruct(
+          (g["batch"], 3, g["height"], g["width"]), jnp.float32),
       scratch_shapes=[
-          pltpu.VMEM((2, 4, bandg, tsrc), jnp.float32),
-          pltpu.VMEM((3, STRIP, tw), jnp.float32),
+          pltpu.VMEM((2, 4, g["bandg"], g["tsrc"]), jnp.float32),
+          pltpu.VMEM((3, STRIP, g["tw"]), jnp.float32),
           pltpu.SemaphoreType.DMA((2,)),
       ],
       interpret=interpret,
-  )(homs32, meta, meta, wq, planes.astype(jnp.float32))
+  )(*operands)
 
 
 def is_separable(homs, atol: float = 1e-6) -> bool:
@@ -872,7 +955,17 @@ def reference_render(planes: jnp.ndarray, homs: jnp.ndarray) -> jnp.ndarray:
 _reference_render_batch = jax.vmap(reference_render)
 
 
-def _make_fused(n_windows: int):
+@functools.lru_cache(maxsize=None)
+def _make_fused(n_windows: int, adj_plan: tuple[int, int] | None = None):
+  """Separable-path fused render with a custom VJP.
+
+  With ``adj_plan`` (an eager ``render_pallas_bwd.plan_adjoint_sep``
+  result), d planes comes from the Pallas backward (warp, composite VJP,
+  tent-filter warp transpose); without it, the whole backward routes
+  through the XLA reference path as before. d homs always comes from the
+  XLA path — XLA dead-code-eliminates it under jit when pose gradients
+  are unused (the training case: poses are data).
+  """
 
   @jax.custom_vjp
   def fused(planes, homs):
@@ -884,14 +977,22 @@ def _make_fused(n_windows: int):
 
   def bwd(res, g):
     planes, homs = res
-    _, vjp = jax.vjp(_reference_render_batch, planes, homs)
-    return vjp(g)
+    if adj_plan is None:
+      _, vjp = jax.vjp(_reference_render_batch, planes, homs)
+      return vjp(g)
+    from mpi_vision_tpu.kernels import render_pallas_bwd
+    dplanes = render_pallas_bwd.backward_planes(
+        planes, homs, g, separable=True, fwd_plan=n_windows,
+        adj_plan=adj_plan)
+    # homs-only VJP: transposition never touches the planes input, so the
+    # XLA planes scatter is skipped even eagerly (and the whole branch is
+    # DCE'd under jit when pose gradients are unused — the training case).
+    _, vjp_h = jax.vjp(lambda hh: _reference_render_batch(planes, hh), homs)
+    (dhoms,) = vjp_h(g)
+    return dplanes, dhoms
 
   fused.defvjp(fwd, bwd)
   return fused
-
-
-_FUSED = {n: _make_fused(n) for n in (2, SEP_WINDOWS)}
 
 
 def _make_shared(n_taps: int, n_windows: int):
@@ -1051,11 +1152,14 @@ def _render_mpi_fused_batch(planes, homs, separable, check, plan):
           "silently render wrong pixels. Pass separable=False (the "
           "shared-gather general kernel) or fix the pose.")
     n_windows = SEP_WINDOWS
+    adj_plan = None
     if homs_concrete:
       n_windows = _sep_windows_needed(homs, height, width)
+      from mpi_vision_tpu.kernels import render_pallas_bwd
+      adj_plan = render_pallas_bwd.plan_adjoint_sep(homs, height, width)
     if check and not fits_envelope(homs, height, width, True):
       return _reference_render_jit(planes, homs)
-    return _FUSED[n_windows](planes, homs)
+    return _make_fused(n_windows, adj_plan)(planes, homs)
 
   # General path: the shared-gather kernel, planned eagerly (tap fan +
   # window count mirrored from concrete homographies); traced opt-in calls

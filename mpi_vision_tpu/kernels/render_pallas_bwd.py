@@ -1,0 +1,505 @@
+"""Pallas TPU backward pass for the fused MPI render kernels.
+
+The reference trains with the renderer inside the loss (cell 12:38-42 of
+fast-torch-stereo-vision.ipynb), so the warp+composite backward is on the
+training hot path. The XLA route (``jax.vjp`` of the gather-based
+``reference_render``) transposes the warp gathers into scatters, which TPUs
+execute essentially scalar-by-scalar — the same reason the forward needed a
+kernel. This module is the TPU-native backward: three steps, two of them
+Pallas kernels that reuse the forward's sampling machinery.
+
+With ``out = composite(warp(planes))`` and the warp linear in plane values,
+
+  d planes = warp^T ( d composite/d warped (g) )
+
+  1. ``warp_planes_fused`` — re-warp every plane WITHOUT compositing (the
+     forward kernels minus the accumulator), emitting the warped stack
+     ``[B, P, 4, H, W]``. Recompute-not-store: the forward stays fused and
+     residual-free; one extra warp costs ~one forward.
+  2. ``_composite_bwd`` — the over-composite VJP on the warped stack via
+     ``jax.vjp`` of ``compose.over_composite_scan``: an elementwise scan
+     transpose XLA fuses well; no gathers, nothing to hand-write.
+  3. ``adjoint_warp_planes`` — the warp transpose, the actual new math.
+     For a homography warp, warp^T is a *tent-filter* warp along the
+     INVERSE map: contribution of gradient pixel (i, j) to source pixel
+     (y, x) is ``relu(1-|u(j,i)-x|) * relu(1-|v(j,i)-y|)`` — the forward
+     map evaluated at integer taps near ``hom^{-1}(x, y)``. Separable maps
+     make the two factors independent (u affine in j, v affine in i), so
+     the kernel is structurally the separable forward kernel with an
+     ``n_taps``-wide tap fan (tent support is ``2/scale``, not 2) and no
+     composite fold.
+
+Gradients w.r.t. the homographies are NOT computed here: the fused
+``custom_vjp`` takes them from the XLA reference path, which XLA dead-code
+eliminates under jit whenever pose gradients are unused — the training
+case (poses are data).
+
+Like the forward, the adjoint has an exact envelope (``plan_adjoint_sep``:
+band coverage and gather-window coverage of the inverse map, plus the
+static tap-fan width); out-of-envelope poses keep the XLA backward.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from mpi_vision_tpu.core import compose
+from mpi_vision_tpu.kernels import render_pallas as rp
+from mpi_vision_tpu.kernels.render_pallas import BAND, CHUNK, STRIP, WIN
+
+
+# ---------------------------------------------------------------------------
+# Step 1: warp without compositing (forward kernels minus the accumulator).
+
+
+def _warp_sep_kernel(hom_ref, planes_ref, out_ref, band_ref, sems,
+                     *, num_planes, height, width, n_windows):
+  """Separable warp of every plane: ``[B, P, 4, H, W]`` warped stack out."""
+  bi = pl.program_id(0)
+  s = pl.program_id(1)
+  p = pl.program_id(2)
+  n_s = pl.num_programs(1)
+  step = (bi * n_s + s) * num_planes + p
+  total = pl.num_programs(0) * n_s * num_planes
+  slot = jax.lax.rem(step, 2)
+  hom = [hom_ref[bi, p, k] for k in range(9)]
+  oy0 = (s * STRIP).astype(jnp.float32)
+
+  def band0_of(b_, p_, s_):
+    return rp._ymin_of([hom_ref[b_, p_, k] for k in range(9)],
+                       (s_ * STRIP).astype(jnp.float32), height, width)
+
+  ymin = band0_of(bi, p, s)
+  rp._sep_band_dma(planes_ref, band_ref, sems, band0_of, step=step,
+                   total=total, slot=slot, bi=bi, s=s, p=p, n_s=n_s,
+                   num_planes=num_planes)
+  ky = rp._sep_ky(hom, oy0, ymin)
+
+  def chunk_body(h, carry):
+    pix = rp._sep_chunk_sample(hom, band_ref, slot, h, ky, n_windows, width)
+    cols = pl.ds(pl.multiple_of(h * CHUNK, CHUNK), CHUNK)
+    for c in range(4):
+      out_ref[0, 0, c, :, cols] = pix[c]
+    return carry
+
+  jax.lax.fori_loop(0, width // CHUNK, chunk_body, 0)
+
+
+@functools.partial(jax.jit, static_argnames=("n_windows", "interpret"))
+def _warp_sep_call(planes, homs, n_windows: int, interpret: bool):
+  batch, num_planes, _, height, width = planes.shape
+  kernel = functools.partial(
+      _warp_sep_kernel, num_planes=num_planes, height=height, width=width,
+      n_windows=min(n_windows, width // WIN))
+  return pl.pallas_call(
+      kernel,
+      grid=(batch, height // STRIP, num_planes),
+      in_specs=[
+          pl.BlockSpec(memory_space=pltpu.SMEM),
+          pl.BlockSpec(memory_space=pl.ANY),
+      ],
+      out_specs=pl.BlockSpec((1, 1, 4, STRIP, width),
+                             lambda b, s, p: (b, p, 0, s, 0)),
+      out_shape=jax.ShapeDtypeStruct(
+          (batch, num_planes, 4, height, width), jnp.float32),
+      scratch_shapes=[
+          pltpu.VMEM((2, 4, BAND, width), jnp.float32),
+          pltpu.SemaphoreType.DMA((2,)),
+      ],
+      interpret=interpret,
+  )(homs.reshape(batch, num_planes, 9).astype(jnp.float32),
+    planes.astype(jnp.float32))
+
+
+def _warp_shr_kernel(hom_ref, meta_ref, meta_next_ref, wq_ref, planes_ref,
+                     out_ref, band_ref, sems,
+                     *, num_planes, height, width, n_windows, n_taps, tw,
+                     tsrc, bandg):
+  """Shared-gather (general homography) warp of every plane."""
+  bi = pl.program_id(0)
+  s = pl.program_id(1)
+  t = pl.program_id(2)
+  p = pl.program_id(3)
+  n_s = pl.num_programs(1)
+  n_t = pl.num_programs(2)
+  step = ((bi * n_s + s) * n_t + t) * num_planes + p
+  total = pl.num_programs(0) * n_s * n_t * num_planes
+  slot = jax.lax.rem(step, 2)
+  hom = [hom_ref[bi, p, k] for k in range(9)]
+  c_t = tw // CHUNK
+  ymin = pl.multiple_of(meta_ref[0, 0, 0, 0, p], 8)
+  xmin = pl.multiple_of(meta_ref[0, 0, 0, 1, p], WIN)
+
+  @pl.when(step == 0)
+  def _first_dma():
+    pltpu.make_async_copy(
+        planes_ref.at[bi, p, :, pl.ds(ymin, bandg), pl.ds(xmin, tsrc)],
+        band_ref.at[0], sems.at[0]).start()
+
+  pltpu.make_async_copy(
+      planes_ref.at[bi, p, :, pl.ds(ymin, bandg), pl.ds(xmin, tsrc)],
+      band_ref.at[slot], sems.at[slot]).wait()
+
+  @pl.when(step < total - 1)
+  def _next_dma():
+    same_tile = p + 1 < num_planes
+    p_n = jnp.where(same_tile, p + 1, 0)
+    last_tile = (t + 1 >= n_t) & (s + 1 >= n_s)
+    b_n = jnp.where(same_tile | ~last_tile, bi, bi + 1)
+    ymin_n = pl.multiple_of(meta_next_ref[0, 0, 0, 0, p_n], 8)
+    xmin_n = pl.multiple_of(meta_next_ref[0, 0, 0, 1, p_n], WIN)
+    pltpu.make_async_copy(
+        planes_ref.at[b_n, p_n, :, pl.ds(ymin_n, bandg), pl.ds(xmin_n, tsrc)],
+        band_ref.at[1 - slot], sems.at[1 - slot]).start()
+
+  lane = jax.lax.broadcasted_iota(
+      jnp.int32, (STRIP, tw), 1).astype(jnp.float32)
+  sub = jax.lax.broadcasted_iota(
+      jnp.int32, (STRIP, tw), 0).astype(jnp.float32)
+  u, v = rp._uv(hom, lane + (t * tw).astype(jnp.float32),
+                sub + (s * STRIP).astype(jnp.float32))
+  u = jnp.where(jnp.isfinite(u), u, 0.0)
+  v = jnp.where(jnp.isfinite(v), v, 0.0)
+
+  for ci in range(c_t):
+    w0 = pl.multiple_of(wq_ref[0, 0, 0, p, ci * 2], WIN)
+    q0 = pl.multiple_of(wq_ref[0, 0, 0, p, ci * 2 + 1], 8)
+    sl = slice(ci * CHUNK, (ci + 1) * CHUNK)
+    pix = rp._shr_chunk_sample(u[:, sl], v[:, sl], band_ref, slot, ymin,
+                               xmin, q0, w0, n_taps, n_windows, height,
+                               width)
+    cols = pl.ds(pl.multiple_of(ci * CHUNK, CHUNK), CHUNK)
+    for c in range(4):
+      out_ref[0, 0, c, :, cols] = pix[c]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("n_taps", "n_windows", "interpret"))
+def _warp_shr_call(planes, homs, n_taps: int, n_windows: int,
+                   interpret: bool):
+  grid, in_specs, operands, g = rp._shared_grid_setup(planes, homs,
+                                                      n_windows)
+  kernel = functools.partial(
+      _warp_shr_kernel, num_planes=g["num_planes"], height=g["height"],
+      width=g["width"], n_windows=g["n_eff"], n_taps=n_taps, tw=g["tw"],
+      tsrc=g["tsrc"], bandg=g["bandg"])
+  return pl.pallas_call(
+      kernel,
+      grid=grid,
+      in_specs=in_specs,
+      out_specs=pl.BlockSpec((1, 1, 4, STRIP, g["tw"]),
+                             lambda b, s, t, p: (b, p, 0, s, t)),
+      out_shape=jax.ShapeDtypeStruct(
+          (g["batch"], g["num_planes"], 4, g["height"], g["width"]),
+          jnp.float32),
+      scratch_shapes=[
+          pltpu.VMEM((2, 4, g["bandg"], g["tsrc"]), jnp.float32),
+          pltpu.SemaphoreType.DMA((2,)),
+      ],
+      interpret=interpret,
+  )(*operands)
+
+
+def warp_planes_fused(planes, homs, separable: bool,
+                      fwd_plan) -> jnp.ndarray:
+  """Warp every plane (no composite): ``[B, P, 4, H, W]`` warped stack.
+
+  ``fwd_plan`` is the forward kernel-variant choice: ``n_windows`` (int)
+  for the separable path, ``(n_taps, n_windows)`` for the general path.
+  """
+  interpret = jax.default_backend() != "tpu"
+  if separable:
+    return _warp_sep_call(planes, homs, fwd_plan, interpret)
+  n_taps, n_windows = fwd_plan
+  return _warp_shr_call(planes, homs, n_taps, n_windows, interpret)
+
+
+# ---------------------------------------------------------------------------
+# Step 2: over-composite VJP on the warped stack (plain XLA).
+
+
+def _composite_bwd(warped: jnp.ndarray, g: jnp.ndarray) -> jnp.ndarray:
+  """d composite / d warped, contracted with ``g``.
+
+  ``warped``: ``[B, P, 4, H, W]``; ``g``: ``[B, 3, H, W]``. Returns
+  ``[B, P, 4, H, W]`` (RGB grads in channels 0-2, alpha grad in 3). The
+  scan transpose is elementwise over pixels — XLA fuses it; no kernel.
+  """
+  w = jnp.swapaxes(jnp.moveaxis(warped, 2, -1), 0, 1)   # [P, B, H, W, 4]
+  _, vjp = jax.vjp(compose.over_composite_scan, w)
+  (dw,) = vjp(jnp.moveaxis(g, 1, -1))
+  return jnp.moveaxis(jnp.swapaxes(dw, 0, 1), -1, 2)
+
+
+# ---------------------------------------------------------------------------
+# Step 3: the warp transpose (tent-filter warp along the inverse map).
+
+
+def _band0_of(ci, di, oy0, height):
+  """First gradient-image row (8-aligned, clamped) whose forward-mapped
+  position can reach source strip ``oy0``: contributors to source rows
+  ``[oy0, oy0+7]`` are rows i with ``v(i) in (oy0-1, oy0+8)``."""
+  i_lo = (oy0 - 1.0 - di) / ci
+  i_lo = jnp.where(jnp.isfinite(i_lo), i_lo, 0.0)
+  b0 = jnp.clip(jnp.floor(i_lo).astype(jnp.int32) - 1, 0, height - BAND)
+  return pl.multiple_of((b0 // 8) * 8, 8)
+
+
+def _adjoint_sep_kernel(hom_ref, grad_ref, out_ref, band_ref, sems,
+                        *, num_planes, height, width, n_taps, n_windows):
+  """Separable warp transpose: ``d planes = warp^T(d warped)``.
+
+  Grid ``(batch, source strip, plane)``. Per step, DMA the gradient-image
+  band whose rows forward-map into the strip, then for each source pixel
+  accumulate ``sum_j relu(1-|u(j)-x|) * sum_i relu(1-|v(i)-y|) * dwarp``:
+  the horizontal factor as an ``n_taps`` tap fan from the inverse-mapped
+  origin (tent support ``2/scale``), the vertical factor as the forward
+  kernel's KY outer-product with the roles of strip rows and band rows
+  swapped. Both factors evaluate the FORWARD map at integer taps, so the
+  weights are exactly the forward kernel's a.e. bilinear derivatives.
+  """
+  bi = pl.program_id(0)
+  s = pl.program_id(1)
+  p = pl.program_id(2)
+  n_s = pl.num_programs(1)
+  step = (bi * n_s + s) * num_planes + p
+  total = pl.num_programs(0) * n_s * num_planes
+  slot = jax.lax.rem(step, 2)
+
+  def inv_scalars(hom):
+    # Separable: u = a*j + b, v = c*i + d in pixel space.
+    a = hom[0] / hom[8]
+    b = hom[2] / hom[8]
+    c = hom[4] / hom[8]
+    d = hom[5] / hom[8]
+    return a, b, c, d
+
+  hom = [hom_ref[bi, p, k] for k in range(9)]
+  a, b, c, d = inv_scalars(hom)
+  oy0 = (s * STRIP).astype(jnp.float32)
+
+  def band0_of(b_, p_, s_):
+    _, _, c_, d_ = inv_scalars([hom_ref[b_, p_, k] for k in range(9)])
+    return _band0_of(c_, d_, (s_ * STRIP).astype(jnp.float32), height)
+
+  band0 = band0_of(bi, p, s)
+  rp._sep_band_dma(grad_ref, band_ref, sems, band0_of, step=step,
+                   total=total, slot=slot, bi=bi, s=s, p=p, n_s=n_s,
+                   num_planes=num_planes)
+
+  # Vertical adjoint weights: ky2[r, q] = relu(1 - |v(band0+q) - (oy0+r)|)
+  # — the forward KY with strip rows and band rows swapped (band rows are
+  # gradient-image rows, always in-image by construction of band0).
+  sub8 = jax.lax.broadcasted_iota(
+      jnp.int32, (STRIP, CHUNK), 0).astype(jnp.float32)
+  lane = jax.lax.broadcasted_iota(
+      jnp.int32, (STRIP, CHUNK), 1).astype(jnp.float32)
+  v_band = c * (lane + band0.astype(jnp.float32)) + d
+  ky2 = jnp.maximum(0.0, 1.0 - jnp.abs(v_band - (sub8 + oy0)))
+  inv_a = 1.0 / a
+
+  def chunk_body(h, carry):
+    ox0 = (h * CHUNK).astype(jnp.float32)
+    xs = lane[:1] + ox0                                  # [1, CHUNK]
+    jref = (xs - b) * inv_a                              # inverse map
+    jhat_f = jnp.floor(jref - inv_a)                     # fan origin
+    jhat = jhat_f.astype(jnp.int32)
+
+    # Gather-window base from the chunk's inverse-mapped extents (mirrors
+    # the forward's w0; the planner checked coverage).
+    ja = (ox0 - b) * inv_a - inv_a
+    jb = (ox0 + CHUNK - 1.0 - b) * inv_a - inv_a
+    ja = jnp.where(jnp.isfinite(ja), ja, 0.0)
+    jb = jnp.where(jnp.isfinite(jb), jb, 0.0)
+    j_lo = jnp.floor(jnp.minimum(ja, jb)).astype(jnp.int32)
+    w0 = jnp.clip((j_lo // WIN) * WIN, 0, width - n_windows * WIN)
+
+    xles = None
+    for tt in range(n_taps):
+      jt = jhat + tt
+      u_t = a * jt.astype(jnp.float32) + b
+      wt = jnp.maximum(0.0, 1.0 - jnp.abs(u_t - xs))     # tent weight
+      wt = jnp.where((jt >= 0) & (jt <= width - 1), wt, 0.0)
+      rel0 = jt - w0
+      for wi in range(n_windows):
+        rel = rel0 - wi * WIN
+        inw = (rel >= 0) & (rel < WIN)
+        coeff = jnp.where(inw, wt, 0.0)
+        idx = jnp.broadcast_to(jnp.clip(rel, 0, WIN - 1), (BAND, CHUNK))
+        base = pl.multiple_of(w0 + wi * WIN, WIN)
+        outs = []
+        for ch in range(4):
+          win = band_ref[slot, ch, :, pl.ds(base, WIN)]
+          g = jnp.take_along_axis(win, idx, axis=1)
+          outs.append(g * coeff)
+        xles = outs if xles is None else [x + o for x, o in zip(xles, outs)]
+
+    pix = [jnp.zeros((STRIP, CHUNK), jnp.float32) for _ in range(4)]
+    for q in range(BAND):
+      kyq = ky2[:, q:q + 1]
+      pix = [acc + kyq * x[q:q + 1] for acc, x in zip(pix, xles)]
+    cols = pl.ds(pl.multiple_of(h * CHUNK, CHUNK), CHUNK)
+    for ch in range(4):
+      out_ref[0, 0, ch, :, cols] = pix[ch]
+    return carry
+
+  jax.lax.fori_loop(0, width // CHUNK, chunk_body, 0)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("n_taps", "n_windows", "interpret"))
+def _adjoint_sep_call(grad_warped, homs, n_taps: int, n_windows: int,
+                      interpret: bool):
+  batch, num_planes, _, height, width = grad_warped.shape
+  kernel = functools.partial(
+      _adjoint_sep_kernel, num_planes=num_planes, height=height,
+      width=width, n_taps=n_taps, n_windows=min(n_windows, width // WIN))
+  return pl.pallas_call(
+      kernel,
+      grid=(batch, height // STRIP, num_planes),
+      in_specs=[
+          pl.BlockSpec(memory_space=pltpu.SMEM),
+          pl.BlockSpec(memory_space=pl.ANY),
+      ],
+      out_specs=pl.BlockSpec((1, 1, 4, STRIP, width),
+                             lambda b, s, p: (b, p, 0, s, 0)),
+      out_shape=jax.ShapeDtypeStruct(
+          (batch, num_planes, 4, height, width), jnp.float32),
+      scratch_shapes=[
+          pltpu.VMEM((2, 4, BAND, width), jnp.float32),
+          pltpu.SemaphoreType.DMA((2,)),
+      ],
+      interpret=interpret,
+  )(homs.reshape(batch, num_planes, 9).astype(jnp.float32),
+    grad_warped.astype(jnp.float32))
+
+
+def plan_adjoint_sep(homs, height: int, width: int):
+  """Static ``(n_taps, n_windows)`` for the separable adjoint, or None.
+
+  Mirrors the kernel's band / fan / window arithmetic in f64 (like
+  ``fits_envelope``), with one-row/one-column safety margins so an f32
+  divergence in the kernel's floor cannot escape coverage:
+
+    * scales must be positive and finite (mirrored/degenerate maps -> XLA);
+    * the tap fan ``floor(jref - 1/a) + [0, n_taps)`` must cover the tent
+      support ``jref ± 1/a`` -> ``n_taps = floor(2/a) + 2``, capped at 6;
+    * every gradient row that forward-maps within 1 of a source strip must
+      lie in the strip's 24-row band (band start mirrors ``_band0_of``);
+    * every tap column of a 128-column source chunk must lie in its
+      ``n_windows`` gather windows (bases aligned down from the chunk's
+      leftmost tap, mirroring the kernel's ``w0``).
+  """
+  h64 = np.asarray(homs, np.float64).reshape(-1, 3, 3)
+  h32 = np.asarray(homs, np.float32).reshape(-1, 3, 3)
+  with np.errstate(divide="ignore", invalid="ignore"):
+    a = h64[:, 0, 0] / h64[:, 2, 2]
+    b = h64[:, 0, 2] / h64[:, 2, 2]
+    c = h64[:, 1, 1] / h64[:, 2, 2]
+    d = h64[:, 1, 2] / h64[:, 2, 2]
+    # The kernel's own f32 arithmetic, op for op, for the band/window
+    # bases (the same mirroring strategy as _plan_shared_stats: the check
+    # must see the very values the kernel computes, not a higher-precision
+    # restatement of them).
+    b32 = h32[:, 0, 2] / h32[:, 2, 2]
+    c32 = h32[:, 1, 1] / h32[:, 2, 2]
+    d32 = h32[:, 1, 2] / h32[:, 2, 2]
+    inv_a32 = np.float32(1.0) / (h32[:, 0, 0] / h32[:, 2, 2])
+  vals = np.stack([a, b, c, d])
+  if not np.isfinite(vals).all() or (a <= 1e-6).any() or (c <= 1e-6).any():
+    return None
+
+  inv_a = 1.0 / a                                          # [P]
+  n_taps = int(np.floor(2.0 * inv_a.max())) + 2
+  if n_taps > 6:
+    return None
+  # A contributor within TOL of its tent boundary carries <= TOL weight, so
+  # dropping it on an f32/f64 floor disagreement costs <= TOL — half the
+  # 1e-3 parity budget (same tolerance policy as the forward planners).
+  tol = 5e-4
+
+  # Vertical: contributors to source rows [y0, y0+7] are gradient rows i
+  # with v(i) in (y0-1, y0+8) — the open interval ((y0-1-d)/c, (y0+8-d)/c).
+  n_strips = height // STRIP
+  y0 = np.arange(n_strips, dtype=np.float64)[:, None] * STRIP  # [S, 1]
+  i_lo = (y0 - 1.0 - d[None, :]) / c[None, :]              # [S, P]
+  i_hi = (y0 + STRIP - d[None, :]) / c[None, :]
+  q_lo = np.maximum(np.floor(i_lo - tol).astype(np.int64) + 1, 0)
+  q_hi = np.minimum(np.ceil(i_hi + tol).astype(np.int64) - 1, height - 1)
+  empty_v = q_lo > q_hi
+  i_lo32 = ((y0.astype(np.float32) - np.float32(1.0) - d32[None, :])
+            / c32[None, :])                                # _band0_of, f32
+  # The kernel's scalar-core f32 divide is not guaranteed bit-identical to
+  # this numpy mirror, so when the value sits near an integer its floor can
+  # resolve either way; require coverage under BOTH resolutions (a generous
+  # multi-ulp band), rejecting near-boundary poses to the XLA backward.
+  eps_v = np.maximum(np.abs(i_lo32), 1.0) * np.float32(1e-5)
+  for i_lo_c in (i_lo32 - eps_v, i_lo32 + eps_v):
+    band0 = np.clip(np.floor(i_lo_c).astype(np.int64) - 1, 0,
+                    height - BAND) // 8 * 8
+    if not (empty_v | ((q_lo >= band0) & (q_hi <= band0 + BAND - 1))).all():
+      return None
+
+  # Horizontal: contributors to a chunk's columns [x0, x0+127] are
+  # gradient columns j with u(j) in (x0-1, x0+128) — the open interval
+  # (jref(x0) - 1/a, jref(x0+127) + 1/a) for a > 0.
+  n_chunks = width // CHUNK
+  x_edges = (np.arange(n_chunks, dtype=np.float64)[:, None] * CHUNK
+             + np.array([0.0, CHUNK - 1.0]))               # [C, 2]
+  jref = ((x_edges[..., None] - b) * inv_a).transpose(2, 0, 1)  # [P, C, 2]
+  j_lo = np.maximum(
+      np.floor(jref.min(axis=2) - inv_a[:, None] - tol).astype(np.int64) + 1,
+      0)
+  j_hi = np.minimum(
+      np.ceil(jref.max(axis=2) + inv_a[:, None] + tol).astype(np.int64) - 1,
+      width - 1)
+  empty_h = j_lo > j_hi
+  # The kernel's f32 window base: floor of the chunk-edge fan origins.
+  x32 = x_edges.astype(np.float32)
+  ja32 = ((x32[:, 0][None, :] - b32[:, None]) * inv_a32[:, None]
+          - inv_a32[:, None])                              # [P, C]
+  jb32 = ((x32[:, 1][None, :] - b32[:, None]) * inv_a32[:, None]
+          - inv_a32[:, None])
+  j_base = np.minimum(ja32, jb32)
+  eps_h = np.maximum(np.abs(j_base), 1.0) * np.float32(1e-5)
+  for n_windows in (2, 3):
+    if width < n_windows * WIN:
+      continue
+    ok = True
+    # Both floor resolutions of the kernel's f32 window base must cover
+    # (same reasoning as the vertical band above).
+    for j_base_c in (j_base - eps_h, j_base + eps_h):
+      w0 = np.clip(np.floor(j_base_c).astype(np.int64) // WIN * WIN, 0,
+                   width - n_windows * WIN)
+      ok = ok and bool(
+          (empty_h | ((j_lo >= w0)
+                      & (j_hi <= w0 + n_windows * WIN - 1))).all())
+    if ok:
+      return n_taps, n_windows
+  return None
+
+
+# ---------------------------------------------------------------------------
+# Assembly.
+
+
+def backward_planes(planes, homs, g, separable: bool, fwd_plan,
+                    adj_plan) -> jnp.ndarray:
+  """``d loss / d planes`` for ``g = d loss / d render``: warp, composite
+  VJP, warp transpose. All arguments batched (``[B, P, 4, H, W]`` planes,
+  ``[B, P, 3, 3]`` homs, ``[B, 3, H, W]`` g)."""
+  if not separable:
+    raise NotImplementedError(
+        "Pallas backward currently covers the separable path; general "
+        "homographies keep the XLA backward")
+  interpret = jax.default_backend() != "tpu"
+  warped = warp_planes_fused(planes, homs, separable, fwd_plan)
+  dwarped = _composite_bwd(warped, g)
+  n_taps, n_windows = adj_plan
+  return _adjoint_sep_call(dwarped, homs, n_taps, n_windows, interpret)

@@ -1,0 +1,209 @@
+"""Tests for the Pallas backward pass (interpret mode on CPU).
+
+Oracle: ``jax.vjp`` of ``reference_render`` — the same XLA path the
+forward kernels are pinned against, whose own gradients are covered by
+tests/test_sampling.py (bilinear grads vs torch).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpi_vision_tpu.core.camera import inv_depths
+from mpi_vision_tpu.kernels import render_pallas as rp
+from mpi_vision_tpu.kernels import render_pallas_bwd as rpb
+
+
+def _mpi(rng, p, h, w, batch=None):
+  shape = (p, 4, h, w) if batch is None else (batch, p, 4, h, w)
+  return jnp.asarray(rng.uniform(0, 1, shape).astype(np.float32))
+
+
+def _intrinsics(h, w):
+  return jnp.asarray(
+      np.array([[0.6 * w, 0, w / 2], [0, 0.6 * w, h / 2], [0, 0, 1]],
+               np.float32))[None]
+
+
+def _pose(tx=0.0, ty=0.0, tz=0.0, rx=0.0, ry=0.0):
+  pose = np.eye(4, dtype=np.float32)
+  cx, sx = np.cos(rx), np.sin(rx)
+  cy, sy = np.cos(ry), np.sin(ry)
+  rot_x = np.array([[1, 0, 0], [0, cx, -sx], [0, sx, cx]], np.float32)
+  rot_y = np.array([[cy, 0, sy], [0, 1, 0], [-sy, 0, cy]], np.float32)
+  pose[:3, :3] = rot_y @ rot_x
+  pose[:3, 3] = [tx, ty, tz]
+  return jnp.asarray(pose)[None]
+
+
+def _homs(h, w, p=4, **pose_kw):
+  depths = inv_depths(1.0, 100.0, p)
+  return rp.pixel_homographies(
+      _pose(**pose_kw), depths, _intrinsics(h, w), h, w)[:, 0]
+
+
+def _reference_warp(planes, homs):
+  """Per-plane XLA warp (reference_render without the composite)."""
+  from mpi_vision_tpu.core import geometry, sampling
+  _, _, h, w = planes.shape
+  nhwc = jnp.moveaxis(planes, 1, -1)[:, None]
+  grid = jnp.moveaxis(geometry.homogeneous_grid(h, w), 0, -1)
+  pts = geometry.apply_homography(grid, homs[:, None])
+  xy = geometry.from_homogeneous(pts)
+  coords = (xy + 0.5) / jnp.array([w, h], xy.dtype)
+  warped = sampling.bilinear_sample(nhwc, coords)       # [P, 1, H, W, 4]
+  return jnp.moveaxis(warped[:, 0], -1, 1)              # [P, 4, H, W]
+
+
+TRANSLATION = dict(tx=0.06, ty=-0.03, tz=-0.04)
+ROTATION = dict(tx=0.04, ty=0.02, tz=0.03, rx=0.006, ry=-0.008)
+
+
+class TestWarpPlanesFused:
+
+  def test_separable_matches_reference_warp(self, rng):
+    p, h, w = 4, 32, 256
+    planes = _mpi(rng, p, h, w)
+    homs = _homs(h, w, p, **TRANSLATION)
+    assert rp.is_separable(homs)
+    n_windows = rp._sep_windows_needed(homs, h, w)
+    got = rpb.warp_planes_fused(planes[None], homs[None], True, n_windows)[0]
+    want = _reference_warp(planes, homs)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+  def test_general_matches_reference_warp(self, rng):
+    p, h, w = 4, 32, 256
+    planes = _mpi(rng, p, h, w)
+    homs = _homs(h, w, p, **ROTATION)
+    assert not rp.is_separable(homs)
+    plan = rp._plan_shared(homs, h, w)
+    assert plan is not None
+    got = rpb.warp_planes_fused(planes[None], homs[None], False, plan)[0]
+    want = _reference_warp(planes, homs)
+    # f32 tap-boundary wobble on the shared-gather path: the kernel's
+    # in-kernel u/v and the XLA warp's coords can floor one ulp apart near
+    # integer boundaries, worth <= the boundary tap's weight (~1e-4); the
+    # repo-wide parity budget is 1e-3 (BASELINE.md).
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-3)
+
+
+class TestPlanAdjointSep:
+
+  def test_normal_translation_accepted(self):
+    h, w = 32, 256
+    plan = rpb.plan_adjoint_sep(_homs(h, w, **TRANSLATION), h, w)
+    assert plan is not None
+    n_taps, n_windows = plan
+    assert 2 <= n_taps <= 6 and n_windows in (2, 3)
+
+  def test_mirrored_map_rejected(self):
+    homs = jnp.asarray(
+        np.diag([-1.0, 1.0, 1.0]).astype(np.float32))[None]
+    assert rpb.plan_adjoint_sep(homs, 32, 256) is None
+
+  def test_extreme_minification_rejected(self):
+    # Forward scale 0.2 => tent support 10 source columns: fan > 6 taps.
+    homs = jnp.asarray(np.diag([0.2, 1.0, 1.0]).astype(np.float32))[None]
+    assert rpb.plan_adjoint_sep(homs, 32, 256) is None
+
+
+class TestBackwardPlanes:
+
+  def _check(self, rng, pose_kw, p=4, h=32, w=256, batch=1, atol=2e-4):
+    planes = _mpi(rng, p, h, w, batch=batch)
+    homs = jnp.stack([_homs(h, w, p, **pose_kw)] * batch)
+    assert rp.is_separable(homs)
+    assert rp.fits_envelope(homs, h, w, True)
+    n_windows = rp._sep_windows_needed(homs, h, w)
+    adj_plan = rpb.plan_adjoint_sep(homs, h, w)
+    assert adj_plan is not None
+    g = jnp.asarray(rng.normal(size=(batch, 3, h, w)).astype(np.float32))
+    got = rpb.backward_planes(planes, homs, g, True, n_windows, adj_plan)
+    _, vjp = jax.vjp(rp._reference_render_batch, planes, homs)
+    want, _ = vjp(g)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=atol)
+
+  def test_translation(self, rng):
+    self._check(rng, TRANSLATION)
+
+  def test_zoom(self, rng):
+    self._check(rng, dict(tz=0.25))
+
+  def test_batched(self, rng):
+    self._check(rng, TRANSLATION, batch=2)
+
+  def test_identity(self, rng):
+    self._check(rng, {})
+
+  def test_property_random_separable_poses(self, rng):
+    """Accepted poses' Pallas backward matches the XLA VJP."""
+    h, w, p = 32, 256, 3
+    checked = 0
+    for _ in range(12):
+      pose_kw = dict(
+          tx=float(rng.uniform(-0.15, 0.15)),
+          ty=float(rng.uniform(-0.15, 0.15)),
+          tz=float(rng.uniform(-0.3, 0.3)))
+      homs = _homs(h, w, p, **pose_kw)
+      if not rp.fits_envelope(homs, h, w, True):
+        continue
+      if rpb.plan_adjoint_sep(homs, h, w) is None:
+        continue
+      self._check(rng, pose_kw, p=p, h=h, w=w)
+      checked += 1
+    assert checked >= 6
+
+
+class TestFusedVjpIntegration:
+
+  def test_grad_through_render_mpi_fused_matches_reference(self, rng):
+    p, h, w = 4, 32, 256
+    planes = _mpi(rng, p, h, w)
+    homs = _homs(h, w, p, **TRANSLATION)
+    wmat = jnp.asarray(rng.normal(size=(3, h, w)).astype(np.float32))
+
+    def loss_fused(pl_):
+      return jnp.sum(rp.render_mpi_fused(pl_, homs, separable=True) * wmat)
+
+    def loss_ref(pl_):
+      return jnp.sum(rp.reference_render(pl_, homs) * wmat)
+
+    got = jax.grad(loss_fused)(planes)
+    want = jax.grad(loss_ref)(planes)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-4)
+
+  def test_pallas_backward_actually_engaged(self, rng, monkeypatch):
+    """The separable in-envelope grad path runs the Pallas backward."""
+    p, h, w = 3, 32, 256
+    planes = _mpi(rng, p, h, w)
+    homs = _homs(h, w, p, **TRANSLATION)
+    calls = []
+    real = rpb.backward_planes
+
+    def spy(*args, **kwargs):
+      calls.append(1)
+      return real(*args, **kwargs)
+
+    monkeypatch.setattr(rpb, "backward_planes", spy)
+    rp._make_fused.cache_clear()
+    try:
+      jax.grad(lambda pl_: jnp.sum(
+          rp.render_mpi_fused(pl_, homs, separable=True)))(planes)
+    finally:
+      rp._make_fused.cache_clear()
+    assert calls
+
+  def test_hom_grads_still_match_reference(self, rng):
+    p, h, w = 3, 32, 256
+    planes = _mpi(rng, p, h, w)
+    homs = _homs(h, w, p, **TRANSLATION)
+    wmat = jnp.asarray(rng.normal(size=(3, h, w)).astype(np.float32))
+
+    got = jax.grad(lambda hh: jnp.sum(
+        rp.render_mpi_fused(planes, hh, separable=True, check=False)
+        * wmat))(homs)
+    want = jax.grad(lambda hh: jnp.sum(
+        rp.reference_render(planes, hh) * wmat))(homs)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-3, atol=1e-3)

@@ -1,0 +1,100 @@
+"""Renderer-backward timing: Pallas backward vs the XLA gather/scatter VJP.
+
+The training loss renders through the MPI pipeline (cell 12:38-42), so
+``d loss / d planes`` through warp+composite is the training hot path.
+This script times ``jax.grad`` of a scalar loss through the fused renderer
+(kernels/render_pallas_bwd: warp, composite VJP, tent-filter adjoint)
+against the same gradient through the XLA reference path, at the
+reference's two training configs (224^2 x 10 planes, cell 14; 480^2 x 33
+planes, cell 7 md) and the 1080p x 32 inference size — the measurement
+VERDICT r2 item 10 asked for.
+
+One JSON line: value = Pallas-backward seconds/step at the 480^2 config,
+vs_baseline = XLA seconds / Pallas seconds there (>= 1.0 means the Pallas
+backward wins); per-config fields for the rest.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(1, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np  # noqa: E402
+
+import _common  # noqa: E402
+
+
+CONFIGS = (
+    ("train224", 224, 224, 10),
+    ("train480", 480, 480, 33),
+    ("infer1080", 1080, 1920, 32),
+)
+
+
+def main() -> None:
+  import jax
+  import jax.numpy as jnp
+
+  from mpi_vision_tpu.core.camera import inv_depths
+  from mpi_vision_tpu.kernels import render_pallas as rp
+
+  on_tpu = jax.default_backend() == "tpu"
+  rng = np.random.default_rng(0)
+  results = {}
+  for name, h, w, p in CONFIGS:
+    if not on_tpu and h > 256:
+      _common.log(f"{name}: skipped off-TPU")
+      continue
+    planes = jnp.asarray(rng.uniform(0, 1, (p, 4, h, w)).astype(np.float32))
+    depths = jnp.asarray(np.asarray(inv_depths(1.0, 100.0, p)))
+    pose = np.eye(4, dtype=np.float32)
+    r = np.radians(0.5)
+    c, s = np.cos(r), np.sin(r)
+    pose[:3, :3] = [[c, 0, s], [0, 1, 0], [-s, 0, c]]
+    pose[0, 3], pose[2, 3] = 0.03, -0.02
+    k = np.array([[0.5 * w, 0, w / 2], [0, 0.5 * w, h / 2], [0, 0, 1]],
+                 np.float32)
+    homs = rp.pixel_homographies(
+        jnp.asarray(pose)[None], depths, jnp.asarray(k)[None], h, w)[:, 0]
+    # plan_fused plans at the kernel's auto-padded geometry — exactly what
+    # render_mpi_fused executes for off-tile-grid sizes.
+    bundle = rp.plan_fused(homs, h, w)
+    if bundle is None or bundle["separable"] or bundle["adj_plan"] is None:
+      _common.log(f"{name}: pose outside kernel/adjoint envelope; skipped")
+      continue
+
+    loss_pallas = jax.jit(jax.grad(
+        lambda pl_: jnp.sum(rp.render_mpi_fused(pl_, homs,
+                                                separable=False) ** 2)))
+    loss_xla = jax.jit(jax.grad(
+        lambda pl_: jnp.sum(rp.reference_render(pl_, homs) ** 2)))
+    _, t_pallas = _common.time_fn(loss_pallas, planes, iters=5)
+    _, t_xla = _common.time_fn(loss_xla, planes, iters=3)
+    results[f"{name}_pallas_s"] = round(t_pallas, 4)
+    results[f"{name}_xla_s"] = round(t_xla, 4)
+    results[f"{name}_speedup"] = round(t_xla / t_pallas, 2)
+    _common.log(f"{name}: pallas {t_pallas:.4f}s  xla {t_xla:.4f}s  "
+                f"speedup {t_xla / t_pallas:.2f}x")
+
+  key = "train480_pallas_s"
+  if key not in results:
+    if on_tpu:
+      raise SystemExit("no 480^2 measurement (outside kernel envelope?)")
+    # Off-TPU (interpret-mode) smoke run: emit whatever was measured so the
+    # script exercises end to end, flagged as not a real number.
+    _common.emit("render_backward_480p33_seconds", -1.0, "s/step", 0.0,
+                 note="no TPU: interpret-mode smoke only", **results)
+    return
+  _common.emit(
+      "render_backward_480p33_seconds",
+      results[key],
+      "s/step",
+      results["train480_speedup"],
+      **results)
+
+
+if __name__ == "__main__":
+  main()

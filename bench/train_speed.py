@@ -31,9 +31,18 @@ from _common import emit, log
 REF_STEP_S = {224: 40.5 / 150.0, 480: 360.0 / 150.0}
 
 
-def _batch(rng, hw: int, p: int):
+def _pose(rotate: bool) -> np.ndarray:
   pose = np.eye(4, dtype=np.float32)
   pose[0, 3] = 0.05
+  if rotate:
+    r = np.radians(0.5)
+    c, s = np.cos(r), np.sin(r)
+    pose[:3, :3] = [[c, 0, s], [0, 1, 0], [-s, 0, c]]
+  return pose
+
+
+def _batch(rng, hw: int, p: int, rotate: bool = False):
+  pose = _pose(rotate)
   return {
       "net_input": rng.uniform(-1, 1, (1, hw, hw, 3 + 3 * p)).astype(
           np.float32),
@@ -47,7 +56,8 @@ def _batch(rng, hw: int, p: int):
   }
 
 
-def time_config(hw: int, planes: int, steps: int) -> float:
+def time_config(hw: int, planes: int, steps: int, planned: bool,
+                rotate: bool = False) -> float:
   import jax
   import jax.numpy as jnp
 
@@ -57,9 +67,10 @@ def time_config(hw: int, planes: int, steps: int) -> float:
   cfg = config.TrainConfig(
       data=config.DataConfig(img_size=hw, num_planes=planes))
   state = cfg.make_train_state(jax.random.PRNGKey(0))
-  step = cfg.make_train_step()        # default VGG weights, resize 224
+  step = cfg.make_train_step(planned=planned)  # default VGG, resize 224
   rng = np.random.default_rng(0)
-  batch = {k: jnp.asarray(v) for k, v in _batch(rng, hw, planes).items()}
+  batch = {k: jnp.asarray(v)
+           for k, v in _batch(rng, hw, planes, rotate).items()}
   batch["mpi_planes"] = inv_depths(
       cfg.data.depth_near, cfg.data.depth_far, planes)
 
@@ -83,12 +94,24 @@ def main() -> None:
   log(f"backend={jax.default_backend()}")
   configs = [(224, 10), (480, 33)] if on_tpu else [(64, 4)]
   for hw, planes in configs:
-    sec = time_config(hw, planes, args.steps)
     ref = REF_STEP_S.get(hw)
-    log(f"{hw}^2 x {planes} planes: {sec * 1e3:.0f} ms/step"
-        + (f" (reference Colab GPU ~{ref * 1e3:.0f} ms)" if ref else ""))
-    emit(f"train_step_{hw}px_{planes}planes_seconds", sec, "s/step",
-         (ref / sec) if ref else 1.0, img_size=hw, planes=planes)
+    extra = {}
+    best = None
+    # XLA render step vs the planned fused-Pallas step (forward+backward);
+    # at 480^2 also a rotated pose (the general adjoint kernel's case).
+    for tag, planned, rotate in (("xla", False, False),
+                                 ("planned", True, False),
+                                 ("planned_rot", True, hw >= 480)):
+      if tag == "planned_rot" and not rotate:
+        continue
+      sec = time_config(hw, planes, args.steps, planned, rotate)
+      extra[f"{tag}_s"] = round(sec, 4)
+      if tag != "planned_rot":
+        best = sec if best is None else min(best, sec)
+      log(f"{hw}^2 x {planes} planes [{tag}]: {sec * 1e3:.0f} ms/step"
+          + (f" (reference Colab GPU ~{ref * 1e3:.0f} ms)" if ref else ""))
+    emit(f"train_step_{hw}px_{planes}planes_seconds", best, "s/step",
+         (ref / best) if ref else 1.0, img_size=hw, planes=planes, **extra)
 
 
 if __name__ == "__main__":

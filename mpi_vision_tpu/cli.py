@@ -66,15 +66,16 @@ def cmd_train(args: argparse.Namespace) -> dict:
       vgg_resize=args.vgg_resize if args.vgg_resize > 0 else None)
   dataset = cfg.data.make_dataset(rng=np.random.default_rng(args.seed))
   state = cfg.make_train_state(jax.random.PRNGKey(args.seed))
-  step = cfg.make_train_step("default" if args.vgg_loss else None)
+  step = cfg.make_train_step("default" if args.vgg_loss else None,
+                             planned=args.planned_render)
 
   order = np.random.default_rng(args.seed + 1)
   t0 = time.time()
   all_losses = []
   for epoch in range(cfg.epochs):
     state, losses = train_loop.fit(
-        state, realestate.iterate_batches(
-            dataset, batch_size=cfg.data.batch_size, rng=order),
+        state, realestate.prefetch_batches(realestate.iterate_batches(
+            dataset, batch_size=cfg.data.batch_size, rng=order)),
         step=step)
     all_losses.extend(losses)
     if losses:
@@ -150,6 +151,10 @@ def build_parser() -> argparse.ArgumentParser:
                  default=True, help="VGG-perceptual loss (reference) or L2")
   t.add_argument("--vgg-resize", type=int, default=224,
                  help="loss resize (cell 12); <= 0 disables")
+  t.add_argument("--planned-render", action=argparse.BooleanOptionalAction,
+                 default=False,
+                 help="render the loss through the fused Pallas kernels "
+                      "(forward+backward), planned per batch on the host")
   t.add_argument("--seed", type=int, default=0)
   t.add_argument("--ckpt", default="", help="orbax checkpoint directory")
   t.add_argument("--export-html", default="",

@@ -65,16 +65,22 @@ class TrainConfig:
         image_size=(self.data.img_size, self.data.img_size),
         learning_rate=self.learning_rate, norm=self.norm)
 
-  def make_train_step(self, vgg_params="default"):
+  def make_train_step(self, vgg_params="default", planned: bool = False):
     """Jitted train step with the reference loss. ``vgg_params='default'``
     resolves ``train.vgg.default_params()`` (a real checkpoint when
     ``MPI_VISION_VGG16_CKPT`` points at one, else the fixed fallback);
-    pass ``None`` for the L2-only metric loss."""
+    pass ``None`` for the L2-only metric loss. ``planned=True`` renders the
+    loss through the fused Pallas kernels forward AND backward, planning
+    each batch's poses on the host (``train.loop.make_train_step_planned``;
+    out-of-envelope batches fall back to the XLA step)."""
     from mpi_vision_tpu.train import vgg
-    from mpi_vision_tpu.train.loop import make_train_step
+    from mpi_vision_tpu.train.loop import (make_train_step,
+                                           make_train_step_planned)
 
     if isinstance(vgg_params, str) and vgg_params == "default":
       vgg_params = vgg.default_params()
+    if planned:
+      return make_train_step_planned(vgg_params, resize=self.vgg_resize)
     return make_train_step(vgg_params, resize=self.vgg_resize)
 
 

@@ -104,7 +104,8 @@ def render_mpi(
     planes_leading: bool = False,
     separable: bool | None = None,
     check: bool = True,
-    plan: tuple[int, int] | None | object = _PLAN_UNSET,
+    plan: tuple[int, int] | int | None | object = _PLAN_UNSET,
+    adj_plan: tuple | None | object = _PLAN_UNSET,
 ) -> jnp.ndarray:
   """Render a novel view from an MPI. The reference's ``mpi_render_view_torch``.
 
@@ -130,11 +131,14 @@ def render_mpi(
       eagerly and fall back to XLA outside it (requires concrete poses;
       raises under jit). ``check=False`` opts into the unchecked kernel:
       the caller owns the envelope (see kernels/render_pallas.py).
-    plan: for 'fused_pallas' with ``check=False`` — explicit
-      ``(n_taps, n_windows)`` general-kernel variant from an eager
-      ``_plan_shared`` on representative poses. A planner ``None``
-      (pose set outside the envelope) raises rather than silently
-      running a tap-dropping kernel.
+    plan: for 'fused_pallas' with ``check=False`` — explicit kernel
+      variant from an eager ``kernels.render_pallas.plan_fused`` on the
+      concrete poses (``(n_taps, n_windows)`` general / window count int
+      separable). A planner ``None`` (pose set outside the envelope)
+      raises rather than silently running a tap-dropping kernel.
+    adj_plan: for 'fused_pallas' with ``check=False`` — the ``plan_fused``
+      backward plan, enabling the Pallas backward for jitted callers
+      (None keeps the XLA backward — correct, slower).
 
   Returns:
     ``[B, H, W, 3]`` rendered view.
@@ -158,6 +162,8 @@ def render_mpi(
     # One batched kernel launch for the whole batch (batch grid axis).
     batched = jnp.moveaxis(jnp.moveaxis(planes, -1, 2), 1, 0)  # [B,P,4,H,W]
     plan_kw = {} if plan is _PLAN_UNSET else {"plan": plan}
+    if adj_plan is not _PLAN_UNSET:
+      plan_kw["adj_plan"] = adj_plan
     out = render_pallas.render_mpi_fused(
         batched, jnp.moveaxis(homs, 1, 0), separable, check=check,
         **plan_kw)                                             # [B, 3, H, W]

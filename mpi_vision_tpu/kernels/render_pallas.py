@@ -553,8 +553,23 @@ def _table_scalars(mins, height: int, width: int, tw: int, tsrc: int,
   return ymin, xmin, ymin_c, xmin_c, w0, q0
 
 
+def _corner_mins_union(h9_stack: jnp.ndarray, height: int, width: int,
+                       tw: int):
+  """Cell-corner minima unioned over a stack of homographies.
+
+  ``h9_stack``: ``[K, P, 3, 3]`` — K variants per plane (e.g. the four
+  ``hom ∘ shift(±1, ±1)`` maps whose union bounds the backward pass's
+  ±1-pixel contributor box). Returns the same four arrays as
+  ``_corner_mins`` with minima taken elementwise across K.
+  """
+  k, p = h9_stack.shape[:2]
+  mins = _corner_mins(h9_stack.reshape(k * p, 3, 3), height, width, tw)
+  return tuple(m.reshape((k, p) + m.shape[1:]).min(axis=0) for m in mins)
+
+
 def _shared_tables(homs: jnp.ndarray, height: int, width: int,
-                   tw: int, tsrc: int, bandg: int, n_eff: int):
+                   tw: int, tsrc: int, bandg: int, n_eff: int,
+                   mins=None):
   """Device-side (traceable) per-tile/per-chunk scalar tables.
 
   Returns ``meta [S, T, 2, P]`` (tile band origin ymin, xmin) and
@@ -562,14 +577,17 @@ def _shared_tables(homs: jnp.ndarray, height: int, width: int,
   and band-slice offset relative to ymin, shared by the whole strip),
   all int32 and aligned for direct use as DMA/slice offsets.
   ``_plan_shared`` runs the same math (same helpers, same dtype) for the
-  envelope decision.
+  envelope decision. ``mins`` overrides the cell-corner minima (the
+  backward pass feeds the shift-union minima from
+  ``_corner_mins_union``).
   """
   p = homs.shape[0]
   h9 = homs.reshape(p, 3, 3).astype(jnp.float32)
   c_t = tw // CHUNK
   n_strips = height // STRIP
   n_tiles = width // tw
-  mins = _corner_mins(h9, height, width, tw)
+  if mins is None:
+    mins = _corner_mins(h9, height, width, tw)
   ymin, xmin, _, _, w0, q0 = _table_scalars(
       mins, height, width, tw, tsrc, bandg, n_eff)
   # Layouts put the per-step-blocked axes first (Pallas requires the last
@@ -583,12 +601,15 @@ def _shared_tables(homs: jnp.ndarray, height: int, width: int,
 
 
 def _shared_grid_setup(planes: jnp.ndarray, homs: jnp.ndarray,
-                       n_windows: int):
+                       n_windows: int, mins_fn=None):
   """Everything a shared-gather-style pallas_call needs besides its kernel
   body and out specs: tile geometry, SMEM tables, grid, in_specs (incl.
   the subtle next-step prefetch index map), and operands. Shared by the
-  forward ``_shared_call`` and the backward warp (render_pallas_bwd) so
-  the prefetch logic cannot fork."""
+  forward ``_shared_call`` and the backward warp/adjoint
+  (render_pallas_bwd) so the prefetch logic cannot fork. ``mins_fn``
+  (per-entry ``homs9 -> _corner_mins``-shaped tuple) overrides the
+  cell-corner minima feeding the tables (the adjoint feeds shift-union
+  minima)."""
   batch, num_planes, _, height, width = planes.shape
   if height % STRIP or width % CHUNK:
     raise ValueError(
@@ -601,7 +622,9 @@ def _shared_grid_setup(planes: jnp.ndarray, homs: jnp.ndarray,
   n_strips, n_tiles = height // STRIP, width // tw
   homs32 = homs.reshape(batch, num_planes, 9).astype(jnp.float32)
   meta, wq = jax.vmap(
-      lambda h: _shared_tables(h, height, width, tw, tsrc, bandg, n_eff)
+      lambda h: _shared_tables(
+          h, height, width, tw, tsrc, bandg, n_eff,
+          mins=None if mins_fn is None else mins_fn(h))
   )(homs32)                          # [B, S, T, 2, P], [B, S, T, P, 2c]
 
   def next_index(b, s, t, p):
@@ -863,8 +886,11 @@ def _plan_shared(homs, height: int, width: int):
   to that same integer boundary (~1e-4 on 1080p-scale coordinates), so an
   approved pose stays within the 1e-3 parity budget even on mismatch.
   """
-  den_ok, span_max, v_ok, h2, h3 = jax.device_get(
-      _plan_shared_stats(jnp.asarray(homs), height, width))
+  # ensure_compile_time_eval: callers may sit under an ambient jit trace
+  # (concrete homs as jit constants); the stats must still run eagerly.
+  with jax.ensure_compile_time_eval():
+    den_ok, span_max, v_ok, h2, h3 = jax.device_get(
+        _plan_shared_stats(jnp.asarray(np.asarray(homs)), height, width))
   if not den_ok or not v_ok:
     return None
   n_taps = int(span_max) + 2
@@ -995,7 +1021,13 @@ def _make_fused(n_windows: int, adj_plan: tuple[int, int] | None = None):
   return fused
 
 
-def _make_shared(n_taps: int, n_windows: int):
+@functools.lru_cache(maxsize=None)
+def _make_shared(n_taps: int, n_windows: int,
+                 adj_plan: tuple[int, int, int] | None = None):
+  """General-path fused render with a custom VJP (see _make_fused: with
+  ``adj_plan`` — an eager ``render_pallas_bwd.plan_adjoint_shr`` result —
+  d planes runs on the Pallas backward; d homs stays on the XLA path,
+  DCE'd under jit when pose gradients are unused)."""
 
   @jax.custom_vjp
   def shared(planes, homs):
@@ -1007,14 +1039,31 @@ def _make_shared(n_taps: int, n_windows: int):
 
   def bwd(res, g):
     planes, homs = res
-    _, vjp = jax.vjp(_reference_render_batch, planes, homs)
-    return vjp(g)
+    if adj_plan is None:
+      _, vjp = jax.vjp(_reference_render_batch, planes, homs)
+      return vjp(g)
+    from mpi_vision_tpu.kernels import render_pallas_bwd
+    dplanes = render_pallas_bwd.backward_planes(
+        planes, homs, g, separable=False, fwd_plan=(n_taps, n_windows),
+        adj_plan=adj_plan)
+    _, vjp_h = jax.vjp(lambda hh: _reference_render_batch(planes, hh), homs)
+    (dhoms,) = vjp_h(g)
+    return dplanes, dhoms
 
   shared.defvjp(fwd, bwd)
   return shared
 
 
-_SHARED = {(tt, n): _make_shared(tt, n) for tt in (2, 3) for n in (2, 3)}
+class _SharedGetter:
+  """Dict-compatible view over ``_make_shared`` (tests index by plan)."""
+
+  def __getitem__(self, key):
+    if len(key) == 2:
+      return _make_shared(key[0], key[1])
+    return _make_shared(*key)
+
+
+_SHARED = _SharedGetter()
 
 # Jitted fallback: the eager reference path materializes per-op temporaries
 # (several GB at 1080p x 32 planes); under jit XLA schedules them.
@@ -1042,10 +1091,43 @@ def _sep_windows_needed(homs, height: int, width: int) -> int:
 PLAN_UNSET = object()
 
 
+def plan_fused(homs, height: int, width: int):
+  """Host-side plan bundle for JITTED fused rendering at ``(H, W)``.
+
+  For callers whose poses are jit ARGUMENTS (e.g. a train step rendering a
+  batch's poses): plan eagerly per batch from the concrete homographies —
+  microseconds of host math — and pass the bundle's fields to
+  ``render_mpi_fused(..., check=False, separable=..., plan=...,
+  adj_plan=...)`` (or ``core.render.render_mpi`` which forwards them).
+  Plans are made at the kernel's auto-padded geometry, which is exactly
+  where an off-tile-grid render executes. Returns None when the pose set
+  is outside the forward envelope (use an XLA method for that batch);
+  ``adj_plan`` is None when only the BACKWARD must fall back to XLA
+  (safe — the XLA VJP is always correct, just slower).
+  """
+  sep = is_separable(homs)
+  hp = max(-(-height // STRIP) * STRIP, BAND)
+  wp = -(-width // CHUNK) * CHUNK
+  from mpi_vision_tpu.kernels import render_pallas_bwd
+  if sep:
+    wp = max(wp, 2 * WIN)
+    if not fits_envelope(homs, hp, wp, True):
+      return None
+    return dict(separable=True,
+                plan=_sep_windows_needed(homs, hp, wp),
+                adj_plan=render_pallas_bwd.plan_adjoint_sep(homs, hp, wp))
+  plan = _plan_shared(homs, hp, wp)
+  if plan is None:
+    return None
+  return dict(separable=False, plan=plan,
+              adj_plan=render_pallas_bwd.plan_adjoint_shr(homs, hp, wp))
+
+
 def render_mpi_fused(planes: jnp.ndarray, homs: jnp.ndarray,
                      separable: bool = False,
                      check: bool = True,
-                     plan: tuple[int, int] | None | object = PLAN_UNSET
+                     plan: tuple[int, int] | int | None | object = PLAN_UNSET,
+                     adj_plan: tuple | None | object = PLAN_UNSET
                      ) -> jnp.ndarray:
   """Render an MPI to a novel view in one fused TPU kernel.
 
@@ -1076,98 +1158,135 @@ def render_mpi_fused(planes: jnp.ndarray, homs: jnp.ndarray,
       eagerly with ``fits_envelope`` first) — or jit an XLA method
       (``core.render.render_mpi(method='scan'|'fused')``) instead. No code
       path renders unchecked taps by default.
-    plan: with ``check=False`` only — an explicit ``(n_taps, n_windows)``
-      from an eager ``_plan_shared`` call on representative poses, so
-      jitted/shard_mapped callers can run the planned general-kernel
-      variant instead of the conservative (3, 3) maximum. Passing the
-      planner's ``None`` result raises: None means the pose set is OUTSIDE
-      the envelope, and the only correct options are an XLA method or the
+    plan: with ``check=False`` only — an explicit kernel-variant plan from
+      an eager ``plan_fused`` (or ``_plan_shared``) call on the concrete
+      poses: ``(n_taps, n_windows)`` for the general path, the window
+      count (int) for the separable path. Jitted/shard_mapped callers use
+      this to run the planned variant instead of the conservative
+      maximum. Plans for sizes off the tile grid must be made at the
+      auto-padded geometry (``plan_fused`` does). Passing the planner's
+      ``None`` result raises: None means the pose set is OUTSIDE the
+      envelope, and the only correct options are an XLA method or the
       ``check=True`` fallback.
+    adj_plan: with ``check=False`` only — the backward-pass plan from
+      ``plan_fused`` (``plan_adjoint_sep``/``plan_adjoint_shr``), enabling
+      the Pallas backward (kernels/render_pallas_bwd) for jitted callers.
+      An explicit None keeps the XLA backward — always correct, just
+      slower (unlike ``plan``, where None would mean dropping taps).
 
   Returns:
     ``[3, H, W]`` rendered view, float32 (``[B, 3, H, W]`` when batched).
   """
+  # Capture concreteness BEFORE any array ops: under an ambient jit even
+  # `homs[None]` on a closure-constant array yields a tracer, but the
+  # original concrete values are exactly what the eager planners need —
+  # so a jitted caller whose poses are constants still gets checked,
+  # optimally-planned kernels.
+  np_homs = None
+  if not isinstance(homs, jax.core.Tracer):
+    np_homs = np.asarray(jax.device_get(homs))
+    if np_homs.ndim == 3:
+      np_homs = np_homs[None]
   single = planes.ndim == 4
   if single:
     planes, homs = planes[None], homs[None]
-  out = _render_mpi_fused_batch(planes, homs, separable, check, plan)
+  out = _render_mpi_fused_batch(planes, homs, np_homs, separable, check,
+                                plan, adj_plan)
   return out[0] if single else out
 
 
-def _pad_to_tiles(planes: jnp.ndarray):
+def _pad_to_tiles(planes: jnp.ndarray, separable: bool):
   """Zero-pad H to a multiple of 8 (>= BAND) and W to a multiple of 128.
 
   EXACT under the sampler's zeros-padding semantics (utils.py:174): a tap
   beyond the original extent contributed 0 before; with padding it reads a
   zero plane value (and zero alpha) — identical pixels, identical
-  gradients. The output is cropped back by the caller.
+  gradients. The output is cropped back by the caller. Only the separable
+  kernel needs W >= 2*WIN (its unconditional two gather windows); the
+  general kernel runs fine at W == 128, so don't double its width.
   """
   _, _, _, height, width = planes.shape
   h_tgt = max(-(-height // STRIP) * STRIP, BAND)      # BAND is 8-aligned
-  w_tgt = max(-(-width // CHUNK) * CHUNK, 2 * WIN)
+  w_tgt = -(-width // CHUNK) * CHUNK
+  if separable:
+    w_tgt = max(w_tgt, 2 * WIN)
   padded = jnp.pad(
       planes,
       ((0, 0), (0, 0), (0, 0), (0, h_tgt - height), (0, w_tgt - width)))
   return padded, height, width
 
 
-def _render_mpi_fused_batch(planes, homs, separable, check, plan):
+def _render_mpi_fused_batch(planes, homs, np_homs, separable, check, plan,
+                            adj_plan):
+  """``np_homs``: host copy of ``homs`` for the eager planners, or None
+  when the homographies are traced (check must then be False)."""
   _, _, _, height, width = planes.shape
   if (height % STRIP or width % CHUNK or height < BAND
       or (separable and width < 2 * WIN)):
-    if not check:
-      # A check=False caller validated their envelope/plan at the ORIGINAL
-      # size; silently re-running the geometry at the padded size would
-      # void that validation (coverage tables shift with H/W). Make the
-      # mismatch loud instead.
+    if not check and plan is PLAN_UNSET:
+      # A check=False caller with no explicit plan validated their
+      # envelope at the ORIGINAL size; silently re-running the geometry at
+      # the padded size would void that validation (coverage tables shift
+      # with H/W). Make the mismatch loud, naming the violated constraint.
+      # (With an explicit plan, auto-pad proceeds: plan_fused makes plans
+      # at exactly this padded geometry.)
+      limits = (f"H % {STRIP} == 0, W % {CHUNK} == 0, H >= {BAND}"
+                + (f", W >= {2 * WIN} (separable path)" if separable
+                   else ""))
       raise ValueError(
-          f"{height}x{width} is off the kernel tile grid (H % {STRIP}, "
-          f"W % {CHUNK}, H >= {BAND}) and check=False: pad the MPI "
-          "yourself and validate fits_envelope/_plan_shared at the padded "
-          "size, or use check=True (which plans at the padded size), or "
-          "an XLA method.")
+          f"{height}x{width} violates the kernel tile contract ({limits}) "
+          "and check=False: pass the plan_fused bundle (plans at the "
+          "padded size), pad the MPI yourself, use check=True, or an XLA "
+          "method.")
     # Auto-pad to the kernel's tile geometry (exact; see _pad_to_tiles)
     # and crop the render back to the requested size; the envelope check
     # below then runs at the padded size the kernel actually executes.
-    padded, h0, w0 = _pad_to_tiles(planes)
-    out = _render_mpi_fused_batch(padded, homs, separable, check, plan)
+    padded, h0, w0 = _pad_to_tiles(planes, separable)
+    out = _render_mpi_fused_batch(padded, homs, np_homs, separable, check,
+                                  plan, adj_plan)
     return out[..., :h0, :w0]
-  homs_concrete = not isinstance(homs, jax.core.Tracer)
-  if check and not homs_concrete:
+  if check and np_homs is None:
     raise ValueError(
         "render_mpi_fused(check=True) needs concrete homographies; under "
         "jit pass check=False (you own the coverage envelope — verify "
         "representative poses with fits_envelope eagerly first) or use an "
-        "XLA method (core.render.render_mpi(method='scan'|'fused')).")
+        "XLA method (core.render.render_mpi(method='scan'|'fused')). "
+        "(Homographies that are jit CONSTANTS — closed over, not "
+        "arguments — keep working with check=True.)")
   if plan is None:
     raise ValueError(
         "plan=None: the planner rejected this pose set (outside the kernel "
         "envelope) — rendering with any kernel variant would drop taps. "
         "Use an XLA method or the check=True fallback.")
   if separable:
-    if check and not is_separable(homs):
+    if check and not is_separable(np_homs):
       raise ValueError(
           "separable=True but the homographies are not separable "
           "(is_separable(homs) is False); the separable kernel would "
           "silently render wrong pixels. Pass separable=False (the "
           "shared-gather general kernel) or fix the pose.")
-    n_windows = SEP_WINDOWS
-    adj_plan = None
-    if homs_concrete:
-      n_windows = _sep_windows_needed(homs, height, width)
-      from mpi_vision_tpu.kernels import render_pallas_bwd
-      adj_plan = render_pallas_bwd.plan_adjoint_sep(homs, height, width)
-    if check and not fits_envelope(homs, height, width, True):
+    n_windows = plan if isinstance(plan, int) else SEP_WINDOWS
+    adj = adj_plan if adj_plan is not PLAN_UNSET else None
+    if np_homs is not None:
+      n_windows = _sep_windows_needed(np_homs, height, width)
+      if adj_plan is PLAN_UNSET:
+        from mpi_vision_tpu.kernels import render_pallas_bwd
+        adj = render_pallas_bwd.plan_adjoint_sep(np_homs, height, width)
+    if check and not fits_envelope(np_homs, height, width, True):
       return _reference_render_jit(planes, homs)
-    return _make_fused(n_windows, adj_plan)(planes, homs)
+    return _make_fused(n_windows, adj)(planes, homs)
 
   # General path: the shared-gather kernel, planned eagerly (tap fan +
   # window count mirrored from concrete homographies); traced opt-in calls
-  # get an explicit caller-supplied plan or the conservative static
-  # maximum (3 taps, 3 windows).
+  # get an explicit caller-supplied plan (plan_fused) or the conservative
+  # static maximum (3 taps, 3 windows) with the XLA backward.
   if check:
-    plan = _plan_shared(homs, height, width)
+    plan = _plan_shared(np_homs, height, width)
     if plan is None:
       return _reference_render_jit(planes, homs)
-    return _SHARED[plan](planes, homs)
-  return _SHARED[(3, 3) if plan is PLAN_UNSET else plan](planes, homs)
+    from mpi_vision_tpu.kernels import render_pallas_bwd
+    adj = render_pallas_bwd.plan_adjoint_shr(np_homs, height, width)
+    return _make_shared(plan[0], plan[1], adj)(planes, homs)
+  adj = adj_plan if adj_plan is not PLAN_UNSET else None
+  n_taps, n_windows = (3, 3) if plan is PLAN_UNSET else plan
+  return _make_shared(n_taps, n_windows, adj)(planes, homs)

@@ -486,6 +486,319 @@ def plan_adjoint_sep(homs, height: int, width: int):
 
 
 # ---------------------------------------------------------------------------
+# Step 3b: the general (rotation) warp transpose.
+#
+# Contributors to source pixel (x, y) are gradient pixels (j, i) whose
+# forward-mapped position lands in the open ±1 box around (x, y) — the
+# preimage of that box under the homography, i.e. the image of the box
+# under hom^{-1}. Box corners map through the four shifted inverses
+# ``hom^{-1} ∘ shift(±1, ±1)`` (denominator one-signed => corner extrema
+# are exact), so the forward's corner-minima table machinery applies
+# verbatim on the 4-shift union (``_corner_mins_union``). The kernel is
+# the shared-gather forward with: per-column tap-fan origin from the
+# shift-union minimum, an (n_tx x n_ty) 2-D tap fan, and per-tap weights
+# ``relu(1-|u(j,i)-x|) * relu(1-|v(j,i)-y|)`` — the FORWARD map evaluated
+# at the integer tap, exactly the forward kernel's a.e. bilinear
+# derivative.
+
+_SHIFTS = ((-1.0, -1.0), (-1.0, 1.0), (1.0, -1.0), (1.0, 1.0))
+
+
+def _shift_matrices():
+  return jnp.stack([
+      jnp.array([[1.0, 0.0, dx], [0.0, 1.0, dy], [0.0, 0.0, 1.0]],
+                jnp.float32) for dx, dy in _SHIFTS])
+
+
+def _shifted_scalars(hom, dx, dy):
+  """``hom ∘ shift(dx, dy)`` for a 9-scalar homography list."""
+  return [hom[0], hom[1], hom[0] * dx + hom[1] * dy + hom[2],
+          hom[3], hom[4], hom[3] * dx + hom[4] * dy + hom[5],
+          hom[6], hom[7], hom[6] * dx + hom[7] * dy + hom[8]]
+
+
+def _adjoint_shr_kernel(hom_ref, meta_ref, meta_next_ref, wq_ref, grad_ref,
+                        homf_ref, out_ref, band_ref, sems,
+                        *, num_planes, height, width, n_windows, n_tx,
+                        n_ty, tw, tsrc, bandg):
+  """General warp transpose on 2-D source tiles.
+
+  ``hom_ref`` holds the INVERSE homographies (fan origins + tables);
+  ``homf_ref`` the forward ones (tap weights). Grid/DMA/table layout is
+  the shared-gather forward's (see _shared_grid_setup).
+  """
+  bi = pl.program_id(0)
+  s = pl.program_id(1)
+  t = pl.program_id(2)
+  p = pl.program_id(3)
+  n_s = pl.num_programs(1)
+  n_t = pl.num_programs(2)
+  step = ((bi * n_s + s) * n_t + t) * num_planes + p
+  total = pl.num_programs(0) * n_s * n_t * num_planes
+  slot = jax.lax.rem(step, 2)
+  homi = [hom_ref[bi, p, k] for k in range(9)]
+  homf = [homf_ref[bi, p, k] for k in range(9)]
+  c_t = tw // CHUNK
+  ymin = pl.multiple_of(meta_ref[0, 0, 0, 0, p], 8)
+  xmin = pl.multiple_of(meta_ref[0, 0, 0, 1, p], WIN)
+
+  @pl.when(step == 0)
+  def _first_dma():
+    pltpu.make_async_copy(
+        grad_ref.at[bi, p, :, pl.ds(ymin, bandg), pl.ds(xmin, tsrc)],
+        band_ref.at[0], sems.at[0]).start()
+
+  pltpu.make_async_copy(
+      grad_ref.at[bi, p, :, pl.ds(ymin, bandg), pl.ds(xmin, tsrc)],
+      band_ref.at[slot], sems.at[slot]).wait()
+
+  @pl.when(step < total - 1)
+  def _next_dma():
+    same_tile = p + 1 < num_planes
+    p_n = jnp.where(same_tile, p + 1, 0)
+    last_tile = (t + 1 >= n_t) & (s + 1 >= n_s)
+    b_n = jnp.where(same_tile | ~last_tile, bi, bi + 1)
+    ymin_n = pl.multiple_of(meta_next_ref[0, 0, 0, 0, p_n], 8)
+    xmin_n = pl.multiple_of(meta_next_ref[0, 0, 0, 1, p_n], WIN)
+    pltpu.make_async_copy(
+        grad_ref.at[b_n, p_n, :, pl.ds(ymin_n, bandg), pl.ds(xmin_n, tsrc)],
+        band_ref.at[1 - slot], sems.at[1 - slot]).start()
+
+  lane = jax.lax.broadcasted_iota(jnp.int32, (STRIP, tw), 1).astype(
+      jnp.float32)
+  sub = jax.lax.broadcasted_iota(jnp.int32, (STRIP, tw), 0).astype(
+      jnp.float32)
+  xs = lane + (t * tw).astype(jnp.float32)
+  ys = sub + (s * STRIP).astype(jnp.float32)
+  jmin = imin = None
+  for dx, dy in _SHIFTS:
+    jc, ic = rp._uv(_shifted_scalars(homi, dx, dy), xs, ys)
+    jc = jnp.where(jnp.isfinite(jc), jc, 0.0)
+    ic = jnp.where(jnp.isfinite(ic), ic, 0.0)
+    jmin = jc if jmin is None else jnp.minimum(jmin, jc)
+    imin = ic if imin is None else jnp.minimum(imin, ic)
+
+  for ci in range(c_t):
+    w0 = pl.multiple_of(wq_ref[0, 0, 0, p, ci * 2], WIN)
+    q0 = pl.multiple_of(wq_ref[0, 0, 0, p, ci * 2 + 1], 8)
+    sl = slice(ci * CHUNK, (ci + 1) * CHUNK)
+    xsl = xs[:1, sl]                                     # [1, CHUNK]
+    ysl = ys[:, sl]                                      # [STRIP, CHUNK]
+    jhat = jnp.floor(jnp.min(jmin[:, sl], axis=0,
+                             keepdims=True)).astype(jnp.int32)
+    ihat = jnp.floor(imin[:, sl]).astype(jnp.int32)      # [STRIP, CHUNK]
+
+    pix = [jnp.zeros((STRIP, CHUNK), jnp.float32) for _ in range(4)]
+    for dj in range(n_tx):
+      jt = jhat + dj                                     # [1, CHUNK]
+      rel0 = jt - xmin - w0
+      xle = None                                         # [G_SHARED, CHUNK]
+      for wi in range(n_windows):
+        rel = rel0 - wi * WIN
+        inw = (rel >= 0) & (rel < WIN)
+        idx = jnp.broadcast_to(jnp.clip(rel, 0, WIN - 1),
+                               (rp.G_SHARED, CHUNK))
+        base = pl.multiple_of(w0 + wi * WIN, WIN)
+        outs = []
+        for ch in range(4):
+          win = band_ref[slot, ch, pl.ds(q0, rp.G_SHARED), pl.ds(base, WIN)]
+          g = jnp.take_along_axis(win, idx, axis=1)
+          outs.append(jnp.where(inw, g, 0.0))
+        xle = outs if xle is None else [a + o for a, o in zip(xle, outs)]
+
+      jf = jt.astype(jnp.float32)
+      for di in range(n_ty):
+        it = ihat + di                                   # [STRIP, CHUNK]
+        itf = it.astype(jnp.float32)
+        den = homf[6] * jf + homf[7] * itf + homf[8]
+        r = 1.0 / den
+        u = (homf[0] * jf + homf[1] * itf + homf[2]) * r
+        v = (homf[3] * jf + homf[4] * itf + homf[5]) * r
+        w = (jnp.maximum(0.0, 1.0 - jnp.abs(u - xsl))
+             * jnp.maximum(0.0, 1.0 - jnp.abs(v - ysl)))
+        w = jnp.where(jnp.isfinite(w), w, 0.0)
+        w = jnp.where((jt >= 0) & (jt <= width - 1)
+                      & (it >= 0) & (it <= height - 1), w, 0.0)
+        qi = it - (ymin + q0)
+        for ch in range(4):
+          sel = jnp.zeros((STRIP, CHUNK), jnp.float32)
+          for k in range(rp.G_SHARED // 8):
+            vreg = xle[ch][8 * k:8 * (k + 1)]            # [8, CHUNK]
+            gk = jnp.take_along_axis(vreg, jnp.clip(qi - 8 * k, 0, 7),
+                                     axis=0)
+            sel = jnp.where((qi >= 8 * k) & (qi < 8 * (k + 1)), gk, sel)
+          pix[ch] += w * sel
+
+    cols = pl.ds(pl.multiple_of(ci * CHUNK, CHUNK), CHUNK)
+    for ch in range(4):
+      out_ref[0, 0, ch, :, cols] = pix[ch]
+
+
+def _inv_homs(homs32):
+  """Normalized f32 inverses of ``[..., 3, 3]`` homographies."""
+  inv = jnp.linalg.inv(homs32)
+  return inv / inv[..., 2:3, 2:3]
+
+
+def _union_mins_fn(height, width, tw):
+  """mins_fn for _shared_grid_setup: 4-shift union corner minima."""
+  shifts = _shift_matrices()                              # [4, 3, 3]
+
+  def fn(h9):                                             # [P, 9]
+    p = h9.shape[0]
+    hmat = h9.reshape(p, 3, 3)
+    stack = jnp.einsum("pij,kjl->kpil", hmat, shifts)     # [4, P, 3, 3]
+    return rp._corner_mins_union(stack, height, width, tw)
+
+  return fn
+
+
+@functools.partial(
+    jax.jit, static_argnames=("n_tx", "n_ty", "n_windows", "interpret"))
+def _adjoint_shr_call(grad_warped, homs, n_tx: int, n_ty: int,
+                      n_windows: int, interpret: bool):
+  batch, num_planes, _, height, width = grad_warped.shape
+  homs32 = homs.reshape(batch, num_planes, 3, 3).astype(jnp.float32)
+  hinv = _inv_homs(homs32)
+  tw = rp._tile_sizes(height, width, n_windows)[0]
+  grid, in_specs, operands, g = rp._shared_grid_setup(
+      grad_warped, hinv.reshape(batch, num_planes, 9), n_windows,
+      mins_fn=_union_mins_fn(height, width, tw))
+  kernel = functools.partial(
+      _adjoint_shr_kernel, num_planes=g["num_planes"], height=g["height"],
+      width=g["width"], n_windows=g["n_eff"], n_tx=n_tx, n_ty=n_ty,
+      tw=g["tw"], tsrc=g["tsrc"], bandg=g["bandg"])
+  return pl.pallas_call(
+      kernel,
+      grid=grid,
+      in_specs=in_specs + [pl.BlockSpec(memory_space=pltpu.SMEM)],
+      out_specs=pl.BlockSpec((1, 1, 4, STRIP, g["tw"]),
+                             lambda b, s, t, p: (b, p, 0, s, t)),
+      out_shape=jax.ShapeDtypeStruct(
+          (g["batch"], g["num_planes"], 4, g["height"], g["width"]),
+          jnp.float32),
+      scratch_shapes=[
+          pltpu.VMEM((2, 4, g["bandg"], g["tsrc"]), jnp.float32),
+          pltpu.SemaphoreType.DMA((2,)),
+      ],
+      interpret=interpret,
+  )(*operands, homs32.reshape(batch, num_planes, 9))
+
+
+@functools.partial(jax.jit, static_argnames=("height", "width"))
+def _plan_adjoint_shr_stats(homs: jnp.ndarray, height: int, width: int):
+  """Device-side stats for the general adjoint plan (traceable, f32).
+
+  Mirrors ``_plan_shared_stats``'s strategy on the INVERSE homographies
+  with the 4-shift union extents: the very f32 values the adjoint call's
+  tables and the kernel's fan origins see. Returns (den_ok, span_x,
+  span_y, v_ok, h2_ok, h3_ok).
+  """
+  h9 = homs.reshape(-1, 3, 3).astype(jnp.float32)
+  p = h9.shape[0]
+  hinv = _inv_homs(h9)
+
+  # Inverse denominator one-signed over the image corners (else corner
+  # extrema of the inverse map are not extrema).
+  cx = jnp.array([0.0, width - 1.0], jnp.float32)
+  cy = jnp.array([0.0, height - 1.0], jnp.float32)
+  d_flat = (hinv[:, 2, 0, None, None] * cx[None, :, None]
+            + hinv[:, 2, 1, None, None] * cy[None, None, :]
+            + hinv[:, 2, 2, None, None]).reshape(p, 4)
+  den_ok = (jnp.isfinite(d_flat).all()
+            & ((d_flat > 0).all(1) | (d_flat < 0).all(1)).all())
+
+  tw, _, bandg, _ = rp._tile_sizes(height, width, 2)
+  n_strips = height // STRIP
+  slice_rows = min(rp.G_SHARED, bandg)
+  shifts = _shift_matrices()
+  stack = jnp.einsum("pij,kjl->kpil", hinv, shifts)       # [4, P, 3, 3]
+  mins = rp._corner_mins_union(stack, height, width, tw)
+
+  # Per-column strip extrema of the shift-union inverse coords, from the
+  # strip's top/bottom rows (exact: monotone in the row for one-signed
+  # denominators), unioned over the 4 shifts.
+  cols = jnp.arange(width, dtype=jnp.float32)
+  oyr = (jnp.arange(n_strips, dtype=jnp.float32)[:, None] * STRIP
+         + jnp.array([0.0, STRIP - 1.0])).reshape(-1)
+  u_r, v_r = rp._uv_vec(stack.reshape(4 * p, 3, 3),
+                        cols[None, None, :], oyr[None, :, None])
+  u_r = u_r.reshape(4, p, n_strips, 2, width)
+  v_r = v_r.reshape(4, p, n_strips, 2, width)
+  j_lo = u_r.min(axis=(0, 3))                             # [P, S, W]
+  j_hi = u_r.max(axis=(0, 3))
+  i_lo = v_r.min(axis=(0, 3))
+  i_hi = v_r.max(axis=(0, 3))
+
+  tol = 5e-4
+  # Horizontal fan origin is shared per COLUMN (min over the strip's
+  # rows), so its span is column-level: strip extrema over rows + shifts.
+  span_x = (jnp.floor(j_hi + tol).astype(jnp.int32)
+            - jnp.floor(j_lo - tol).astype(jnp.int32)).max()
+  # Vertical fan origin is PER PIXEL, so its span is the 4-shift spread at
+  # one pixel — evaluated at the strip-edge rows (the host wrapper adds
+  # one safety tap for interior rows; the spread varies by ~|second
+  # derivative| * 8 rows across a strip, orders below one tap for any
+  # accepted pose, and the random-pose property test backs this
+  # empirically).
+  i_lo_px = v_r.min(axis=0)                               # [P, S, 2, W]
+  i_hi_px = v_r.max(axis=0)
+  span_y = (jnp.floor(i_hi_px + tol).astype(jnp.int32)
+            - jnp.floor(i_lo_px - tol).astype(jnp.int32)).max()
+
+  chunk_of_col = jnp.arange(width) // CHUNK
+  _, _, ymin_c2, _, _, q0_2 = rp._table_scalars(
+      mins, height, width, tw, min(width, 640), bandg,
+      min(2, min(width, 640) // WIN))
+  ymq = ((ymin_c2 + q0_2)[:, :, chunk_of_col]).astype(jnp.float32)
+  empty_v = (i_hi <= -1) | (i_lo >= height)
+  v_ok = (empty_v | (
+      (jnp.maximum(i_lo, 0.0) >= ymq - tol)
+      & (jnp.minimum(i_hi, height - 1.0)
+         <= ymq + slice_rows - 1 + tol))).all()
+
+  empty_h = (j_hi <= -1) | (j_lo >= width)
+  h_oks = []
+  for n_windows in (2, 3):
+    _, tsrc, _, n_eff = rp._tile_sizes(height, width, n_windows)
+    _, _, _, xmin_c, w0, _ = rp._table_scalars(
+        mins, height, width, tw, tsrc, bandg, n_eff)
+    xmw = ((xmin_c + w0)[:, :, chunk_of_col]).astype(jnp.float32)
+    h_oks.append((empty_h | (
+        (jnp.maximum(j_lo, 0.0) >= xmw - tol)
+        & (jnp.minimum(j_hi, width - 1.0)
+           <= xmw + n_eff * WIN - 1 + tol))).all())
+  return den_ok, span_x, span_y, v_ok, h_oks[0], h_oks[1]
+
+
+def plan_adjoint_shr(homs, height: int, width: int):
+  """Static ``(n_tx, n_ty, n_windows)`` for the general adjoint, or None.
+
+  The tap fans must cover the shift-union contributor extents: ``span + 1``
+  taps each way, capped at 5 (beyond that the pose is cheaper on the XLA
+  backward anyway). ``homs`` concrete; batch axes flatten into planes.
+  """
+  # ensure_compile_time_eval: callers may sit under an ambient jit trace
+  # (concrete homs as jit constants); the stats must still run eagerly.
+  with jax.ensure_compile_time_eval():
+    den_ok, span_x, span_y, v_ok, h2, h3 = jax.device_get(
+        _plan_adjoint_shr_stats(jnp.asarray(np.asarray(homs)), height,
+                                width))
+  if not den_ok or not v_ok:
+    return None
+  # +1 to cover the span; vertical +1 more as the interior-row safety tap
+  # (the stats sample per-pixel spreads at strip-edge rows only).
+  n_tx, n_ty = int(span_x) + 1, int(span_y) + 2
+  if n_tx > 5 or n_ty > 5:
+    return None
+  if h2:
+    return n_tx, n_ty, 2
+  if h3:
+    return n_tx, n_ty, 3
+  return None
+
+
+# ---------------------------------------------------------------------------
 # Assembly.
 
 
@@ -493,13 +806,14 @@ def backward_planes(planes, homs, g, separable: bool, fwd_plan,
                     adj_plan) -> jnp.ndarray:
   """``d loss / d planes`` for ``g = d loss / d render``: warp, composite
   VJP, warp transpose. All arguments batched (``[B, P, 4, H, W]`` planes,
-  ``[B, P, 3, 3]`` homs, ``[B, 3, H, W]`` g)."""
-  if not separable:
-    raise NotImplementedError(
-        "Pallas backward currently covers the separable path; general "
-        "homographies keep the XLA backward")
+  ``[B, P, 3, 3]`` homs, ``[B, 3, H, W]`` g). ``adj_plan`` comes from
+  ``plan_adjoint_sep`` (separable: ``(n_taps, n_windows)``) or
+  ``plan_adjoint_shr`` (general: ``(n_tx, n_ty, n_windows)``)."""
   interpret = jax.default_backend() != "tpu"
   warped = warp_planes_fused(planes, homs, separable, fwd_plan)
   dwarped = _composite_bwd(warped, g)
-  n_taps, n_windows = adj_plan
-  return _adjoint_sep_call(dwarped, homs, n_taps, n_windows, interpret)
+  if separable:
+    n_taps, n_windows = adj_plan
+    return _adjoint_sep_call(dwarped, homs, n_taps, n_windows, interpret)
+  n_tx, n_ty, n_windows = adj_plan
+  return _adjoint_shr_call(dwarped, homs, n_tx, n_ty, n_windows, interpret)

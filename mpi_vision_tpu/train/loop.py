@@ -52,14 +52,24 @@ def create_train_state(
 
 
 def make_loss_fn(vgg_params: Any | None,
-                 resize: int | None = 224) -> Callable[..., jnp.ndarray]:
-  """Loss closure: VGG-perceptual when ``vgg_params`` given, else L2."""
+                 resize: int | None = 224,
+                 method: str = "fused",
+                 render_kwargs: Mapping[str, Any] | None = None,
+                 ) -> Callable[..., jnp.ndarray]:
+  """Loss closure: VGG-perceptual when ``vgg_params`` given, else L2.
+
+  ``method``/``render_kwargs`` select the renderer inside the loss (the
+  planned-step path passes 'fused_pallas' plus a ``plan_fused`` bundle).
+  """
 
   def loss_fn(params, apply_fn, batch: Batch):
     mpi_pred = apply_fn({"params": params}, batch["net_input"])
     if vgg_params is None:
-      return loss_lib.l2_render_loss(mpi_pred, batch)
-    return loss_lib.vgg_perceptual_loss(mpi_pred, batch, vgg_params, resize)
+      return loss_lib.l2_render_loss(mpi_pred, batch, method=method,
+                                     render_kwargs=render_kwargs)
+    return loss_lib.vgg_perceptual_loss(mpi_pred, batch, vgg_params, resize,
+                                        method=method,
+                                        render_kwargs=render_kwargs)
 
   return loss_fn
 
@@ -80,6 +90,67 @@ def make_train_step(vgg_params: Any | None = None,
                     resize: int | None = 224):
   """A jitted ``(state, batch) -> (state, metrics)`` step."""
   return jax.jit(_grad_step(make_loss_fn(vgg_params, resize)))
+
+
+def plan_batch_render(batch: Batch, convention=None):
+  """Host-side ``plan_fused`` bundle for a concrete batch's render.
+
+  Computes the batch's pixel homographies exactly as the loss will
+  (``render_novel_view``: rel_pose = tgt_cfw @ ref_wfc, ``mpi_planes``
+  row 0 when collated) and plans the fused kernels at the image size.
+  Returns None when the batch's poses are outside the forward envelope.
+  """
+  from mpi_vision_tpu.core.sampling import Convention
+  from mpi_vision_tpu.kernels import render_pallas
+
+  convention = Convention.REF_HOMOGRAPHY if convention is None else convention
+  h, w = batch["ref_img"].shape[1:3]
+  rel = jnp.asarray(batch["tgt_img_cfw"]) @ jnp.asarray(batch["ref_img_wfc"])
+  planes = batch["mpi_planes"]
+  if planes.ndim == 2:
+    planes = planes[0]
+  homs = render_pallas.pixel_homographies(
+      rel, jnp.asarray(planes), jnp.asarray(batch["intrinsics"]), h, w,
+      convention)                                          # [P, B, 3, 3]
+  return render_pallas.plan_fused(jnp.moveaxis(homs, 1, 0), h, w)
+
+
+def make_train_step_planned(vgg_params: Any | None = None,
+                            resize: int | None = 224):
+  """A train step rendering through the fused Pallas kernels, forward AND
+  backward (kernels/render_pallas + render_pallas_bwd).
+
+  Poses are batch DATA, so kernel plans cannot be jit-static. Instead
+  each batch's concrete poses are planned on the host
+  (``plan_batch_render``: microseconds of math per batch) and the step
+  dispatches into a jit cache keyed by the plan signature — a bounded set
+  of window/tap-fan variants, so recompiles are bounded and steady-state
+  batches reuse compiled programs. Batches outside the forward envelope
+  run the XLA 'fused' step (always correct); a batch whose backward plan
+  is rejected keeps the Pallas forward with the XLA backward.
+
+  The returned ``step`` exposes its cache as ``step.cache`` (signature ->
+  compiled step) for tests/diagnostics.
+  """
+  cache: dict = {}
+
+  def step(state: TrainState, batch: Batch):
+    bundle = plan_batch_render(batch)
+    if bundle is None:
+      key = "xla"
+      if key not in cache:
+        cache[key] = make_train_step(vgg_params, resize)
+    else:
+      key = (bundle["separable"], bundle["plan"], bundle["adj_plan"])
+      if key not in cache:
+        rk = dict(separable=bundle["separable"], check=False,
+                  plan=bundle["plan"], adj_plan=bundle["adj_plan"])
+        cache[key] = jax.jit(_grad_step(make_loss_fn(
+            vgg_params, resize, method="fused_pallas", render_kwargs=rk)))
+    return cache[key](state, batch)
+
+  step.cache = cache
+  return step
 
 
 def shard_train_step(mesh: Mesh, vgg_params: Any | None = None,

@@ -41,8 +41,14 @@ def render_novel_view(
     batch: Mapping[str, jnp.ndarray],
     convention: Convention = Convention.REF_HOMOGRAPHY,
     method: str = "fused",
+    render_kwargs: Mapping[str, Any] | None = None,
 ) -> jnp.ndarray:
-  """Net output -> MPI -> rendered target view ``[B, H, W, 3]``."""
+  """Net output -> MPI -> rendered target view ``[B, H, W, 3]``.
+
+  ``render_kwargs`` forwards extra ``render.render_mpi`` arguments — the
+  planned-train-step path passes ``method='fused_pallas'`` with the
+  ``plan_fused`` bundle (separable/plan/adj_plan, check=False) here.
+  """
   rgba = mpi_from_net_output(mpi_pred, batch["ref_img"])    # [B,H,W,P,4]
   rel_pose = batch["tgt_img_cfw"] @ batch["ref_img_wfc"]    # cell 12:40
   planes = batch["mpi_planes"]
@@ -50,16 +56,19 @@ def render_novel_view(
     planes = planes[0]
   return render.render_mpi(rgba, rel_pose, planes,
                            batch["intrinsics"], convention=convention,
-                           method=method)
+                           method=method, **(render_kwargs or {}))
 
 
 def l2_render_loss(
     mpi_pred: jnp.ndarray,
     batch: Mapping[str, jnp.ndarray],
     convention: Convention = Convention.REF_HOMOGRAPHY,
+    method: str = "fused",
+    render_kwargs: Mapping[str, Any] | None = None,
 ) -> jnp.ndarray:
   """The reference's ``test_loss`` eval metric: MSE(rendered, target)."""
-  out = render_novel_view(mpi_pred, batch, convention=convention)
+  out = render_novel_view(mpi_pred, batch, convention=convention,
+                          method=method, render_kwargs=render_kwargs)
   return jnp.mean((out - batch["tgt_img"]) ** 2)
 
 
@@ -69,10 +78,13 @@ def vgg_perceptual_loss(
     vgg_params: Any,
     resize: int | None = 224,
     convention: Convention = Convention.REF_HOMOGRAPHY,
+    method: str = "fused",
+    render_kwargs: Mapping[str, Any] | None = None,
 ) -> jnp.ndarray:
   """The reference training loss (cell 12): pixel L1 + weighted VGG L1s."""
   with jax.named_scope("loss/render"):
-    out = render_novel_view(mpi_pred, batch, convention=convention)
+    out = render_novel_view(mpi_pred, batch, convention=convention,
+                            method=method, render_kwargs=render_kwargs)
   tgt = batch["tgt_img"]
 
   x = vgg.imagenet_normalize(out)

@@ -155,6 +155,71 @@ class TestBackwardPlanes:
     assert checked >= 6
 
 
+class TestBackwardPlanesGeneral:
+
+  def _check(self, rng, pose_kw, p=4, h=32, w=256, batch=1, atol=1e-3):
+    planes = _mpi(rng, p, h, w, batch=batch)
+    homs = jnp.stack([_homs(h, w, p, **pose_kw)] * batch)
+    assert not rp.is_separable(homs)
+    fwd_plan = rp._plan_shared(homs, h, w)
+    assert fwd_plan is not None
+    adj_plan = rpb.plan_adjoint_shr(homs, h, w)
+    assert adj_plan is not None, "general adjoint plan rejected"
+    g = jnp.asarray(rng.normal(size=(batch, 3, h, w)).astype(np.float32))
+    got = rpb.backward_planes(planes, homs, g, False, fwd_plan, adj_plan)
+    _, vjp = jax.vjp(rp._reference_render_batch, planes, homs)
+    want, _ = vjp(g)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=atol)
+
+  def test_small_rotation(self, rng):
+    self._check(rng, ROTATION)
+
+  def test_yaw_pan(self, rng):
+    self._check(rng, dict(ry=0.004, tx=0.03))
+
+  def test_batched(self, rng):
+    self._check(rng, ROTATION, batch=2)
+
+  def test_plan_sane(self):
+    h, w = 32, 256
+    plan = rpb.plan_adjoint_shr(_homs(h, w, **ROTATION), h, w)
+    assert plan is not None
+    n_tx, n_ty, n_windows = plan
+    assert 2 <= n_tx <= 5 and 2 <= n_ty <= 5 and n_windows in (2, 3)
+
+  def test_property_random_rotation_poses(self, rng):
+    """Accepted general poses' Pallas backward matches the XLA VJP."""
+    h, w, p = 32, 256, 3
+    checked = 0
+    for _ in range(12):
+      pose_kw = dict(
+          tx=float(rng.uniform(-0.1, 0.1)),
+          tz=float(rng.uniform(-0.2, 0.2)),
+          rx=float(rng.uniform(-0.008, 0.008)),
+          ry=float(rng.uniform(-0.008, 0.008)))
+      homs = _homs(h, w, p, **pose_kw)
+      if rp.is_separable(homs):
+        continue
+      if rp._plan_shared(homs, h, w) is None:
+        continue
+      if rpb.plan_adjoint_shr(homs, h, w) is None:
+        continue
+      self._check(rng, pose_kw, p=p, h=h, w=w)
+      checked += 1
+    assert checked >= 6
+
+  def test_grad_through_public_api_rotation(self, rng):
+    p, h, w = 4, 32, 256
+    planes = _mpi(rng, p, h, w)
+    homs = _homs(h, w, p, **ROTATION)
+    wmat = jnp.asarray(rng.normal(size=(3, h, w)).astype(np.float32))
+    got = jax.grad(lambda pl_: jnp.sum(
+        rp.render_mpi_fused(pl_, homs, separable=False) * wmat))(planes)
+    want = jax.grad(lambda pl_: jnp.sum(
+        rp.reference_render(pl_, homs) * wmat))(planes)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-3)
+
+
 class TestFusedVjpIntegration:
 
   def test_grad_through_render_mpi_fused_matches_reference(self, rng):

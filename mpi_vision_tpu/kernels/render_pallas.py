@@ -981,16 +981,41 @@ def reference_render(planes: jnp.ndarray, homs: jnp.ndarray) -> jnp.ndarray:
 _reference_render_batch = jax.vmap(reference_render)
 
 
+# adj_plan sentinel: plan the backward INSIDE bwd, from the concrete
+# residual homographies, only when a gradient is actually taken. Forward-
+# only rendering (the FPS path) must not pay per-call adjoint planning
+# (host math + device round-trips); under jit the residuals are tracers
+# and lazy resolves to the XLA backward (pass plan_fused's adj_plan for
+# the Pallas backward there).
+LAZY_ADJ = "lazy"
+
+
+def _resolve_adj(adj_plan, planes, homs, separable: bool):
+  """``bwd``-time adjoint plan: pass tuples through, resolve LAZY_ADJ from
+  concrete residuals (None — the XLA backward — when traced or rejected)."""
+  if not (isinstance(adj_plan, str) and adj_plan == LAZY_ADJ):
+    return adj_plan
+  if isinstance(homs, jax.core.Tracer):
+    return None
+  from mpi_vision_tpu.kernels import render_pallas_bwd
+  h, w = planes.shape[-2:]
+  planner = (render_pallas_bwd.plan_adjoint_sep if separable
+             else render_pallas_bwd.plan_adjoint_shr)
+  return planner(homs, h, w)
+
+
 @functools.lru_cache(maxsize=None)
-def _make_fused(n_windows: int, adj_plan: tuple[int, int] | None = None):
+def _make_fused(n_windows: int,
+                adj_plan: tuple[int, int] | str | None = None):
   """Separable-path fused render with a custom VJP.
 
-  With ``adj_plan`` (an eager ``render_pallas_bwd.plan_adjoint_sep``
-  result), d planes comes from the Pallas backward (warp, composite VJP,
-  tent-filter warp transpose); without it, the whole backward routes
-  through the XLA reference path as before. d homs always comes from the
-  XLA path — XLA dead-code-eliminates it under jit when pose gradients
-  are unused (the training case: poses are data).
+  With ``adj_plan`` (a ``render_pallas_bwd.plan_adjoint_sep`` result, or
+  LAZY_ADJ to plan at bwd time from concrete residuals), d planes comes
+  from the Pallas backward (warp, composite VJP, tent-filter warp
+  transpose); without it, the whole backward routes through the XLA
+  reference path as before. d homs always comes from the XLA path — XLA
+  dead-code-eliminates it under jit when pose gradients are unused (the
+  training case: poses are data).
   """
 
   @jax.custom_vjp
@@ -1003,13 +1028,14 @@ def _make_fused(n_windows: int, adj_plan: tuple[int, int] | None = None):
 
   def bwd(res, g):
     planes, homs = res
-    if adj_plan is None:
+    plan = _resolve_adj(adj_plan, planes, homs, separable=True)
+    if plan is None:
       _, vjp = jax.vjp(_reference_render_batch, planes, homs)
       return vjp(g)
     from mpi_vision_tpu.kernels import render_pallas_bwd
     dplanes = render_pallas_bwd.backward_planes(
         planes, homs, g, separable=True, fwd_plan=n_windows,
-        adj_plan=adj_plan)
+        adj_plan=plan)
     # homs-only VJP: transposition never touches the planes input, so the
     # XLA planes scatter is skipped even eagerly (and the whole branch is
     # DCE'd under jit when pose gradients are unused — the training case).
@@ -1023,11 +1049,11 @@ def _make_fused(n_windows: int, adj_plan: tuple[int, int] | None = None):
 
 @functools.lru_cache(maxsize=None)
 def _make_shared(n_taps: int, n_windows: int,
-                 adj_plan: tuple[int, int, int] | None = None):
+                 adj_plan: tuple[int, int, int] | str | None = None):
   """General-path fused render with a custom VJP (see _make_fused: with
-  ``adj_plan`` — an eager ``render_pallas_bwd.plan_adjoint_shr`` result —
-  d planes runs on the Pallas backward; d homs stays on the XLA path,
-  DCE'd under jit when pose gradients are unused)."""
+  ``adj_plan`` — a ``render_pallas_bwd.plan_adjoint_shr`` result or
+  LAZY_ADJ — d planes runs on the Pallas backward; d homs stays on the
+  XLA path, DCE'd under jit when pose gradients are unused)."""
 
   @jax.custom_vjp
   def shared(planes, homs):
@@ -1039,13 +1065,14 @@ def _make_shared(n_taps: int, n_windows: int,
 
   def bwd(res, g):
     planes, homs = res
-    if adj_plan is None:
+    plan = _resolve_adj(adj_plan, planes, homs, separable=False)
+    if plan is None:
       _, vjp = jax.vjp(_reference_render_batch, planes, homs)
       return vjp(g)
     from mpi_vision_tpu.kernels import render_pallas_bwd
     dplanes = render_pallas_bwd.backward_planes(
         planes, homs, g, separable=False, fwd_plan=(n_taps, n_windows),
-        adj_plan=adj_plan)
+        adj_plan=plan)
     _, vjp_h = jax.vjp(lambda hh: _reference_render_batch(planes, hh), homs)
     (dhoms,) = vjp_h(g)
     return dplanes, dhoms
@@ -1173,6 +1200,10 @@ def render_mpi_fused(planes: jnp.ndarray, homs: jnp.ndarray,
       the Pallas backward (kernels/render_pallas_bwd) for jitted callers.
       An explicit None keeps the XLA backward — always correct, just
       slower (unlike ``plan``, where None would mean dropping taps).
+      Left unset, the backward plans itself lazily at VJP time: eager
+      gradients get the Pallas backward automatically, jitted ones (traced
+      residuals) the XLA backward — and forward-only rendering never pays
+      adjoint planning.
 
   Returns:
     ``[3, H, W]`` rendered view, float32 (``[B, 3, H, W]`` when batched).
@@ -1265,13 +1296,32 @@ def _render_mpi_fused_batch(planes, homs, np_homs, separable, check, plan,
           "(is_separable(homs) is False); the separable kernel would "
           "silently render wrong pixels. Pass separable=False (the "
           "shared-gather general kernel) or fix the pose.")
+  # Default adjoint plan when the caller passed none: fully eager calls
+  # defer planning to VJP time (LAZY_ADJ — forward-only rendering, the FPS
+  # path, must not pay per-call adjoint planning), but a call whose poses
+  # are concrete jit CONSTANTS (np_homs captured, yet ``homs`` already a
+  # tracer) plans NOW from np_homs — at bwd time the residuals are tracers
+  # and lazy would silently regress to the XLA backward. Once per trace,
+  # not per call.
+  def _default_adj(planner):
+    if adj_plan is not PLAN_UNSET:
+      return adj_plan
+    if np_homs is not None and isinstance(homs, jax.core.Tracer):
+      return planner(np_homs, height, width)
+    return LAZY_ADJ
+
+  from mpi_vision_tpu.kernels import render_pallas_bwd
+  if separable:
+    if check and not is_separable(np_homs):
+      raise ValueError(
+          "separable=True but the homographies are not separable "
+          "(is_separable(homs) is False); the separable kernel would "
+          "silently render wrong pixels. Pass separable=False (the "
+          "shared-gather general kernel) or fix the pose.")
     n_windows = plan if isinstance(plan, int) else SEP_WINDOWS
-    adj = adj_plan if adj_plan is not PLAN_UNSET else None
+    adj = _default_adj(render_pallas_bwd.plan_adjoint_sep)
     if np_homs is not None:
       n_windows = _sep_windows_needed(np_homs, height, width)
-      if adj_plan is PLAN_UNSET:
-        from mpi_vision_tpu.kernels import render_pallas_bwd
-        adj = render_pallas_bwd.plan_adjoint_sep(np_homs, height, width)
     if check and not fits_envelope(np_homs, height, width, True):
       return _reference_render_jit(planes, homs)
     return _make_fused(n_windows, adj)(planes, homs)
@@ -1280,13 +1330,11 @@ def _render_mpi_fused_batch(planes, homs, np_homs, separable, check, plan,
   # window count mirrored from concrete homographies); traced opt-in calls
   # get an explicit caller-supplied plan (plan_fused) or the conservative
   # static maximum (3 taps, 3 windows) with the XLA backward.
+  adj = _default_adj(render_pallas_bwd.plan_adjoint_shr)
   if check:
     plan = _plan_shared(np_homs, height, width)
     if plan is None:
       return _reference_render_jit(planes, homs)
-    from mpi_vision_tpu.kernels import render_pallas_bwd
-    adj = render_pallas_bwd.plan_adjoint_shr(np_homs, height, width)
     return _make_shared(plan[0], plan[1], adj)(planes, homs)
-  adj = adj_plan if adj_plan is not PLAN_UNSET else None
   n_taps, n_windows = (3, 3) if plan is PLAN_UNSET else plan
   return _make_shared(n_taps, n_windows, adj)(planes, homs)

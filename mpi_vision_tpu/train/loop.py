@@ -180,6 +180,80 @@ def shard_train_step(mesh: Mesh, vgg_params: Any | None = None,
   return step
 
 
+def shard_train_step_planned(mesh: Mesh, vgg_params: Any | None = None,
+                             resize: int | None = 224, axis: str = "data"):
+  """DP train step with the fused Pallas render in the loss, per shard.
+
+  GSPMD cannot partition a ``pallas_call``, so unlike ``shard_train_step``
+  (which lets XLA shard an all-XLA loss) the loss+grad here runs inside
+  ``shard_map``: every device renders and differentiates its batch shard
+  through the planned fused kernels (forward AND backward, as
+  ``make_train_step_planned``), and loss/grads are ``pmean``-ed over the
+  mesh axis — the same gradient all-reduce-on-ICI layout, now with the
+  Pallas hot path inside it. Batches are planned per step from their
+  concrete poses; a plan made on the FULL pose set is valid for every
+  shard's subset (tap fans and window counts are maxima over poses).
+  Batches outside the forward envelope fall back to the XLA loss, still
+  sharded. The mesh axis size must divide the global batch.
+
+  Returns ``step(state, batch)`` with a ``step.cache`` like the planned
+  single-chip step; place ``state`` with ``replicate`` and the batch with
+  ``shard_batch``.
+  """
+  from jax import shard_map as _smap
+  from mpi_vision_tpu.parallel.mesh import batch_spec
+
+  cache: dict = {}
+  n = mesh.shape[axis]
+
+  def _compile(bundle):
+    if bundle is None:
+      method, rk = "fused", None
+    else:
+      method = "fused_pallas"
+      rk = dict(separable=bundle["separable"], check=False,
+                plan=bundle["plan"], adj_plan=bundle["adj_plan"])
+    loss_fn = make_loss_fn(vgg_params, resize, method=method,
+                           render_kwargs=rk)
+
+    def compiled(state, batch):
+      # apply_fn is read from THIS state (a static TrainState field): a
+      # later state wrapping a different model recompiles rather than
+      # silently reusing the first model's apply.
+      def local_grad(params, shard):
+        loss, grads = jax.value_and_grad(loss_fn)(
+            params, state.apply_fn, shard)
+        return (jax.lax.pmean(loss, axis_name=axis),
+                jax.lax.pmean(grads, axis_name=axis))
+
+      # pallas_call outputs carry no vma metadata (see parallel/mesh.py);
+      # the pmean makes loss/grads replicated regardless.
+      grad_fn = _smap(
+          local_grad, mesh=mesh,
+          in_specs=(P(), jax.tree.map(
+              lambda a: batch_spec(a, mesh, axis), batch)),
+          out_specs=(P(), P()), check_vma=False)
+      loss, grads = grad_fn(state.params, batch)
+      state = state.apply_gradients(grads=grads)
+      return state, {"loss": loss}
+
+    return jax.jit(compiled)
+
+  def step(state: TrainState, batch: Batch):
+    b = batch["ref_img"].shape[0]
+    if b % n:
+      raise ValueError(f"batch {b} not divisible by mesh axis {axis}={n}")
+    bundle = plan_batch_render(batch)
+    key = ("xla" if bundle is None
+           else (bundle["separable"], bundle["plan"], bundle["adj_plan"]))
+    if key not in cache:
+      cache[key] = _compile(bundle)
+    return cache[key](state, batch)
+
+  step.cache = cache
+  return step
+
+
 def fit(state: TrainState, batches, step=None, log_every: int = 0):
   """Minimal epoch driver over an iterable of batches; returns final state
   and the list of per-step losses.

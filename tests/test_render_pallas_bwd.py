@@ -272,3 +272,30 @@ class TestFusedVjpIntegration:
         rp.reference_render(planes, hh) * wmat))(homs)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=1e-3, atol=1e-3)
+
+  def test_jit_constant_pose_grad_uses_pallas_backward(self, rng,
+                                                       monkeypatch):
+    """Poses that are jit CONSTANTS (closed over, concrete at trace time)
+    still get the Pallas backward: the adjoint is planned eagerly from the
+    captured host copy, not lazily from (traced) residuals."""
+    p, h, w = 3, 32, 256
+    planes = _mpi(rng, p, h, w)
+    homs = _homs(h, w, p, **ROTATION)
+    calls = []
+    real = rpb.backward_planes
+
+    def spy(*args, **kwargs):
+      calls.append(kwargs.get("adj_plan") or args[5])
+      return real(*args, **kwargs)
+
+    monkeypatch.setattr(rpb, "backward_planes", spy)
+    rp._make_shared.cache_clear()
+    try:
+      got = jax.jit(jax.grad(lambda pl_: jnp.sum(
+          rp.render_mpi_fused(pl_, homs, separable=False) ** 2)))(planes)
+    finally:
+      rp._make_shared.cache_clear()
+    assert calls, "jit-constant-pose gradient fell back to the XLA VJP"
+    want = jax.grad(lambda pl_: jnp.sum(
+        rp.reference_render(pl_, homs) ** 2))(planes)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-3)

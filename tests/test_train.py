@@ -291,3 +291,47 @@ class TestPlannedTrainStep:
     state, metrics = step(state, _batch_pose(rng, wild))
     assert np.isfinite(float(metrics["loss"]))
     assert "xla" in step.cache
+
+
+class TestShardedPlannedTrainStep:
+  """shard_train_step_planned: fused Pallas loss per shard under shard_map."""
+
+  def test_matches_single_device_planned(self, rng):
+    m = pmesh.make_mesh()
+    state = tloop.create_train_state(
+        jax.random.PRNGKey(0), num_planes=4, image_size=(32, 32), norm=None)
+    batch = _batch_pose(rng, _rot_pose(), b=8)
+
+    single = tloop.make_train_step_planned(vgg_params=None)
+    s1, m1 = single(state, batch)
+
+    sharded = tloop.shard_train_step_planned(m, vgg_params=None)
+    s2, m2 = sharded(pmesh.replicate(state, m), pmesh.shard_batch(batch, m))
+    (key,) = sharded.cache
+    assert key != "xla" and key[0] is False and key[2] is not None
+    np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]), atol=1e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-4),
+        s1.params, s2.params)
+
+  def test_out_of_envelope_falls_back_to_sharded_xla(self, rng):
+    m = pmesh.make_mesh()
+    state = tloop.create_train_state(
+        jax.random.PRNGKey(0), num_planes=4, image_size=(32, 32), norm=None)
+    batch = _batch_pose(rng, _rot_pose(ry=0.8), b=8)
+    sharded = tloop.shard_train_step_planned(m, vgg_params=None)
+    _, metrics = sharded(pmesh.replicate(state, m),
+                         pmesh.shard_batch(batch, m))
+    assert np.isfinite(float(metrics["loss"]))
+    assert "xla" in sharded.cache
+
+  def test_rejects_indivisible_batch(self, rng):
+    m = pmesh.make_mesh()
+    if m.shape["data"] == 1:
+      pytest.skip("every batch divides a 1-device mesh")
+    state = tloop.create_train_state(
+        jax.random.PRNGKey(0), num_planes=4, image_size=(32, 32), norm=None)
+    with pytest.raises(ValueError, match="not divisible"):
+      tloop.shard_train_step_planned(m, vgg_params=None)(
+          state, _batch(rng, b=3))

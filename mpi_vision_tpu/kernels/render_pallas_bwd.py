@@ -777,13 +777,19 @@ def plan_adjoint_shr(homs, height: int, width: int):
   The tap fans must cover the shift-union contributor extents: ``span + 1``
   taps each way, capped at 5 (beyond that the pose is cheaper on the XLA
   backward anyway). ``homs`` concrete; batch axes flatten into planes.
+  Memoized on the pose bytes (``render_pallas.plan_memo``).
   """
+  a = np.asarray(homs)
+  return rp.plan_memo("adj_shr", a, height, width,
+                      lambda: _plan_adjoint_shr_uncached(a, height, width))
+
+
+def _plan_adjoint_shr_uncached(homs: np.ndarray, height: int, width: int):
   # ensure_compile_time_eval: callers may sit under an ambient jit trace
   # (concrete homs as jit constants); the stats must still run eagerly.
   with jax.ensure_compile_time_eval():
     den_ok, span_x, span_y, v_ok, h2, h3 = jax.device_get(
-        _plan_adjoint_shr_stats(jnp.asarray(np.asarray(homs)), height,
-                                width))
+        _plan_adjoint_shr_stats(jnp.asarray(homs), height, width))
   if not den_ok or not v_ok:
     return None
   # +1 to cover the span; vertical +1 more as the interior-row safety tap

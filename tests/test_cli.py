@@ -69,3 +69,15 @@ def test_train_zero_epochs_errors(capsys):
               "--img-size", "32", "--num-planes", "4", "--epochs", "0",
               "--no-vgg-loss"])
   capsys.readouterr()
+
+
+def test_train_synthetic_planned_render(capsys):
+  """--planned-render trains through the fused Pallas loss end to end."""
+  rc = cli.main([
+      "train", "--synthetic", "--synthetic-scenes", "2",
+      "--img-size", "32", "--num-planes", "4", "--epochs", "1",
+      "--no-vgg-loss", "--planned-render",
+  ])
+  assert rc == 0
+  out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+  assert out["steps"] == 2 and np.isfinite(out["final_loss"])

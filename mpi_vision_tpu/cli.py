@@ -63,7 +63,8 @@ def cmd_train(args: argparse.Namespace) -> dict:
       data=config.DataConfig(dataset_path=root, img_size=args.img_size,
                              num_planes=args.num_planes),
       learning_rate=args.lr, epochs=args.epochs,
-      vgg_resize=args.vgg_resize if args.vgg_resize > 0 else None)
+      vgg_resize=args.vgg_resize if args.vgg_resize > 0 else None,
+      compute_dtype="bfloat16" if args.bf16 else None)
   dataset = cfg.data.make_dataset(rng=np.random.default_rng(args.seed))
   state = cfg.make_train_state(jax.random.PRNGKey(args.seed))
   step = cfg.make_train_step("default" if args.vgg_loss else None,
@@ -155,6 +156,10 @@ def build_parser() -> argparse.ArgumentParser:
                  default=False,
                  help="render the loss through the fused Pallas kernels "
                       "(forward+backward), planned per batch on the host")
+  t.add_argument("--bf16", action=argparse.BooleanOptionalAction,
+                 default=False,
+                 help="run the U-Net convs in bfloat16 on the MXU "
+                      "(params/optimizer state stay f32)")
   t.add_argument("--seed", type=int, default=0)
   t.add_argument("--ckpt", default="", help="orbax checkpoint directory")
   t.add_argument("--export-html", default="",

@@ -50,6 +50,8 @@ class TrainConfig:
   epochs: int = 20               # cell 16
   vgg_resize: int | None = 224   # cell 12:50-52
   norm: str | None = "instance"  # cell 10 (ConvLayer InstanceNorm)
+  compute_dtype: str | None = None  # "bfloat16": U-Net convs on the MXU in
+                                    # bf16; params/opt state/outputs f32
 
   @classmethod
   def scaled_480(cls) -> "TrainConfig":
@@ -60,10 +62,15 @@ class TrainConfig:
   def make_train_state(self, rng_key):
     from mpi_vision_tpu.train.loop import create_train_state
 
+    dtype = None
+    if self.compute_dtype is not None:
+      import jax.numpy as jnp
+
+      dtype = jnp.dtype(self.compute_dtype)
     return create_train_state(
         rng_key, num_planes=self.data.num_planes,
         image_size=(self.data.img_size, self.data.img_size),
-        learning_rate=self.learning_rate, norm=self.norm)
+        learning_rate=self.learning_rate, norm=self.norm, dtype=dtype)
 
   def make_train_step(self, vgg_params="default", planned: bool = False):
     """Jitted train step with the reference loss. ``vgg_params='default'``

@@ -40,13 +40,15 @@ class InstanceNorm(nn.Module):
 
   @nn.compact
   def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
-    mean = x.mean(axis=(-3, -2), keepdims=True)
-    var = x.var(axis=(-3, -2), keepdims=True)
-    y = (x - mean) * jax.lax.rsqrt(var + self.epsilon)
+    dt = x.dtype
+    x32 = x.astype(jnp.float32)   # stats in f32 even under bf16 compute
+    mean = x32.mean(axis=(-3, -2), keepdims=True)
+    var = x32.var(axis=(-3, -2), keepdims=True)
+    y = (x32 - mean) * jax.lax.rsqrt(var + self.epsilon)
     c = x.shape[-1]
     scale = self.param("scale", nn.initializers.ones, (c,))
     bias = self.param("bias", nn.initializers.zeros, (c,))
-    return y * scale + bias
+    return (y * scale + bias).astype(dt)
 
 
 class ConvBlock(nn.Module):
@@ -64,6 +66,7 @@ class ConvBlock(nn.Module):
   transpose: bool = False
   norm: str | None = "instance"
   act: str | None = "relu"
+  dtype: Any = None               # computation dtype; params stay f32
 
   @nn.compact
   def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -75,14 +78,16 @@ class ConvBlock(nn.Module):
       x = nn.ConvTranspose(
           self.features, (self.kernel, self.kernel),
           strides=(self.stride, self.stride),
-          padding=((pad, pad), (pad, pad)), transpose_kernel=True, name="conv")(x)
+          padding=((pad, pad), (pad, pad)), transpose_kernel=True,
+          dtype=self.dtype, name="conv")(x)
     else:
       pad = self.dilation * (self.kernel - 1) // 2
       x = nn.Conv(
           self.features, (self.kernel, self.kernel),
           strides=(self.stride, self.stride),
           padding=((pad, pad), (pad, pad)),
-          kernel_dilation=(self.dilation, self.dilation), name="conv")(x)
+          kernel_dilation=(self.dilation, self.dilation), dtype=self.dtype,
+          name="conv")(x)
     if self.norm == "instance":
       x = InstanceNorm(name="norm")(x)
     elif self.norm is not None:
@@ -109,45 +114,53 @@ class StereoMagnificationModel(nn.Module):
 
   num_planes: int = 10
   norm: str | None = "instance"
+  dtype: Any = None     # computation dtype: jnp.bfloat16 runs the convs on
+                        # the MXU in bf16 (params/optimizer state stay f32,
+                        # norm stats and the output are f32 — the standard
+                        # TPU mixed-precision layout, SURVEY.md par.7's
+                        # "f32 default with bf16 option")
 
   @nn.compact
   def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
     ngf = 3 + self.num_planes * 3
     nout = 3 + self.num_planes * 2
     n = self.norm
+    if self.dtype is not None:
+      x = x.astype(self.dtype)
 
-    c1_1 = ConvBlock(ngf, name="cnv1_1", norm=n)(x)
-    c1_2 = ConvBlock(ngf * 2, stride=2, name="cnv1_2", norm=n)(c1_1)
+    c1_1 = ConvBlock(ngf, name="cnv1_1", norm=n, dtype=self.dtype)(x)
+    c1_2 = ConvBlock(ngf * 2, stride=2, name="cnv1_2", norm=n, dtype=self.dtype)(c1_1)
 
-    c2_1 = ConvBlock(ngf * 2, name="cnv2_1", norm=n)(c1_2)
-    c2_2 = ConvBlock(ngf * 4, stride=2, name="cnv2_2", norm=n)(c2_1)
+    c2_1 = ConvBlock(ngf * 2, name="cnv2_1", norm=n, dtype=self.dtype)(c1_2)
+    c2_2 = ConvBlock(ngf * 4, stride=2, name="cnv2_2", norm=n, dtype=self.dtype)(c2_1)
 
-    c3_1 = ConvBlock(ngf * 4, name="cnv3_1", norm=n)(c2_2)
-    c3_2 = ConvBlock(ngf * 4, name="cnv3_2", norm=n)(c3_1)
-    c3_3 = ConvBlock(ngf * 8, stride=2, name="cnv3_3", norm=n)(c3_2)
+    c3_1 = ConvBlock(ngf * 4, name="cnv3_1", norm=n, dtype=self.dtype)(c2_2)
+    c3_2 = ConvBlock(ngf * 4, name="cnv3_2", norm=n, dtype=self.dtype)(c3_1)
+    c3_3 = ConvBlock(ngf * 8, stride=2, name="cnv3_3", norm=n, dtype=self.dtype)(c3_2)
 
-    c4_1 = ConvBlock(ngf * 8, dilation=2, name="cnv4_1", norm=n)(c3_3)
-    c4_2 = ConvBlock(ngf * 8, dilation=2, name="cnv4_2", norm=n)(c4_1)
-    c4_3 = ConvBlock(ngf * 8, dilation=2, name="cnv4_3", norm=n)(c4_2)
+    c4_1 = ConvBlock(ngf * 8, dilation=2, name="cnv4_1", norm=n, dtype=self.dtype)(c3_3)
+    c4_2 = ConvBlock(ngf * 8, dilation=2, name="cnv4_2", norm=n, dtype=self.dtype)(c4_1)
+    c4_3 = ConvBlock(ngf * 8, dilation=2, name="cnv4_3", norm=n, dtype=self.dtype)(c4_2)
 
     x5 = jnp.concatenate([c4_3, c3_3], axis=-1)
     c5_1 = ConvBlock(ngf * 4, kernel=4, stride=2, transpose=True,
-                     name="cnv5_1", norm=n)(x5)
-    c5_2 = ConvBlock(ngf * 4, name="cnv5_2", norm=n)(c5_1)
-    c5_3 = ConvBlock(ngf * 4, name="cnv5_3", norm=n)(c5_2)
+                     name="cnv5_1", norm=n, dtype=self.dtype)(x5)
+    c5_2 = ConvBlock(ngf * 4, name="cnv5_2", norm=n, dtype=self.dtype)(c5_1)
+    c5_3 = ConvBlock(ngf * 4, name="cnv5_3", norm=n, dtype=self.dtype)(c5_2)
 
     x6 = jnp.concatenate([c5_3, c2_2], axis=-1)
     c6_1 = ConvBlock(ngf * 2, kernel=4, stride=2, transpose=True,
-                     name="cnv6_1", norm=n)(x6)
-    c6_2 = ConvBlock(ngf * 2, name="cnv6_2", norm=n)(c6_1)
+                     name="cnv6_1", norm=n, dtype=self.dtype)(x6)
+    c6_2 = ConvBlock(ngf * 2, name="cnv6_2", norm=n, dtype=self.dtype)(c6_1)
 
     x7 = jnp.concatenate([c6_2, c1_2], axis=-1)
     c7_1 = ConvBlock(nout, kernel=4, stride=2, transpose=True,
-                     name="cnv7_1", norm=n)(x7)
-    c7_2 = ConvBlock(nout, name="cnv7_2", norm=n)(c7_1)
+                     name="cnv7_1", norm=n, dtype=self.dtype)(x7)
+    c7_2 = ConvBlock(nout, name="cnv7_2", norm=n, dtype=self.dtype)(c7_1)
 
-    return ConvBlock(nout, kernel=1, norm=None, act="tanh",
-                     name="cnv8_1")(c7_2)
+    out = ConvBlock(nout, kernel=1, norm=None, act="tanh",
+                    dtype=self.dtype, name="cnv8_1")(c7_2)
+    return out.astype(jnp.float32)
 
 
 def mpi_from_net_output(mpi_pred: jnp.ndarray, ref_img: jnp.ndarray) -> jnp.ndarray:

@@ -41,9 +41,14 @@ def create_train_state(
     image_size: tuple[int, int] = (224, 224),
     learning_rate: float = 2e-4,
     norm: str | None = "instance",
+    dtype: Any = None,
 ) -> TrainState:
-  """Init model params and Adam (reference lr 2e-4, cells 15-16)."""
-  model = StereoMagnificationModel(num_planes=num_planes, norm=norm)
+  """Init model params and Adam (reference lr 2e-4, cells 15-16).
+
+  ``dtype=jnp.bfloat16`` runs the U-Net's convs in bf16 on the MXU while
+  params, optimizer state, and outputs stay f32 (mixed precision)."""
+  model = StereoMagnificationModel(num_planes=num_planes, norm=norm,
+                                   dtype=dtype)
   h, w = image_size
   sample = jnp.zeros((1, h, w, 3 + 3 * num_planes), jnp.float32)
   params = model.init(rng, sample)["params"]

@@ -71,13 +71,15 @@ def test_train_zero_epochs_errors(capsys):
   capsys.readouterr()
 
 
-def test_train_synthetic_planned_render(capsys):
-  """--planned-render trains through the fused Pallas loss end to end."""
+@pytest.mark.parametrize("bf16", [False, True])
+def test_train_synthetic_planned_render(capsys, bf16):
+  """--planned-render trains through the fused Pallas loss end to end, in
+  both default f32 and --bf16 compute."""
   rc = cli.main([
       "train", "--synthetic", "--synthetic-scenes", "2",
       "--img-size", "32", "--num-planes", "4", "--epochs", "1",
       "--no-vgg-loss", "--planned-render",
-  ])
+  ] + (["--bf16"] if bf16 else []))
   assert rc == 0
   out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
   assert out["steps"] == 2 and np.isfinite(out["final_loss"])

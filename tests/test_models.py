@@ -152,3 +152,43 @@ class TestTinyPlaneUNet:
       params, opt, l = step(params, opt)
       losses.append(float(l))
     assert losses[-1] < losses[0], losses
+
+
+class TestBF16Compute:
+  """dtype=jnp.bfloat16: MXU-precision compute, f32 params and output
+  (SURVEY.md par.7's "f32 default with bf16 option")."""
+
+  def _setup(self, rng, norm):
+    x = jnp.asarray(rng.uniform(-1, 1, (1, 32, 32, 15)).astype(np.float32))
+    m32 = stereo_mag.StereoMagnificationModel(num_planes=4, norm=norm)
+    mbf = stereo_mag.StereoMagnificationModel(num_planes=4, norm=norm,
+                                              dtype=jnp.bfloat16)
+    params = m32.init(jax.random.PRNGKey(0), x)["params"]
+    return x, m32, mbf, params
+
+  @pytest.mark.parametrize("norm", [None, "instance"])
+  def test_forward_tracks_f32(self, rng, norm):
+    x, m32, mbf, params = self._setup(rng, norm)
+    y32 = m32.apply({"params": params}, x)
+    ybf = mbf.apply({"params": params}, x)
+    assert ybf.dtype == jnp.float32          # output cast back
+    d = np.abs(np.asarray(y32) - np.asarray(ybf))
+    # bf16's 8-bit mantissa compounds through ~20 layers; the tanh output
+    # lives in (-1, 1), so a few 1e-2 of drift is the expected precision,
+    # not a bug.
+    assert d.mean() < 2e-2 and d.max() < 0.2, (d.mean(), d.max())
+
+  def test_params_identical_tree_and_f32(self, rng):
+    x, m32, mbf, params = self._setup(rng, "instance")
+    pbf = mbf.init(jax.random.PRNGKey(0), x)["params"]
+    assert jax.tree.structure(params) == jax.tree.structure(pbf)
+    assert all(a.dtype == jnp.float32 for a in jax.tree.leaves(pbf))
+
+  def test_grads_finite_and_nonzero(self, rng):
+    x, m32, mbf, params = self._setup(rng, None)
+    g = jax.grad(lambda p: jnp.sum(
+        mbf.apply({"params": p}, x) ** 2))(params)
+    leaves = jax.tree.leaves(g)
+    assert all(np.isfinite(np.asarray(a)).all() for a in leaves)
+    assert any(float(jnp.abs(a).max()) > 0 for a in leaves)
+    assert all(a.dtype == jnp.float32 for a in leaves)

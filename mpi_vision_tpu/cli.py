@@ -158,8 +158,8 @@ def build_parser() -> argparse.ArgumentParser:
                       "(forward+backward), planned per batch on the host")
   t.add_argument("--bf16", action=argparse.BooleanOptionalAction,
                  default=False,
-                 help="run the U-Net convs in bfloat16 on the MXU "
-                      "(params/optimizer state stay f32)")
+                 help="run the U-Net and VGG-loss convs in bfloat16 on the "
+                      "MXU (params/optimizer state stay f32)")
   t.add_argument("--seed", type=int, default=0)
   t.add_argument("--ckpt", default="", help="orbax checkpoint directory")
   t.add_argument("--export-html", default="",

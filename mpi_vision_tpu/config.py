@@ -86,9 +86,16 @@ class TrainConfig:
 
     if isinstance(vgg_params, str) and vgg_params == "default":
       vgg_params = vgg.default_params()
+    vgg_dtype = None
+    if self.compute_dtype is not None:
+      import jax.numpy as jnp
+
+      vgg_dtype = jnp.dtype(self.compute_dtype)
     if planned:
-      return make_train_step_planned(vgg_params, resize=self.vgg_resize)
-    return make_train_step(vgg_params, resize=self.vgg_resize)
+      return make_train_step_planned(vgg_params, resize=self.vgg_resize,
+                                     vgg_dtype=vgg_dtype)
+    return make_train_step(vgg_params, resize=self.vgg_resize,
+                           vgg_dtype=vgg_dtype)
 
 
 @dataclasses.dataclass(frozen=True)

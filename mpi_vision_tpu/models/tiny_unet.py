@@ -19,6 +19,8 @@ evidence.
 
 from __future__ import annotations
 
+from typing import Any
+
 import flax.linen as nn
 import jax.numpy as jnp
 
@@ -32,35 +34,39 @@ class TinyPlaneUNet(nn.Module):
 
   width: int = 32
   mix: int = 2   # cross-plane mixing convs at the bottleneck
+  dtype: Any = None  # bf16 compute on the MXU; params/output stay f32
 
   @nn.compact
   def __call__(self, psv: jnp.ndarray) -> jnp.ndarray:
     b, h, w, p, c = psv.shape
     x = psv.transpose(0, 3, 1, 2, 4).reshape(b * p, h, w, c)
+    if self.dtype is not None:
+      x = x.astype(self.dtype)
 
     # Shared-weight per-plane encoder (planes folded into batch).
-    e0 = nn.relu(nn.Conv(self.width, (3, 3), name="enc0")(x))
+    e0 = nn.relu(nn.Conv(self.width, (3, 3), dtype=self.dtype, name="enc0")(x))
     e1 = nn.relu(nn.Conv(self.width * 2, (3, 3), strides=(2, 2),
-                         name="enc1")(e0))
+                         dtype=self.dtype, name="enc1")(e0))
     e2 = nn.relu(nn.Conv(self.width * 4, (3, 3), strides=(2, 2),
-                         name="enc2")(e1))
+                         dtype=self.dtype, name="enc2")(e1))
 
     # Cross-plane mixing: stack plane features on channels at 1/4 res.
     m = e2.reshape(b, p, h // 4, w // 4, -1)
     m = m.transpose(0, 2, 3, 1, 4).reshape(b, h // 4, w // 4, -1)
     for i in range(self.mix):
-      m = nn.relu(nn.Conv(self.width * 4 * 2, (3, 3), name=f"mix{i}")(m))
-    m = nn.Conv(p * self.width * 4, (1, 1), name="unmix")(m)
+      m = nn.relu(nn.Conv(self.width * 4 * 2, (3, 3), dtype=self.dtype, name=f"mix{i}")(m))
+    m = nn.Conv(p * self.width * 4, (1, 1), dtype=self.dtype, name="unmix")(m)
     m = m.reshape(b, h // 4, w // 4, p, -1)
     m = m.transpose(0, 3, 1, 2, 4).reshape(b * p, h // 4, w // 4, -1)
 
     # Shared-weight decoder with skips.
     d1 = nn.relu(nn.ConvTranspose(self.width * 2, (4, 4), strides=(2, 2),
-                                  name="dec1")(jnp.concatenate([m, e2], -1)))
+                                  dtype=self.dtype, name="dec1")(jnp.concatenate([m, e2], -1)))
     d0 = nn.relu(nn.ConvTranspose(self.width, (4, 4), strides=(2, 2),
-                                  name="dec0")(jnp.concatenate([d1, e1], -1)))
-    out = nn.Conv(4, (1, 1), name="head")(jnp.concatenate([d0, e0], -1))
+                                  dtype=self.dtype, name="dec0")(jnp.concatenate([d1, e1], -1)))
+    out = nn.Conv(4, (1, 1), dtype=self.dtype, name="head")(jnp.concatenate([d0, e0], -1))
 
+    out = out.astype(jnp.float32)
     rgb = jnp.tanh(out[..., :3])
     alpha = nn.sigmoid(out[..., 3:])
     out = jnp.concatenate([rgb, alpha], -1)

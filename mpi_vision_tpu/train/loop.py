@@ -60,11 +60,13 @@ def make_loss_fn(vgg_params: Any | None,
                  resize: int | None = 224,
                  method: str = "fused",
                  render_kwargs: Mapping[str, Any] | None = None,
+                 vgg_dtype: Any = None,
                  ) -> Callable[..., jnp.ndarray]:
   """Loss closure: VGG-perceptual when ``vgg_params`` given, else L2.
 
   ``method``/``render_kwargs`` select the renderer inside the loss (the
-  planned-step path passes 'fused_pallas' plus a ``plan_fused`` bundle).
+  planned-step path passes 'fused_pallas' plus a ``plan_fused`` bundle);
+  ``vgg_dtype=jnp.bfloat16`` runs the VGG feature convs on the MXU in bf16.
   """
 
   def loss_fn(params, apply_fn, batch: Batch):
@@ -74,7 +76,8 @@ def make_loss_fn(vgg_params: Any | None,
                                      render_kwargs=render_kwargs)
     return loss_lib.vgg_perceptual_loss(mpi_pred, batch, vgg_params, resize,
                                         method=method,
-                                        render_kwargs=render_kwargs)
+                                        render_kwargs=render_kwargs,
+                                        vgg_dtype=vgg_dtype)
 
   return loss_fn
 
@@ -92,9 +95,11 @@ def _grad_step(loss_fn):
 
 
 def make_train_step(vgg_params: Any | None = None,
-                    resize: int | None = 224):
+                    resize: int | None = 224,
+                    vgg_dtype: Any = None):
   """A jitted ``(state, batch) -> (state, metrics)`` step."""
-  return jax.jit(_grad_step(make_loss_fn(vgg_params, resize)))
+  return jax.jit(_grad_step(make_loss_fn(vgg_params, resize,
+                                         vgg_dtype=vgg_dtype)))
 
 
 def plan_batch_render(batch: Batch, convention=None):
@@ -121,7 +126,8 @@ def plan_batch_render(batch: Batch, convention=None):
 
 
 def make_train_step_planned(vgg_params: Any | None = None,
-                            resize: int | None = 224):
+                            resize: int | None = 224,
+                            vgg_dtype: Any = None):
   """A train step rendering through the fused Pallas kernels, forward AND
   backward (kernels/render_pallas + render_pallas_bwd).
 
@@ -144,14 +150,15 @@ def make_train_step_planned(vgg_params: Any | None = None,
     if bundle is None:
       key = "xla"
       if key not in cache:
-        cache[key] = make_train_step(vgg_params, resize)
+        cache[key] = make_train_step(vgg_params, resize, vgg_dtype)
     else:
       key = (bundle["separable"], bundle["plan"], bundle["adj_plan"])
       if key not in cache:
         rk = dict(separable=bundle["separable"], check=False,
                   plan=bundle["plan"], adj_plan=bundle["adj_plan"])
         cache[key] = jax.jit(_grad_step(make_loss_fn(
-            vgg_params, resize, method="fused_pallas", render_kwargs=rk)))
+            vgg_params, resize, method="fused_pallas", render_kwargs=rk,
+            vgg_dtype=vgg_dtype)))
     return cache[key](state, batch)
 
   step.cache = cache
@@ -159,7 +166,8 @@ def make_train_step_planned(vgg_params: Any | None = None,
 
 
 def shard_train_step(mesh: Mesh, vgg_params: Any | None = None,
-                     resize: int | None = 224, axis: str = "data"):
+                     resize: int | None = 224, axis: str = "data",
+                     vgg_dtype: Any = None):
   """The train step compiled for a mesh: batch DP-sharded, state replicated.
 
   Gradients are averaged across the ``axis`` shards by XLA (the loss means
@@ -169,7 +177,8 @@ def shard_train_step(mesh: Mesh, vgg_params: Any | None = None,
   """
   from mpi_vision_tpu.parallel.mesh import batch_spec
 
-  raw_step = _grad_step(make_loss_fn(vgg_params, resize))
+  raw_step = _grad_step(make_loss_fn(vgg_params, resize,
+                                     vgg_dtype=vgg_dtype))
   repl = NamedSharding(mesh, P())
 
   @functools.partial(jax.jit, donate_argnums=(0,))
@@ -186,7 +195,8 @@ def shard_train_step(mesh: Mesh, vgg_params: Any | None = None,
 
 
 def shard_train_step_planned(mesh: Mesh, vgg_params: Any | None = None,
-                             resize: int | None = 224, axis: str = "data"):
+                             resize: int | None = 224, axis: str = "data",
+                             vgg_dtype: Any = None):
   """DP train step with the fused Pallas render in the loss, per shard.
 
   GSPMD cannot partition a ``pallas_call``, so unlike ``shard_train_step``
@@ -219,7 +229,7 @@ def shard_train_step_planned(mesh: Mesh, vgg_params: Any | None = None,
       rk = dict(separable=bundle["separable"], check=False,
                 plan=bundle["plan"], adj_plan=bundle["adj_plan"])
     loss_fn = make_loss_fn(vgg_params, resize, method=method,
-                           render_kwargs=rk)
+                           render_kwargs=rk, vgg_dtype=vgg_dtype)
 
     def compiled(state, batch):
       # apply_fn is read from THIS state (a static TrainState field): a

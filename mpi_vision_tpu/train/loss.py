@@ -80,8 +80,13 @@ def vgg_perceptual_loss(
     convention: Convention = Convention.REF_HOMOGRAPHY,
     method: str = "fused",
     render_kwargs: Mapping[str, Any] | None = None,
+    vgg_dtype: Any = None,
 ) -> jnp.ndarray:
-  """The reference training loss (cell 12): pixel L1 + weighted VGG L1s."""
+  """The reference training loss (cell 12): pixel L1 + weighted VGG L1s.
+
+  ``vgg_dtype=jnp.bfloat16`` runs the VGG feature convs in bf16 on the
+  MXU (taps come back f32, so the L1 terms accumulate at full precision).
+  """
   with jax.named_scope("loss/render"):
     out = render_novel_view(mpi_pred, batch, convention=convention,
                             method=method, render_kwargs=render_kwargs)
@@ -100,8 +105,9 @@ def vgg_perceptual_loss(
 
   loss = jnp.mean(jnp.abs(x - y))                           # cell 12:54
   with jax.named_scope("loss/vgg"):
-    feats_x = vgg.VGG16Features().apply(vgg_params, x)
-    feats_y = vgg.VGG16Features().apply(vgg_params, y)
+    net = vgg.VGG16Features(dtype=vgg_dtype)
+    feats_x = net.apply(vgg_params, x)
+    feats_y = net.apply(vgg_params, y)
     for i, (fx, fy) in enumerate(zip(feats_x, feats_y)):
       loss = loss + jnp.mean(jnp.abs(fx - fy)) / (1.0 + i)  # cell 12:55-59
   return loss

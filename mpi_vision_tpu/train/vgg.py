@@ -57,22 +57,31 @@ assert _TORCH_TAP_INDICES == [3, 8, 15, 22]
 
 
 class VGG16Features(nn.Module):
-  """Returns the four perceptual-loss feature maps for NHWC input."""
+  """Returns the four perceptual-loss feature maps for NHWC input.
+
+  ``dtype=jnp.bfloat16`` runs the convs in bf16 on the MXU (params stay
+  f32); the taps are cast back to f32 so downstream L1 terms accumulate
+  at full precision.
+  """
+
+  dtype: Any = None
 
   @nn.compact
   def __call__(self, x: jnp.ndarray) -> list[jnp.ndarray]:
+    if self.dtype is not None:
+      x = x.astype(self.dtype)
     taps = []
     conv_i = 0
     for c in _CFG:
       if c == "M":
         x = nn.max_pool(x, (2, 2), strides=(2, 2))
         continue
-      x = nn.Conv(c, (3, 3), padding=((1, 1), (1, 1)),
+      x = nn.Conv(c, (3, 3), padding=((1, 1), (1, 1)), dtype=self.dtype,
                   name=f"conv{conv_i}")(x)
       x = nn.relu(x)
       conv_i += 1
       if conv_i in _TAPS_AFTER_CONV:
-        taps.append(x)
+        taps.append(x.astype(jnp.float32))
     return taps
 
 

@@ -192,3 +192,16 @@ class TestBF16Compute:
     assert all(np.isfinite(np.asarray(a)).all() for a in leaves)
     assert any(float(jnp.abs(a).max()) > 0 for a in leaves)
     assert all(a.dtype == jnp.float32 for a in leaves)
+
+  def test_bf16_compute_tracks_f32(self, rng):
+    from mpi_vision_tpu.models import tiny_unet
+
+    psv = jnp.asarray(rng.uniform(-1, 1, (1, 16, 16, 3, 3)).astype(np.float32))
+    m32 = tiny_unet.TinyPlaneUNet(width=8)
+    mbf = tiny_unet.TinyPlaneUNet(width=8, dtype=jnp.bfloat16)
+    params = m32.init(jax.random.PRNGKey(0), psv)["params"]
+    y32 = m32.apply({"params": params}, psv)
+    ybf = mbf.apply({"params": params}, psv)
+    assert ybf.dtype == jnp.float32
+    d = np.abs(np.asarray(y32) - np.asarray(ybf))
+    assert d.mean() < 2e-2 and d.max() < 0.2, (d.mean(), d.max())

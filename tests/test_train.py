@@ -335,3 +335,20 @@ class TestShardedPlannedTrainStep:
     with pytest.raises(ValueError, match="not divisible"):
       tloop.shard_train_step_planned(m, vgg_params=None)(
           state, _batch(rng, b=3))
+
+
+def test_vgg_bf16_loss_tracks_f32(rng):
+  """vgg_dtype=bf16 perceptual loss stays close to the f32 loss."""
+  from mpi_vision_tpu.train import loss as loss_lib
+  from mpi_vision_tpu.train import vgg
+
+  batch = _batch(rng, hw=32)
+  params = vgg.init_params(0)
+  state = tloop.create_train_state(
+      jax.random.PRNGKey(0), num_planes=4, image_size=(32, 32), norm=None)
+  pred = state.apply_fn({"params": state.params}, batch["net_input"])
+  l32 = float(loss_lib.vgg_perceptual_loss(pred, batch, params, resize=None))
+  lbf = float(loss_lib.vgg_perceptual_loss(pred, batch, params, resize=None,
+                                           vgg_dtype=jnp.bfloat16))
+  assert np.isfinite(lbf)
+  assert abs(l32 - lbf) / max(abs(l32), 1e-6) < 0.05, (l32, lbf)

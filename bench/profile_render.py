@@ -1,0 +1,117 @@
+"""Capture a jax.profiler trace of the fused render kernels (TPU only).
+
+Writes a perfetto/tensorboard-compatible trace of ~20 frames of each
+headline path — separable (truck+dolly) and general (1-degree pan) at
+1080p x 32 planes — plus Pallas-backward gradients of the rotation path,
+under ``artifacts/trace_r03/``. The trace is the input for the next round's
+kernel-level optimization (which ops bind: gathers, DMA waits, or the
+scalar core) without needing live chip time to investigate.
+
+One JSON line: value = 1.0 if the trace directory was written, with the
+capture's frame timings as side fields. Off-TPU this is a no-op (emits
+value 0.0) — interpret-mode traces carry no kernel timing.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(1, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np  # noqa: E402
+
+import _common  # noqa: E402
+
+TRACE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "artifacts", "trace_r03")
+
+
+def main() -> None:
+  import jax
+  import jax.numpy as jnp
+
+  from mpi_vision_tpu.core.camera import inv_depths
+  from mpi_vision_tpu.kernels import render_pallas as rp
+
+  if jax.default_backend() != "tpu":
+    _common.log("no TPU: interpret-mode traces carry no kernel timing")
+    _common.emit("render_profile_trace_written", 0.0, "bool", 0.0,
+                 note="skipped off-TPU")
+    return
+
+  h, w, p = 1080, 1920, 32
+  planes = jax.jit(lambda k: jax.random.uniform(k, (p, 4, h, w)))(
+      jax.random.PRNGKey(0))
+  jax.block_until_ready(planes)
+  depths = jnp.asarray(np.asarray(inv_depths(1.0, 100.0, p)))
+  k = np.array([[0.5 * w, 0, w / 2], [0, 0.5 * w, h / 2], [0, 0, 1]],
+               np.float32)
+
+  def homs_for(ry_deg, tx, tz):
+    pose = np.eye(4, dtype=np.float32)
+    r = np.radians(ry_deg)
+    c, s = np.cos(r), np.sin(r)
+    pose[:3, :3] = [[c, 0, s], [0, 1, 0], [-s, 0, c]]
+    pose[0, 3], pose[2, 3] = tx, tz
+    return rp.pixel_homographies(
+        jnp.asarray(pose)[None], depths, jnp.asarray(k)[None], h, w)[:, 0]
+
+  homs_sep = homs_for(0.0, 0.08, -0.05)
+  homs_rot = homs_for(1.0, 0.05, -0.03)
+
+  # Warm up (compile outside the trace so the trace holds steady-state).
+  jax.block_until_ready(rp.render_mpi_fused(planes, homs_sep, separable=True))
+  jax.block_until_ready(rp.render_mpi_fused(planes, homs_rot,
+                                            separable=False))
+
+  # Gradient through the fused render (the training hot path): warm up so
+  # the trace holds steady-state kernels, not compiles.
+  grad_rot = jax.jit(jax.grad(
+      lambda pl_: jnp.sum(rp.render_mpi_fused(pl_, homs_rot,
+                                              separable=False) ** 2)))
+  jax.block_until_ready(grad_rot(planes))
+
+  import shutil
+  import time
+  # Clear stale captures: a leftover trace from a killed previous run must
+  # not let a failed capture report trace_written=1.0.
+  shutil.rmtree(TRACE_DIR, ignore_errors=True)
+  os.makedirs(TRACE_DIR, exist_ok=True)
+  with jax.profiler.trace(TRACE_DIR):
+    t0 = time.perf_counter()
+    for _ in range(20):
+      out = rp.render_mpi_fused(planes, homs_sep, separable=True)
+    jax.block_until_ready(out)
+    t_sep = (time.perf_counter() - t0) / 20
+    t0 = time.perf_counter()
+    for _ in range(20):
+      out = rp.render_mpi_fused(planes, homs_rot, separable=False)
+    jax.block_until_ready(out)
+    t_rot = (time.perf_counter() - t0) / 20
+    t0 = time.perf_counter()
+    for _ in range(5):
+      g = grad_rot(planes)
+    jax.block_until_ready(g)
+    t_bwd = (time.perf_counter() - t0) / 5
+
+  written = bool(glob.glob(os.path.join(TRACE_DIR, "**", "*.pb"),
+                           recursive=True)
+                 or glob.glob(os.path.join(TRACE_DIR, "**", "*.json.gz"),
+                              recursive=True)
+                 or glob.glob(os.path.join(TRACE_DIR, "**", "*.trace*"),
+                              recursive=True))
+  _common.log(f"trace at {TRACE_DIR} (written={written}); "
+              f"separable {t_sep * 1e3:.1f} ms, rotation {t_rot * 1e3:.1f} ms, "
+              f"rotation grad {t_bwd * 1e3:.1f} ms")
+  _common.emit("render_profile_trace_written", 1.0 if written else 0.0,
+               "bool", 1.0 if written else 0.0,
+               separable_ms=round(t_sep * 1e3, 2),
+               rotation_ms=round(t_rot * 1e3, 2),
+               rotation_grad_ms=round(t_bwd * 1e3, 2), trace_dir=TRACE_DIR)
+
+
+if __name__ == "__main__":
+  main()

@@ -35,4 +35,5 @@ run tpu_r03_config4 bench/config4_sharded.py
 run tpu_r03_config5 bench/config5_tiny_unet.py
 run tpu_r03_train_speed bench/train_speed.py
 run tpu_r03_render_bwd bench/render_bwd.py
+run tpu_r03_profile bench/profile_render.py
 echo "battery done $(date -u +%H:%M:%SZ)"

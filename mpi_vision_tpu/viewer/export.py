@@ -53,6 +53,11 @@ _HTML_TEMPLATE = """<!DOCTYPE html>
   .depthmap .layer img { visibility: hidden; }
   .depthmap .layer .tint { display: block; }
   .alpha .layer img { filter: grayscale(1) contrast(0); }
+  /* Excluded-layer silhouettes (the reference's white/black feColorMatrix
+     inspection filters, template:693-698): keep the alpha shape, flatten
+     the RGB to black or white. */
+  body.silh-black .layer.excluded img { filter: brightness(0); }
+  body.silh-white .layer.excluded img { filter: brightness(0) invert(1); }
   #hud { position: fixed; left: 8px; bottom: 8px; opacity: .7;
          user-select: none; }
   #minis { position: fixed; right: 8px; top: 8px; bottom: 8px; width: 96px;
@@ -68,8 +73,8 @@ _HTML_TEMPLATE = """<!DOCTYPE html>
 <div id="stage"><div id="frustum"></div></div>
 <div id="minis"></div>
 <div id="hud">drag: rotate · shift-drag: pan · wheel: dolly ·
-1-9/0: solo · [: under · ]: over · a: alpha · d: depth map ·
-s: sway · w: wander · m: minis · r: reset</div>
+1-9/0: solo · [: under · ]: over · x: dim/black/white others ·
+a: alpha · d: depth map · s: sway · w: wander · m: minis · r: reset</div>
 <script>
 "use strict";
 const embeddedSources = __MPI_SOURCES__;
@@ -195,6 +200,7 @@ const hover = { rx: 0, ry: 0 };
 const auto = { rx: 0, ry: 0 };
 const sel = { index: cfg.solo, mode: "solo" };
 let depthMode = cfg.depth % COLORMAPS.length;
+let silhMode = "dim";             // dim | black | white (excluded layers)
 let moveMode = cfg.move;          // none | sway | wander
 let dragging = false, lastX = 0, lastY = 0;
 if (!cfg.mini) document.body.classList.add("nominis");
@@ -204,6 +210,12 @@ function visible(i) {
   if (sel.mode === "solo") return i === sel.index;
   if (sel.mode === "under") return i <= sel.index;
   return i >= sel.index;          // over
+}
+
+function setSilhMode(mode) {
+  silhMode = mode;                // dim | black | white
+  document.body.classList.toggle("silh-black", mode === "black");
+  document.body.classList.toggle("silh-white", mode === "white");
 }
 
 function setDepthMode(mode) {
@@ -229,7 +241,15 @@ function apply() {
       `translate3d(${base.tx}px, ${base.ty}px, ${base.tz}px) ` +
       `rotateX(${base.rx + hover.rx + auto.rx}deg) ` +
       `rotateY(${base.ry + hover.ry + auto.ry}deg)`;
-  layers.forEach((l, i) => l.style.opacity = visible(i) ? 1 : 0.04);
+  layers.forEach((l, i) => {
+    const vis = visible(i);
+    l.classList.toggle("excluded", !vis);
+    // Depth-map mode shows tint panes, which the silhouette img filters
+    // cannot reach — keep excluded layers dimmed there so the selection
+    // stays visible.
+    const silh = silhMode !== "dim" && depthMode === 0;
+    l.style.opacity = vis ? 1 : (silh ? 1 : 0.04);
+  });
   minis.forEach((m, i) => m.classList.toggle("sel",
       sel.index >= 0 && visible(i)));
 }
@@ -282,6 +302,9 @@ window.addEventListener("keydown", e => {
     sel.mode = "under";
   } else if (e.key === "]" && sel.index >= 0) {
     sel.mode = "over";
+  } else if (e.key === "x") {
+    setSilhMode(silhMode === "dim" ? "black"
+        : (silhMode === "black" ? "white" : "dim"));
   } else if (e.key === "a") {
     document.body.classList.toggle("alpha");
   } else if (e.key === "d") {
@@ -295,6 +318,7 @@ window.addEventListener("keydown", e => {
   } else if (e.key === "r") {
     Object.assign(base, { rx: 0, ry: 0, tx: 0, ty: 0, tz: 0 });
     sel.index = -1; setDepthMode(0); setMoveMode("none");
+    setSilhMode("dim");
   }
   apply();
 });

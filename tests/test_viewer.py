@@ -84,6 +84,14 @@ class TestViewerFeatures:
         str(tmp_path_factory.mktemp("v") / "v.html"))
     return open(out).read()
 
+  def test_silhouette_modes(self, html):
+    """Excluded-layer black/white silhouettes (the reference's
+    feColorMatrix white/black inspection filters, template:693-698)."""
+    assert "silh-black" in html and "silh-white" in html
+    assert "brightness(0) invert(1)" in html    # white silhouette filter
+    assert 'e.key === "x"' in html              # the mode-cycle key
+    assert "setSilhMode" in html
+
   def test_depth_colormap_modes(self, html):
     # Two procedural colormaps tinting layers through their alpha masks.
     assert "function turbo(" in html

@@ -105,6 +105,32 @@ def render_views_sharded(
   return fn(rgba_layers[None], tgt_poses, intrinsics)
 
 
+def _fold_plane_shard(shard: jnp.ndarray, axis: str, n: int) -> jnp.ndarray:
+  """Composite a device's plane shard, finishing across ``axis``.
+
+  Inside shard_map: ``shard [P/n, ..., 4]`` back-to-front. Only the GLOBAL
+  index-0 plane (axis_index == 0) gets the reference's first-opaque
+  treatment; the shard folds to one affine (A, B) pair via
+  ``associative_scan``, the tiny pairs are all-gathered over ``axis``
+  (the only cross-device traffic: 4/3-channel images), and the ordered
+  fold finishes locally. Shared by the 1-D and 2-D mesh composites so the
+  first-plane/fold-order semantics cannot drift between them.
+  """
+  first = jax.lax.axis_index(axis) == 0
+  coeff, offset = compose.plane_affine(shard, first_opaque=False)
+  coeff = jnp.where(first, coeff.at[0].set(0.0), coeff)
+  offset = jnp.where(first, offset.at[0].set(shard[0, ..., :3]), offset)
+  a, b = jax.lax.associative_scan(compose.combine_affine, (coeff, offset),
+                                  axis=0)
+  a, b = a[-1], b[-1]                       # this shard as ONE affine map
+  a_all = jax.lax.all_gather(a, axis)       # [n, ..., 1]
+  b_all = jax.lax.all_gather(b, axis)       # [n, ..., 3]
+  out = b_all[0]
+  for i in range(1, n):                     # ordered fold, n is tiny
+    out = b_all[i] + a_all[i] * out
+  return out
+
+
 def over_composite_planes_sharded(
     rgba: jnp.ndarray,
     mesh: Mesh,
@@ -112,36 +138,20 @@ def over_composite_planes_sharded(
 ) -> jnp.ndarray:
   """Back-to-front composite with the plane axis sharded across devices.
 
-  ``rgba``: ``[P, ..., 4]`` back-to-front, P divisible by the axis size.
+  ``rgba``: ``[P, ..., 4]`` back-to-front; the axis size must divide P.
   Same contract as ``core.compose.over_composite`` (farthest plane's alpha
-  ignored). Each device reduces its plane shard to one affine (A, B) pair
-  via ``associative_scan``; the tiny pairs are all-gathered and folded in
-  axis order — O(P/n) local work + one all-gather of 4/3-channel images.
+  ignored). O(P/n) local work + one all-gather of 4/3-channel images
+  (see ``_fold_plane_shard``).
   """
   p = rgba.shape[0]
   n = mesh.shape[axis]
   if p % n:
     raise ValueError(f"plane count {p} not divisible by mesh axis {axis}={n}")
 
-  def local(shard):
-    # shard [P/n, ..., 4]; only the global index-0 plane gets first_opaque.
-    first = jax.lax.axis_index(axis) == 0
-    coeff, offset = compose.plane_affine(shard, first_opaque=False)
-    coeff = jnp.where(first, coeff.at[0].set(0.0), coeff)
-    offset = jnp.where(first, offset.at[0].set(shard[0, ..., :3]), offset)
-    a, b = jax.lax.associative_scan(compose.combine_affine, (coeff, offset),
-                                   axis=0)
-    a, b = a[-1], b[-1]                       # this shard as ONE affine map
-    a_all = jax.lax.all_gather(a, axis)       # [n, ..., 1]
-    b_all = jax.lax.all_gather(b, axis)       # [n, ..., 3]
-    out = b_all[0]
-    for i in range(1, n):                     # ordered fold, n is tiny
-      out = b_all[i] + a_all[i] * out
-    return out
-
   # check_vma=False: the ordered fold after the all_gather yields the same
   # value on every device, but shard_map cannot infer that replication.
-  fn = shard_map(local, mesh=mesh, in_specs=(P(axis),), out_specs=P(),
+  fn = shard_map(lambda shard: _fold_plane_shard(shard, axis, n),
+                 mesh=mesh, in_specs=(P(axis),), out_specs=P(),
                  check_vma=False)
   return fn(rgba)
 
@@ -171,3 +181,59 @@ def shard_batch(x, mesh: Mesh, axis: str = "data"):
   return jax.tree.map(
       lambda a: jax.device_put(a, NamedSharding(mesh, batch_spec(a, mesh, axis))),
       x)
+
+
+def render_views_planes_sharded(
+    rgba_layers: jnp.ndarray,
+    tgt_poses: jnp.ndarray,
+    depths: jnp.ndarray,
+    intrinsics: jnp.ndarray,
+    mesh: Mesh,
+    view_axis: str = "data",
+    plane_axis: str = "planes",
+    convention: Convention = Convention.REF_HOMOGRAPHY,
+) -> jnp.ndarray:
+  """Render a view batch on a 2-D (views x planes) mesh.
+
+  The combined layout of the two parallel axes (the DP + sequence-parallel
+  analog for MPIs): views shard over ``view_axis`` exactly as in
+  ``render_views_sharded``, while the PLANE axis — the depth scan the
+  composite is sequential over — shards over ``plane_axis``. Each device
+  warps only its local plane shard for its local views, folds those planes
+  into ONE affine (A, B) pair (``core.compose.plane_affine`` /
+  ``associative_scan``), and a single tiny ``all_gather`` of the pairs
+  over ``plane_axis`` (4 channels x pixels per device — the only
+  cross-chip traffic) finishes the ordered fold locally, as in
+  ``over_composite_planes_sharded``.
+
+  ``rgba_layers``: ``[H, W, P, 4]`` back-to-front; ``tgt_poses``
+  ``[V, 4, 4]``; ``depths`` ``[P]`` descending; ``intrinsics`` ``[3, 3]``.
+  The mesh axis sizes must divide V and P respectively. Returns
+  ``[V, H, W, 3]`` sharded over ``view_axis``.
+  """
+  n_v, n_p = mesh.shape[view_axis], mesh.shape[plane_axis]
+  v, p = tgt_poses.shape[0], rgba_layers.shape[2]
+  if v % n_v or p % n_p:
+    raise ValueError(
+        f"views {v} / planes {p} not divisible by mesh axes "
+        f"{view_axis}={n_v} / {plane_axis}={n_p}")
+
+  def local(mpi, poses, k, dep):
+    # mpi [H, W, P/np, 4]; poses [V/nv, 4, 4]; dep [P/np].
+    vn = poses.shape[0]
+    planes = jnp.moveaxis(mpi, 2, 0)[:, None]              # [P/np,1,H,W,4]
+    planes = jnp.broadcast_to(planes, planes.shape[:1] + (vn,)
+                              + planes.shape[2:])
+    warped = render.warp_planes(planes, poses, dep,
+                                jnp.broadcast_to(k[None], (vn, 3, 3)),
+                                convention=convention)     # [P/np,V/nv,H,W,4]
+    return _fold_plane_shard(warped, plane_axis, n_p)      # [V/nv, H, W, 3]
+
+  # check_vma=False: as in over_composite_planes_sharded, the post-gather
+  # fold replicates over the plane axis in value but not in inferred vma.
+  fn = shard_map(
+      local, mesh=mesh,
+      in_specs=(P(None, None, plane_axis), P(view_axis), P(),
+                P(plane_axis)),
+      out_specs=P(view_axis), check_vma=False)
+  return fn(rgba_layers, tgt_poses, intrinsics, depths)

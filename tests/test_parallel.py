@@ -85,3 +85,35 @@ def test_sharded_render_under_jit(rng, scene):
   got = fn(mpi, poses)
   want = pmesh.render_views_sharded(mpi, poses, depths, k, m)
   np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-6)
+
+
+class TestViewsPlanesSharded:
+  """2-D mesh: views DP-sharded x planes sequence-parallel-sharded."""
+
+  def test_matches_single_device(self, rng, scene):
+    mpi, depths, k = scene
+    m = pmesh.make_mesh(("data", "planes"), shape=(2, 4))
+    poses = jnp.asarray(np.stack([_pose(0.01 * i) for i in range(4)]))
+    got = pmesh.render_views_planes_sharded(mpi, poses, depths, k, m)
+    b = poses.shape[0]
+    want = render.render_mpi(
+        jnp.broadcast_to(mpi[None], (b,) + mpi.shape), poses, depths,
+        jnp.broadcast_to(k[None], (b, 3, 3)))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+  def test_swapped_mesh_shape(self, rng, scene):
+    mpi, depths, k = scene
+    m = pmesh.make_mesh(("data", "planes"), shape=(4, 2))
+    poses = jnp.asarray(np.stack([_pose(0.02 * i) for i in range(8)]))
+    got = pmesh.render_views_planes_sharded(mpi, poses, depths, k, m)
+    want = render.render_mpi(
+        jnp.broadcast_to(mpi[None], (8,) + mpi.shape), poses, depths,
+        jnp.broadcast_to(k[None], (8, 3, 3)))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+  def test_rejects_indivisible(self, scene):
+    mpi, depths, k = scene
+    m = pmesh.make_mesh(("data", "planes"), shape=(2, 4))
+    with pytest.raises(ValueError, match="not divisible"):
+      pmesh.render_views_planes_sharded(
+          mpi, jnp.zeros((3, 4, 4)), depths, k, m)

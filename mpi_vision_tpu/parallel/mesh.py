@@ -73,11 +73,14 @@ def render_views_sharded(
     tgt_poses: ``[V, 4, 4]`` source-cam -> target-cam transforms.
     depths: ``[P]`` descending plane depths.
     intrinsics: ``[3, 3]`` shared camera intrinsics.
-    **render_kwargs: forwarded to ``core.render.render_mpi`` — for
-      ``method='fused_pallas'`` inside shard_map the poses are tracers, so
-      pass ``check=False`` with explicit ``separable`` (and optionally
-      ``plan`` from an eager ``_plan_shared`` on the concrete pose set);
-      see ``kernels.render_pallas.render_mpi_fused``.
+    **render_kwargs: forwarded to ``core.render.render_mpi``. For
+      ``method='fused_pallas'`` the poses are tracers inside shard_map, so
+      kernel plans must come from OUTSIDE: with concrete ``tgt_poses`` and
+      no explicit plan this function plans the whole pose set eagerly
+      (``kernels.render_pallas.plan_fused``) and forwards the bundle
+      (check=False + separable/plan/adj_plan); a pose set outside the
+      kernel envelope raises (pass an XLA ``method`` for those). Traced
+      pose batches keep requiring the caller's explicit plan.
 
   Returns:
     ``[V, H, W, 3]`` rendered views, sharded over ``axis``.
@@ -86,6 +89,33 @@ def render_views_sharded(
   v = tgt_poses.shape[0]
   if v % n:
     raise ValueError(f"view count {v} not divisible by mesh axis {axis}={n}")
+
+  # Auto-plan only when the caller supplied NO fused-kernel knobs (an
+  # explicit adj_plan=None — the keep-the-XLA-backward escape hatch — or
+  # separable/check must never be silently overridden).
+  if (method == "fused_pallas"
+      and not {"plan", "adj_plan", "separable", "check"} & set(render_kwargs)):
+    from mpi_vision_tpu.kernels import render_pallas
+
+    h, w = rgba_layers.shape[0], rgba_layers.shape[1]
+    homs = render_pallas.pixel_homographies(
+        jnp.asarray(tgt_poses), jnp.asarray(depths),
+        jnp.broadcast_to(jnp.asarray(intrinsics)[None],
+                         (v, 3, 3)), h, w, convention)      # [P, V, 3, 3]
+    if isinstance(homs, jax.core.Tracer):
+      # Poses/depths/intrinsics traced: plans must come from the caller.
+      raise ValueError(
+          "render_views_sharded(method='fused_pallas') under jit needs an "
+          "explicit plan_fused bundle (check=False + separable/plan/"
+          "adj_plan) — traced inputs cannot be planned here")
+    bundle = render_pallas.plan_fused(jnp.moveaxis(homs, 1, 0), h, w)
+    if bundle is None:
+      raise ValueError(
+          "pose set outside the fused-kernel envelope; use an XLA method "
+          "(method='fused'|'scan') for this batch")
+    render_kwargs = dict(render_kwargs, check=False,
+                         separable=bundle["separable"],
+                         plan=bundle["plan"], adj_plan=bundle["adj_plan"])
 
   def local_render(mpi, poses, k):
     # mpi [1, H, W, P, 4] (replicated), poses [V/n, 4, 4].

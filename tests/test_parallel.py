@@ -117,3 +117,36 @@ class TestViewsPlanesSharded:
     with pytest.raises(ValueError, match="not divisible"):
       pmesh.render_views_planes_sharded(
           mpi, jnp.zeros((3, 4, 4)), depths, k, m)
+
+
+class TestSharedFusedAutoPlan:
+  """render_views_sharded(method='fused_pallas') plans concrete pose sets
+  itself — no caller-side plan boilerplate."""
+
+  def test_auto_planned_fused_matches_xla(self, rng, scene):
+    mpi, depths, k = scene
+    m = pmesh.make_mesh()
+    # Mixed separable + small-pan poses: forces the general kernel plan.
+    poses = []
+    for i in range(8):
+      pose = np.eye(4, dtype=np.float32)
+      ang = np.radians(0.3) * np.sin(2 * np.pi * i / 8)
+      c, s = np.cos(ang), np.sin(ang)
+      pose[:3, :3] = [[c, 0, s], [0, 1, 0], [-s, 0, c]]
+      pose[0, 3] = 0.02 * i
+      poses.append(pose)
+    poses = jnp.asarray(np.stack(poses))
+    got = pmesh.render_views_sharded(mpi, poses, depths, k, m,
+                                     method="fused_pallas")
+    want = pmesh.render_views_sharded(mpi, poses, depths, k, m)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-3)
+
+  def test_out_of_envelope_raises(self, rng, scene):
+    mpi, depths, k = scene
+    m = pmesh.make_mesh()
+    wild = np.eye(4, dtype=np.float32)
+    wild[:3, :3] = np.array([[0, -1, 0], [1, 0, 0], [0, 0, 1]], np.float32)
+    poses = jnp.asarray(np.stack([wild] * 8))
+    with pytest.raises(ValueError, match="outside the fused-kernel"):
+      pmesh.render_views_sharded(mpi, poses, depths, k, m,
+                                 method="fused_pallas")

@@ -4,9 +4,12 @@ JAX/XLA/Pallas re-design of the capabilities of Findeton/mpi-vision (a torch
 port of Google's Stereo Magnification): differentiable MPI rendering via
 plane-induced homographies and plane-sweep cost volumes, with the
 stereo-magnification U-Net + VGG-perceptual training pipeline, data loading,
-mesh-parallel batched rendering, and DeepView HTML viewer export built on top
-(see the ``models``, ``train``, ``data``, ``parallel`` and ``viewer``
-subpackages as they land; current public surface below).
+mesh-parallel batched rendering, and DeepView HTML viewer export built on
+top. Subpackages: ``kernels`` (fused Pallas render, forward and backward),
+``models``, ``train``, ``data``, ``parallel``, ``viewer``, ``torchref`` (the
+CPU-torch parity oracle), and ``compat`` (the reference's star-import
+surface under original names with ``backend={'jax','torch'}``). The core
+function surface is re-exported below.
 """
 
 from mpi_vision_tpu.core.camera import (

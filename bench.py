@@ -75,7 +75,15 @@ def _fps(fn, *args, iters: int = 30) -> float:
 
 
 def main() -> None:
-  dev = jax.devices()[0]
+  try:
+    dev = jax.devices()[0]
+  except RuntimeError as e:
+    # Honest hard failure (rc=1), but legible: the axon tunnel being down
+    # is an infra condition, not a code path — say so in one line. See
+    # artifacts/tpu_session_notes_r03.md for the outage record and
+    # bench/tpu_watch.sh for the auto-retry.
+    first = (str(e).splitlines() or ["<no message>"])[0]
+    raise SystemExit(f"bench: no usable device — TPU tunnel down? ({first})")
   print(f"bench: backend={jax.default_backend()} device={dev.device_kind}",
         file=sys.stderr)
   planes, homs, homs_rot, pose, depths, intrinsics = _make_inputs()

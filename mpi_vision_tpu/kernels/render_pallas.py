@@ -1339,13 +1339,6 @@ def _render_mpi_fused_batch(planes, homs, np_homs, separable, check, plan,
         "plan=None: the planner rejected this pose set (outside the kernel "
         "envelope) — rendering with any kernel variant would drop taps. "
         "Use an XLA method or the check=True fallback.")
-  if separable:
-    if check and not is_separable(np_homs):
-      raise ValueError(
-          "separable=True but the homographies are not separable "
-          "(is_separable(homs) is False); the separable kernel would "
-          "silently render wrong pixels. Pass separable=False (the "
-          "shared-gather general kernel) or fix the pose.")
   # Default adjoint plan when the caller passed none: fully eager calls
   # defer planning to VJP time (LAZY_ADJ — forward-only rendering, the FPS
   # path, must not pay per-call adjoint planning), but a call whose poses

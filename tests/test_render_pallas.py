@@ -295,6 +295,17 @@ class TestSharedKernel:
     np.testing.assert_allclose(
         np.asarray(g_shared), np.asarray(g_ref), atol=1e-4, rtol=0)
 
+  def test_separable_flag_on_nonseparable_pose_raises(self, rng):
+    """separable=True with a rotating pose must raise, not render the
+    wrong pixels through the row-independent kernel."""
+    p, h, w = 2, 24, 256
+    planes = _mpi(rng, p, h, w)
+    depths = inv_depths(1.0, 100.0, p)
+    homs = rp.pixel_homographies(
+        _pose(**ROTATION), depths, _intrinsics(h, w), h, w)[:, 0]
+    with pytest.raises(ValueError, match="not separable"):
+      rp.render_mpi_fused(planes, homs, separable=True)
+
   def test_traced_checked_call_raises(self, rng):
     """Under jit no envelope check can run: check=True must raise, never
     silently render unchecked taps (the round-2 silent-wrong-pixels bug)."""

@@ -67,6 +67,35 @@ def cmd_train(args: argparse.Namespace) -> dict:
       compute_dtype="bfloat16" if args.bf16 else None)
   dataset = cfg.data.make_dataset(rng=np.random.default_rng(args.seed))
   state = cfg.make_train_state(jax.random.PRNGKey(args.seed))
+
+  lr_found = None
+  if args.lr_find:
+    import itertools
+
+    # Sweep the SAME loss surface training will use (VGG vs L2, resize),
+    # on at most num_steps batches (the sweep cycles them).
+    sweep_vgg = None
+    if args.vgg_loss:
+      from mpi_vision_tpu.train import vgg as vgg_lib
+
+      sweep_vgg = vgg_lib.default_params()
+    sweep_batches = list(itertools.islice(
+        realestate.iterate_batches(
+            dataset, batch_size=cfg.data.batch_size,
+            rng=np.random.default_rng(args.seed + 2)),
+        args.lr_find_steps))
+    found = train_loop.lr_find(state, sweep_batches, vgg_params=sweep_vgg,
+                               resize=cfg.vgg_resize,
+                               num_steps=args.lr_find_steps)
+    lr_found = found["suggestion"]
+    _log(f"lr_find: suggestion {lr_found:.2e} over {len(found['lrs'])} "
+         f"steps (smoothed loss {found['smoothed'][0]:.4f} -> "
+         f"{min(found['smoothed']):.4f})")
+    import dataclasses
+
+    cfg = dataclasses.replace(cfg, learning_rate=lr_found)
+    state = cfg.make_train_state(jax.random.PRNGKey(args.seed))
+
   step = cfg.make_train_step("default" if args.vgg_loss else None,
                              planned=args.planned_render)
 
@@ -108,6 +137,7 @@ def cmd_train(args: argparse.Namespace) -> dict:
 
   return {
       "command": "train",
+      **({"lr_found": lr_found} if lr_found is not None else {}),
       "epochs": cfg.epochs,
       "steps": len(all_losses),
       "first_loss": round(all_losses[0], 5),
@@ -148,6 +178,11 @@ def build_parser() -> argparse.ArgumentParser:
   t.add_argument("--num-planes", type=int, default=10)   # cell 8:90
   t.add_argument("--epochs", type=int, default=20)       # cell 16
   t.add_argument("--lr", type=float, default=2e-4)       # cell 15
+  t.add_argument("--lr-find", action="store_true",
+                 help="run the exponential LR sweep first (cell 14) and "
+                      "train at its suggestion instead of --lr")
+  t.add_argument("--lr-find-steps", type=int, default=60,
+                 help="max sweep steps for --lr-find")
   t.add_argument("--vgg-loss", action=argparse.BooleanOptionalAction,
                  default=True, help="VGG-perceptual loss (reference) or L2")
   t.add_argument("--vgg-resize", type=int, default=224,

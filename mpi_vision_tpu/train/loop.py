@@ -269,6 +269,81 @@ def shard_train_step_planned(mesh: Mesh, vgg_params: Any | None = None,
   return step
 
 
+def lr_find(state: TrainState, batches,
+            vgg_params: Any | None = None,
+            resize: int | None = 224,
+            lr_start: float = 1e-7,
+            lr_end: float = 10.0,
+            num_steps: int = 100,
+            divergence_factor: float = 4.0,
+            beta: float = 0.98) -> dict:
+  """Exponential learning-rate sweep (the notebook's ``learn.lr_find()``,
+  cell 14; cell 15 picks 2e-4 off the resulting curve).
+
+  Runs up to ``num_steps`` Adam updates from the given state, stepping the
+  learning rate geometrically from ``lr_start`` to ``lr_end`` and recording
+  the loss, stopping early once the smoothed loss exceeds
+  ``divergence_factor`` x the best seen (divergence). The sweep trains on
+  throwaway copies — ``state`` is not modified.
+
+  The learning rate is a traced argument via ``optax.inject_hyperparams``,
+  so the whole sweep compiles ONE step program (no per-lr recompiles; the
+  per-step host sync is inherent — early stopping needs the loss value).
+
+  Returns ``{"lrs", "losses", "smoothed", "suggestion"}`` where
+  ``suggestion`` is the lr at the steepest descent of the smoothed curve
+  (fastai's default heuristic), clipped away from the divergence tail.
+  """
+  loss_fn = make_loss_fn(vgg_params, resize)
+  tx = optax.inject_hyperparams(optax.adam)(learning_rate=lr_start)
+  opt_state = tx.init(state.params)
+
+  @jax.jit
+  def sweep_step(params, opt_state, batch, lr):
+    opt_state.hyperparams["learning_rate"] = lr
+    loss, grads = jax.value_and_grad(loss_fn)(
+        params, state.apply_fn, batch)
+    updates, opt_state = tx.update(grads, opt_state, params)
+    return optax.apply_updates(params, updates), opt_state, loss
+
+  import numpy as np
+
+  lrs = np.geomspace(lr_start, lr_end, num_steps)
+  params = state.params
+  batch_list = list(batches) if not hasattr(batches, "__getitem__") else batches
+  if not len(batch_list):
+    raise ValueError("lr_find needs at least one batch")
+  losses, smoothed, used = [], [], []
+  avg, best = 0.0, float("inf")
+  for i, lr in enumerate(lrs):
+    batch = batch_list[i % len(batch_list)]
+    params, opt_state, loss = sweep_step(
+        params, opt_state, batch, jnp.float32(lr))
+    loss = float(loss)
+    if not np.isfinite(loss):
+      break
+    avg = beta * avg + (1 - beta) * loss
+    smooth = avg / (1 - beta ** (i + 1))           # bias-corrected EMA
+    losses.append(loss)
+    smoothed.append(smooth)
+    used.append(float(lr))
+    best = min(best, smooth)
+    if smooth > divergence_factor * best:
+      break
+  if len(used) < 2:
+    raise ValueError(
+        "lr_find diverged immediately: loss became non-finite at "
+        f"lr={lrs[len(losses)]:.2e}; lower lr_start")
+  # Steepest descent of the smoothed curve over log(lr), ignoring the
+  # final climb into divergence (last ~10% of recorded points).
+  tail = max(2, int(len(used) * 0.9))
+  slopes = np.gradient(np.asarray(smoothed[:tail]),
+                       np.log(np.asarray(used[:tail])))
+  suggestion = float(used[int(np.argmin(slopes))])
+  return {"lrs": used, "losses": losses, "smoothed": smoothed,
+          "suggestion": suggestion}
+
+
 def fit(state: TrainState, batches, step=None, log_every: int = 0):
   """Minimal epoch driver over an iterable of batches; returns final state
   and the list of per-step losses.

@@ -36,6 +36,20 @@ def test_train_synthetic_vgg_loss(capsys):
   assert out["steps"] == 2 and np.isfinite(out["final_loss"])
 
 
+def test_train_lr_find(capsys):
+  rc = cli.main([
+      "train", "--synthetic", "--synthetic-scenes", "2",
+      "--img-size", "32", "--num-planes", "4", "--epochs", "1",
+      "--no-vgg-loss", "--lr-find", "--lr-find-steps", "12",
+  ])
+  assert rc == 0
+  captured = capsys.readouterr()
+  out = json.loads(captured.out.strip().splitlines()[-1])
+  assert out["steps"] == 2 and np.isfinite(out["final_loss"])
+  assert 0 < out["lr_found"] <= 10.0
+  assert "lr_find: suggestion" in captured.err
+
+
 def test_export_viewer_fixture(tmp_path, capsys):
   fixtures = os.path.join(os.path.dirname(__file__), "fixtures", "scene_009")
   rc = cli.main([

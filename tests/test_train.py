@@ -184,6 +184,23 @@ class TestTrainLoop:
       losses.append(float(metrics["loss"]))
     assert losses[-1] < losses[0], losses
 
+  def test_lr_find_sweeps_and_suggests(self, rng):
+    state = tloop.create_train_state(
+        jax.random.PRNGKey(0), num_planes=4, image_size=(32, 32),
+        learning_rate=1e-3, norm=None)
+    found = tloop.lr_find(state, [_batch(rng)], num_steps=40,
+                          lr_start=1e-6, lr_end=10.0)
+    assert len(found["lrs"]) == len(found["losses"]) == len(found["smoothed"])
+    assert len(found["lrs"]) >= 2
+    # Geometric schedule, monotone increasing lrs within [start, end].
+    lrs = np.asarray(found["lrs"])
+    assert np.all(np.diff(lrs) > 0) and lrs[0] >= 1e-6 and lrs[-1] <= 10.0
+    # The suggestion is one of the swept lrs, away from the divergent tail.
+    assert found["suggestion"] in found["lrs"]
+    assert found["suggestion"] < lrs[-1]
+    # The sweep must not mutate the input state.
+    assert int(state.step) == 0
+
   def test_checkpoint_roundtrip(self, rng, tmp_path):
     state = tloop.create_train_state(
         jax.random.PRNGKey(0), num_planes=4, image_size=(32, 32), norm=None)

@@ -84,9 +84,10 @@ def cmd_train(args: argparse.Namespace) -> dict:
             dataset, batch_size=cfg.data.batch_size,
             rng=np.random.default_rng(args.seed + 2)),
         args.lr_find_steps))
-    found = train_loop.lr_find(state, sweep_batches, vgg_params=sweep_vgg,
-                               resize=cfg.vgg_resize,
-                               num_steps=args.lr_find_steps)
+    found = train_loop.lr_find(
+        state, sweep_batches, vgg_params=sweep_vgg, resize=cfg.vgg_resize,
+        num_steps=args.lr_find_steps,
+        vgg_dtype=jnp.dtype(cfg.compute_dtype) if cfg.compute_dtype else None)
     lr_found = found["suggestion"]
     _log(f"lr_find: suggestion {lr_found:.2e} over {len(found['lrs'])} "
          f"steps (smoothed loss {found['smoothed'][0]:.4f} -> "
@@ -96,8 +97,13 @@ def cmd_train(args: argparse.Namespace) -> dict:
     cfg = dataclasses.replace(cfg, learning_rate=lr_found)
     state = cfg.make_train_state(jax.random.PRNGKey(args.seed))
 
-  step = cfg.make_train_step("default" if args.vgg_loss else None,
-                             planned=args.planned_render)
+  if args.lr_find and args.vgg_loss:
+    # Reuse the sweep's resolved VGG params (default_params() can load an
+    # orbax checkpoint from disk — don't do that twice).
+    step = cfg.make_train_step(sweep_vgg, planned=args.planned_render)
+  else:
+    step = cfg.make_train_step("default" if args.vgg_loss else None,
+                               planned=args.planned_render)
 
   order = np.random.default_rng(args.seed + 1)
   t0 = time.time()

@@ -276,7 +276,8 @@ def lr_find(state: TrainState, batches,
             lr_end: float = 10.0,
             num_steps: int = 100,
             divergence_factor: float = 4.0,
-            beta: float = 0.98) -> dict:
+            beta: float = 0.98,
+            vgg_dtype: Any = None) -> dict:
   """Exponential learning-rate sweep (the notebook's ``learn.lr_find()``,
   cell 14; cell 15 picks 2e-4 off the resulting curve).
 
@@ -294,7 +295,9 @@ def lr_find(state: TrainState, batches,
   ``suggestion`` is the lr at the steepest descent of the smoothed curve
   (fastai's default heuristic), clipped away from the divergence tail.
   """
-  loss_fn = make_loss_fn(vgg_params, resize)
+  if num_steps < 2:
+    raise ValueError(f"lr_find needs num_steps >= 2, got {num_steps}")
+  loss_fn = make_loss_fn(vgg_params, resize, vgg_dtype=vgg_dtype)
   tx = optax.inject_hyperparams(optax.adam)(learning_rate=lr_start)
   opt_state = tx.init(state.params)
 

@@ -2,7 +2,8 @@
 
 Prints ONE JSON line on stdout (diagnostics go to stderr) with fields
 {"metric", "value", "unit", "vs_baseline", "separable_fps", "rotation_fps",
-"xla_fps"}. ``value`` is the WORST of the two real novel-view cases —
+"rot10_fps", "xla_fps"}. ``value`` is the WORST of the two real novel-view
+cases —
 separable (truck + dolly) and rotation (1-degree pan, the tiled general
 kernel) — because the renderer must treat arbitrary poses uniformly, as the
 reference does (utils.py:267-294). ``vs_baseline`` is that value relative to
@@ -60,8 +61,18 @@ def _make_inputs():
   homs_rot = render_pallas.pixel_homographies(
       jnp.asarray(rot)[None], depths, jnp.asarray(intrinsics)[None],
       HEIGHT, WIDTH)[:, 0]
-  return (planes, homs, homs_rot, jnp.asarray(pose)[None], depths,
-          jnp.asarray(intrinsics)[None])
+  # A 10-degree pan: far outside the shared kernel's envelope — the banded
+  # per-row middle tier's case (the reference renders it through the same
+  # grid_sample path as any other pose, utils.py:104-134).
+  rot10 = np.eye(4, dtype=np.float32)
+  c10, s10 = np.cos(np.radians(10.0)), np.sin(np.radians(10.0))
+  rot10[:3, :3] = [[c10, 0, s10], [0, 1, 0], [-s10, 0, c10]]
+  rot10[0, 3] = 0.05
+  homs_rot10 = render_pallas.pixel_homographies(
+      jnp.asarray(rot10)[None], depths, jnp.asarray(intrinsics)[None],
+      HEIGHT, WIDTH)[:, 0]
+  return (planes, homs, homs_rot, homs_rot10, jnp.asarray(pose)[None],
+          depths, jnp.asarray(intrinsics)[None])
 
 
 def _fps(fn, *args, iters: int = 30) -> float:
@@ -86,7 +97,8 @@ def main() -> None:
     raise SystemExit(f"bench: no usable device — TPU tunnel down? ({first})")
   print(f"bench: backend={jax.default_backend()} device={dev.device_kind}",
         file=sys.stderr)
-  planes, homs, homs_rot, pose, depths, intrinsics = _make_inputs()
+  planes, homs, homs_rot, homs_rot10, pose, depths, intrinsics = (
+      _make_inputs())
   results = {}
 
   # Guards so neither field can mislabel which kernel ran: the truck+dolly
@@ -117,6 +129,23 @@ def main() -> None:
   except Exception as e:  # pragma: no cover
     print(f"bench: rotation failed: {e}", file=sys.stderr)
 
+  # 10-degree pan: must land in the banded middle tier (shared plan None,
+  # banded plan present) — else this field would mislabel whichever path
+  # actually ran. Side metric, not part of the worst-of headline (the
+  # banded tier trades throughput for envelope by design).
+  if render_pallas._plan_shared(homs_rot10, HEIGHT, WIDTH) is not None:
+    raise SystemExit("10-degree pose unexpectedly inside the shared plan")
+  if render_pallas._plan_banded(homs_rot10, HEIGHT, WIDTH) is None:
+    raise SystemExit("10-degree pose fell out of the banded-tier envelope")
+  try:
+    results["rot10"] = _fps(
+        lambda p, h: render_pallas.render_mpi_fused(p, h, separable=False),
+        planes, homs_rot10, iters=10)
+    print(f"bench: rotation10(banded) fps={results['rot10']:.2f}",
+          file=sys.stderr)
+  except Exception as e:  # pragma: no cover
+    print(f"bench: rotation10 failed: {e}", file=sys.stderr)
+
   try:
     nhwc = jnp.moveaxis(planes, 1, -1)[:, None]  # [P, 1, H, W, 4]
     fn = jax.jit(lambda pl_, po, d, k: render_mpi(
@@ -143,6 +172,7 @@ def main() -> None:
       "vs_baseline": round(value / TARGET_FPS, 3),
       "separable_fps": rnd("separable"),
       "rotation_fps": rnd("rotation"),
+      "rot10_fps": rnd("rot10"),
       "xla_fps": rnd("xla_fused"),
   }))
 

@@ -8,7 +8,7 @@ the TPU-native redesign that makes the 30 FPS target reachable: the whole
 render is ONE kernel with no warped-plane stack, no XLA gather, and HBM
 traffic within ~2x of the theoretical minimum (read each plane once).
 
-Two kernels share the architecture (strip of 8 output rows per grid step,
+Three kernels share the architecture (strip of 8 output rows per grid step,
 planes innermost, double-buffered source-band DMA, running composite in a
 VMEM accumulator, farthest plane's alpha ignored per utils.py:152-153):
 
@@ -24,6 +24,14 @@ VMEM accumulator, farthest plane's alpha ignored per utils.py:152-153):
     taps are selected per pixel with single-vreg sublane gathers. All
     data-dependent scalars come from SMEM tables computed vectorized (in
     the same jit) from cell-corner homography evaluations.
+  - ``_banded_kernel``: the per-row middle tier for rotations past the
+    shared envelope (~1 degree at 1080p). Per-ROW gather windows and band
+    slices with pose-adaptive tile geometry (``_banded_family``) hold to
+    ~10+ degrees; ~8x the shared kernel's gather traffic, still ~an order
+    of magnitude above the XLA gather fallback. Dispatch chains
+    shared -> banded -> XLA so cost degrades gradually with pose, where
+    the reference's one-size grid_sample path (utils.py:104-134) is
+    pose-independent.
 
 The bilinear x-taps come from ``tpu.dynamic_gather`` (the HW lane gather,
 ~750 G elem/s measured); the gather window is one 128-lane vreg, so taps
@@ -600,6 +608,29 @@ def _shared_tables(homs: jnp.ndarray, height: int, width: int,
   return meta, wq
 
 
+def _next_step_index(batch: int, n_strips: int, n_tiles: int,
+                     num_planes: int):
+  """Index map for the NEXT (b, s, t, p) grid step (p innermost), clamped
+  at the final step — the double-buffer prefetch's subtle core, shared by
+  every tiled kernel (shared-gather forward, banded tier, and the backward
+  kernels via ``_shared_grid_setup``) so the prefetch logic cannot fork.
+  Returns ``(b, s, t, p) -> (b_n, s_n, t_n, 0, 0)``.
+  """
+
+  def next_index(b, s, t, p):
+    same_tile = p + 1 < num_planes
+    t_n = jnp.where(same_tile, t, jnp.where(t + 1 < n_tiles, t + 1, 0))
+    s_roll = jnp.where(t + 1 < n_tiles, s,
+                       jnp.where(s + 1 < n_strips, s + 1, 0))
+    s_n = jnp.where(same_tile, s, s_roll)
+    last_tile = (t + 1 >= n_tiles) & (s + 1 >= n_strips)
+    b_n = jnp.minimum(
+        jnp.where(same_tile | ~last_tile, b, b + 1), batch - 1)
+    return b_n, s_n, t_n, 0, 0
+
+  return next_index
+
+
 def _shared_grid_setup(planes: jnp.ndarray, homs: jnp.ndarray,
                        n_windows: int, mins_fn=None):
   """Everything a shared-gather-style pallas_call needs besides its kernel
@@ -627,18 +658,7 @@ def _shared_grid_setup(planes: jnp.ndarray, homs: jnp.ndarray,
           mins=None if mins_fn is None else mins_fn(h))
   )(homs32)                          # [B, S, T, 2, P], [B, S, T, P, 2c]
 
-  def next_index(b, s, t, p):
-    # The (b, s, t, p) grid steps with p innermost; clamp at the final step.
-    same_tile = p + 1 < num_planes
-    t_n = jnp.where(same_tile, t, jnp.where(t + 1 < n_tiles, t + 1, 0))
-    s_roll = jnp.where(t + 1 < n_tiles, s,
-                       jnp.where(s + 1 < n_strips, s + 1, 0))
-    s_n = jnp.where(same_tile, s, s_roll)
-    last_tile = (t + 1 >= n_tiles) & (s + 1 >= n_strips)
-    b_n = jnp.minimum(
-        jnp.where(same_tile | ~last_tile, b, b + 1), batch - 1)
-    return b_n, s_n, t_n, 0, 0
-
+  next_index = _next_step_index(batch, n_strips, n_tiles, num_planes)
   grid = (batch, n_strips, n_tiles, num_planes)
   in_specs = [
       pl.BlockSpec(memory_space=pltpu.SMEM),   # [B, P, 9] homographies
@@ -687,6 +707,273 @@ def _shared_call(planes: jnp.ndarray, homs: jnp.ndarray,
   )(*operands)
 
 
+# --- Banded per-row middle tier (large rotations) -----------------------
+# The shared-gather kernel's strip-shared tap fan caps out when a strip's
+# rows stop sharing x-taps (fan > 3 columns) or a chunk's vertical taps
+# leave the 24-row shared slice — roughly a degree of rotation at 1080p.
+# The reference renders ANY pose through one uniform grid_sample path
+# (utils.py:267-294, utils.py:104-134) with pose-independent cost; without
+# a middle tier, poses past the shared envelope fall ~45x to the XLA
+# gather path. This tier trades gather sharing for generality: per-ROW
+# gather windows and band slices (each output row picks its own), with
+# tile geometry chosen per pose from a static family — taller bands,
+# taller row slices, and narrower tiles buy rotation envelope at the cost
+# of DMA read amplification. ~10-12 degrees of roll at 1080p fits the
+# (128-wide tile, 64-row band, 32-row slice) member; the planner picks
+# the cheapest covering member, so small rotations that just miss the
+# shared envelope pay near-shared-tier DMA cost, not worst-case.
+
+_BANDED_LEVELS = ((32, 16), (48, 24), (64, 32))   # (bandg, slice_rows)
+
+
+def _banded_family(height: int, width: int):
+  """Static (tw, bandg, slice_rows, tsrc, n_eff) configs, cheapest first.
+
+  Cost ranks by DMA bytes per output pixel (bandg*tsrc / (STRIP*tw));
+  coverage is verified exactly per config by ``_plan_banded``, so the
+  ranking only decides preference among covering configs. ``tw`` must
+  divide the (tile-padded) width; W % 128 == 0 guarantees at least the
+  CHUNK-wide member.
+  """
+  cfgs = []
+  for tw in (t for t in (G_TILE_W, 256, CHUNK) if width % t == 0):
+    for bandg, slc in _BANDED_LEVELS:
+      bg = min(bandg, height // 8 * 8)
+      sl = min(slc, bg)
+      for n_win in (2, 3):
+        tsrc = min(width, tw + WIN * (n_win + 1))
+        n_eff = min(n_win, tsrc // WIN)
+        cfgs.append((tw, bg, sl, tsrc, n_eff))
+  seen, out = set(), []
+  for c in sorted(cfgs, key=lambda c: (c[1] * c[3]) / (STRIP * c[0])):
+    if c not in seen:
+      seen.add(c)
+      out.append(c)
+  return out
+
+
+def _banded_tables(homs: jnp.ndarray, height: int, width: int, tw: int,
+                   tsrc: int, bandg: int, slice_rows: int, n_eff: int):
+  """Device-side per-tile / per-ROW scalar tables for the banded kernel.
+
+  Same shape discipline as ``_shared_tables`` but the window base ``w0``
+  and band-slice offset ``q0`` are per (row, chunk) — computed from
+  chunk-boundary homography evaluations per row, exact extrema bounds for
+  one-signed denominators (monotone in x at a fixed row; the boundary at
+  ``(ci+1)*CHUNK`` over-reaches the chunk's last pixel by one column,
+  which only widens the bound — conservative). Returns ``meta
+  [S, T, 2, P]`` and ``wq [S, T, P, STRIP, 2*c_t]``, int32, aligned for
+  direct use as DMA/slice offsets. ``_plan_banded`` mirrors this math on
+  the host for the envelope decision.
+  """
+  p = homs.shape[0]
+  h9 = homs.reshape(p, 3, 3).astype(jnp.float32)
+  c_t = tw // CHUNK
+  n_chunks = width // CHUNK
+  n_strips = height // STRIP
+  n_tiles = width // tw
+  _, _, umin_tile, vmin_tile = _corner_mins(h9, height, width, tw)
+  ymin = jnp.clip(jnp.floor(vmin_tile).astype(jnp.int32) - 1, 0,
+                  height - bandg) // 8 * 8                   # [P, S, T]
+  xmin = jnp.clip(jnp.floor(umin_tile).astype(jnp.int32), 0,
+                  width - tsrc) // WIN * WIN
+
+  rows = jnp.arange(height, dtype=jnp.float32)
+  oxb = jnp.arange(n_chunks + 1, dtype=jnp.float32) * CHUNK
+  u_b, v_b = _uv_vec(h9, oxb[None, None, :], rows[None, :, None])  # [P,H,C+1]
+  x_lo = jnp.floor(
+      jnp.minimum(u_b[..., :-1], u_b[..., 1:])).astype(jnp.int32)
+  v_lo = jnp.minimum(v_b[..., :-1], v_b[..., 1:])            # [P, H, C]
+  tile_of_chunk = jnp.arange(n_chunks) // c_t
+  ymin_rc = jnp.repeat(ymin, STRIP, axis=1)[:, :, tile_of_chunk]
+  xmin_rc = jnp.repeat(xmin, STRIP, axis=1)[:, :, tile_of_chunk]
+  w0 = jnp.clip((x_lo - xmin_rc) // WIN * WIN, 0, tsrc - n_eff * WIN)
+  q0 = jnp.clip((jnp.floor(v_lo).astype(jnp.int32) - ymin_rc) // 8 * 8,
+                0, bandg - slice_rows)
+  meta = jnp.stack([ymin, xmin], axis=-1).transpose(1, 2, 3, 0)  # [S,T,2,P]
+  wq = (jnp.stack([w0, q0], axis=-1)                             # [P,H,C,2]
+        .reshape(p, n_strips, STRIP, n_tiles, c_t, 2)
+        .transpose(1, 3, 0, 2, 4, 5)
+        .reshape(n_strips, n_tiles, p, STRIP, c_t * 2))
+  return meta, wq
+
+
+def _banded_kernel(hom_ref, meta_ref, meta_next_ref, wq_ref, planes_ref,
+                   out_ref, band_ref, acc_ref, sems,
+                   *, num_planes, height, width, n_windows, tw, tsrc,
+                   bandg, slice_rows):
+  """Per-row general-homography render on 2-D output tiles (middle tier).
+
+  Structure matches ``_shared_kernel`` (same grid, same double-buffered
+  per-tile band DMA, same SMEM table plumbing) but sampling is per ROW:
+  each of the strip's 8 rows picks its own ``n_windows`` 128-lane gather
+  windows (base ``w0`` from its leftmost tap) and its own ``slice_rows``
+  band slice (offset ``q0``), then the vertical 2-tap lerp is a
+  tent-filter weighted reduction over the slice. No cross-row sharing —
+  ~8x the gather traffic of the shared kernel — but the envelope is set
+  only by per-row-chunk drift against ``slice_rows`` and the tile band,
+  not by a strip-wide tap fan: the family's tall-band members hold to
+  ~10+ degrees of rotation at 1080p where the shared kernel caps out
+  around one degree.
+  """
+  bi = pl.program_id(0)
+  s = pl.program_id(1)
+  t = pl.program_id(2)
+  p = pl.program_id(3)
+  n_s = pl.num_programs(1)
+  n_t = pl.num_programs(2)
+  step = ((bi * n_s + s) * n_t + t) * num_planes + p
+  total = pl.num_programs(0) * n_s * n_t * num_planes
+  slot = jax.lax.rem(step, 2)
+  hom = [hom_ref[bi, p, k] for k in range(9)]
+  c_t = tw // CHUNK
+  ymin = pl.multiple_of(meta_ref[0, 0, 0, 0, p], 8)
+  xmin = pl.multiple_of(meta_ref[0, 0, 0, 1, p], WIN)
+
+  @pl.when(step == 0)
+  def _first_dma():
+    pltpu.make_async_copy(
+        planes_ref.at[bi, p, :, pl.ds(ymin, bandg), pl.ds(xmin, tsrc)],
+        band_ref.at[0], sems.at[0]).start()
+
+  pltpu.make_async_copy(
+      planes_ref.at[bi, p, :, pl.ds(ymin, bandg), pl.ds(xmin, tsrc)],
+      band_ref.at[slot], sems.at[slot]).wait()
+
+  @pl.when(step < total - 1)
+  def _next_dma():
+    same_tile = p + 1 < num_planes
+    p_n = jnp.where(same_tile, p + 1, 0)
+    last_tile = (t + 1 >= n_t) & (s + 1 >= n_s)
+    b_n = jnp.where(same_tile | ~last_tile, bi, bi + 1)
+    ymin_n = pl.multiple_of(meta_next_ref[0, 0, 0, 0, p_n], 8)
+    xmin_n = pl.multiple_of(meta_next_ref[0, 0, 0, 1, p_n], WIN)
+    pltpu.make_async_copy(
+        planes_ref.at[b_n, p_n, :, pl.ds(ymin_n, bandg), pl.ds(xmin_n, tsrc)],
+        band_ref.at[1 - slot], sems.at[1 - slot]).start()
+
+  lane = jax.lax.broadcasted_iota(jnp.int32, (STRIP, tw), 1).astype(jnp.float32)
+  sub = jax.lax.broadcasted_iota(jnp.int32, (STRIP, tw), 0).astype(jnp.float32)
+  u, v = _uv(hom, lane + (t * tw).astype(jnp.float32),
+             sub + (s * STRIP).astype(jnp.float32))          # [STRIP, tw]
+  u = jnp.where(jnp.isfinite(u), u, 0.0)
+  v = jnp.where(jnp.isfinite(v), v, 0.0)
+  x0f = jnp.floor(u)
+  fxs = u - x0f
+  x0s = x0f.astype(jnp.int32)
+  qrow = jax.lax.broadcasted_iota(
+      jnp.int32, (slice_rows, CHUNK), 0).astype(jnp.float32)
+
+  for r in range(STRIP):
+    for ci in range(c_t):
+      w0 = pl.multiple_of(wq_ref[0, 0, 0, p, r, ci * 2], WIN)
+      q0 = pl.multiple_of(wq_ref[0, 0, 0, p, r, ci * 2 + 1], 8)
+
+      sl = slice(ci * CHUNK, (ci + 1) * CHUNK)
+      fx = fxs[r:r + 1, sl]                                  # [1, CHUNK]
+      x0 = x0s[r:r + 1, sl]
+      v_r = v[r:r + 1, sl]
+      valid0 = (x0 >= 0) & (x0 <= width - 1)
+      valid1 = (x0 + 1 >= 0) & (x0 + 1 <= width - 1)
+      xrel = x0 - xmin
+
+      xles = None
+      for wi in range(n_windows):
+        base = pl.multiple_of(w0 + wi * WIN, WIN)
+        rel = xrel - base
+        in0 = (rel >= 0) & (rel < WIN) & valid0
+        in1 = (rel + 1 >= 0) & (rel + 1 < WIN) & valid1
+        a = jnp.where(in0, 1.0 - fx, 0.0)
+        b = jnp.where(in1, fx, 0.0)
+        i0 = jnp.broadcast_to(jnp.clip(rel, 0, WIN - 1),
+                              (slice_rows, CHUNK))
+        i1 = jnp.broadcast_to(jnp.clip(rel + 1, 0, WIN - 1),
+                              (slice_rows, CHUNK))
+        outs = []
+        for c in range(4):
+          win = band_ref[slot, c, pl.ds(q0, slice_rows), pl.ds(base, WIN)]
+          g0 = jnp.take_along_axis(win, i0, axis=1)
+          g1 = jnp.take_along_axis(win, i1, axis=1)
+          outs.append(g0 * a + g1 * b)
+        xles = outs if xles is None else [x + o for x, o in zip(xles, outs)]
+
+      ky = jnp.maximum(
+          0.0, 1.0 - jnp.abs(v_r - (qrow + (ymin + q0).astype(jnp.float32))))
+      pix = [jnp.sum(x * ky, axis=0, keepdims=True) for x in xles]
+      rgb, alpha = pix[:3], pix[3]
+      cols = pl.ds(pl.multiple_of(ci * CHUNK, CHUNK), CHUNK)
+
+      for c in range(3):
+
+        @pl.when(p == 0)
+        def _init(c=c):
+          # Farthest plane: alpha ignored (utils.py:152-153).
+          acc_ref[c, r:r + 1, cols] = rgb[c]
+
+        @pl.when(p > 0)
+        def _fold(c=c):
+          prev = acc_ref[c, r:r + 1, cols]
+          acc_ref[c, r:r + 1, cols] = rgb[c] * alpha + prev * (1.0 - alpha)
+
+  @pl.when(p == num_planes - 1)
+  def _emit():
+    out_ref[0] = acc_ref[:]
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "tw", "bandg", "slice_rows", "tsrc", "n_windows", "interpret"))
+def _banded_call(planes: jnp.ndarray, homs: jnp.ndarray, tw: int, bandg: int,
+                 slice_rows: int, tsrc: int, n_windows: int,
+                 interpret: bool) -> jnp.ndarray:
+  """Banded-tier kernel call on a batch ``[B, P, 4, H, W]`` (one launch)."""
+  batch, num_planes, _, height, width = planes.shape
+  if height % STRIP or width % CHUNK or width % tw:
+    raise ValueError(
+        f"H must be a multiple of {STRIP} and W of {CHUNK} and of tw={tw}; "
+        f"got {height}x{width} (pad the MPI, or use an XLA method)")
+  if height < bandg:
+    raise ValueError(f"H must be >= bandg={bandg}, got {height}")
+  c_t = tw // CHUNK
+  n_strips, n_tiles = height // STRIP, width // tw
+  homs32 = homs.reshape(batch, num_planes, 9).astype(jnp.float32)
+  meta, wq = jax.vmap(
+      lambda h: _banded_tables(h, height, width, tw, tsrc, bandg,
+                               slice_rows, n_windows)
+  )(homs32)                    # [B, S, T, 2, P], [B, S, T, P, STRIP, 2c]
+
+  next_index = _next_step_index(batch, n_strips, n_tiles, num_planes)
+  kernel = functools.partial(
+      _banded_kernel, num_planes=num_planes, height=height, width=width,
+      n_windows=n_windows, tw=tw, tsrc=tsrc, bandg=bandg,
+      slice_rows=slice_rows)
+  return pl.pallas_call(
+      kernel,
+      grid=(batch, n_strips, n_tiles, num_planes),
+      in_specs=[
+          pl.BlockSpec(memory_space=pltpu.SMEM),   # [B, P, 9] homographies
+          pl.BlockSpec((1, 1, 1, 2, num_planes),
+                       lambda b, s, t, p: (b, s, t, 0, 0),
+                       memory_space=pltpu.SMEM),   # meta (this step's tile)
+          pl.BlockSpec((1, 1, 1, 2, num_planes), next_index,
+                       memory_space=pltpu.SMEM),   # meta (next step's tile)
+          pl.BlockSpec((1, 1, 1, num_planes, STRIP, 2 * c_t),
+                       lambda b, s, t, p: (b, s, t, 0, 0, 0),
+                       memory_space=pltpu.SMEM),   # per-row w0/q0
+          pl.BlockSpec(memory_space=pl.ANY),       # [B, P, 4, H, W] (HBM)
+      ],
+      out_specs=pl.BlockSpec(
+          (1, 3, STRIP, tw), lambda b, s, t, p: (b, 0, s, t)),
+      out_shape=jax.ShapeDtypeStruct(
+          (batch, 3, height, width), jnp.float32),
+      scratch_shapes=[
+          pltpu.VMEM((2, 4, bandg, tsrc), jnp.float32),
+          pltpu.VMEM((3, STRIP, tw), jnp.float32),
+          pltpu.SemaphoreType.DMA((2,)),
+      ],
+      interpret=interpret,
+  )(homs32, meta, meta, wq, planes.astype(jnp.float32))
+
+
 def is_separable(homs, atol: float = 1e-6) -> bool:
   """Whether pixel homographies are axis-aligned (fast-path eligible).
 
@@ -709,15 +996,18 @@ def fits_envelope(homs, height: int, width: int,
   from the leftmost tap). Extrema are evaluated at strip/chunk boundaries,
   exact for projective maps whose denominator keeps one sign over the image
   (checked); sign-changing denominators reject. For general homographies,
-  delegates to ``_plan_shared`` (the shared-gather kernel's envelope).
-  ``homs`` must be concrete; leading batch axes flatten into the plane axis
-  ([P, 3, 3] or [B, P, 3, 3]).
+  answers for the full Pallas dispatch chain — the shared-gather kernel OR
+  the banded per-row middle tier (``_plan_shared`` / ``_plan_banded``), the
+  same chain ``render_mpi_fused(check=True)`` walks before falling back to
+  XLA. ``homs`` must be concrete; leading batch axes flatten into the plane
+  axis ([P, 3, 3] or [B, P, 3, 3]).
   """
   auto = separable is None
   if auto:
     separable = is_separable(homs)
   if not separable:
-    return _plan_shared(homs, height, width) is not None
+    return (_plan_shared(homs, height, width) is not None
+            or _plan_banded(homs, height, width) is not None)
   if not auto and not is_separable(homs):
     # A caller-asserted separable flag on non-separable homographies is a
     # contract violation; reject so checked callers fall back safely.
@@ -950,6 +1240,128 @@ def _plan_shared_uncached(homs: np.ndarray, height: int, width: int):
   return None
 
 
+def _plan_banded(homs, height: int, width: int):
+  """Cheapest covering banded-tier config, or None. Memoized (plan_memo).
+
+  The host-side mirror of ``_banded_tables``: walks ``_banded_family`` in
+  DMA-cost order and returns the first ``(tw, bandg, slice_rows, tsrc,
+  n_eff)`` under which every in-image bilinear tap of every output pixel
+  lands inside its tile's ``[bandg, tsrc]`` source rectangle, its row's
+  ``slice_rows`` band slice, and its row-chunk's gather windows. Returns
+  None when no family member covers the pose set (caller falls back to
+  XLA) or a homography denominator changes sign over the image (poles
+  break the edge-monotonicity the extent math relies on). ``homs`` must
+  be concrete; leading batch axes flatten into the plane axis.
+
+  Mirror precision: this runs in f64 while the device tables are f32.
+  Near an integer boundary the two can FLOOR differently, and because the
+  slice/window offsets are quantized (``//8*8``, ``//WIN*WIN``) a
+  divergent floor shifts the whole slice or window by 8 rows / 128
+  columns — which would drop full-weight taps, not just a boundary tap.
+  The planner therefore verifies coverage under BOTH floor resolutions:
+  every floored quantity is evaluated at value−tol and value+tol
+  (tol = 5e-4, comfortably above the f32 evaluation error at 1080p-scale
+  coordinates) and a config is approved only if it covers both. Residual
+  exposure is a tap whose extent estimate itself is off by >tol — not
+  possible for one-signed denominators (the boundary evaluations are
+  exact extrema up to rounding).
+  """
+  a = np.asarray(homs)
+  return plan_memo("banded", a, height, width,
+                   lambda: _plan_banded_uncached(a, height, width))
+
+
+def _plan_banded_uncached(homs: np.ndarray, height: int, width: int):
+  h = np.asarray(homs, np.float64).reshape(-1, 3, 3)
+  p = h.shape[0]
+  cx = np.array([0.0, width - 1.0])
+  cy = np.array([0.0, height - 1.0])
+  d_flat = (h[:, 2, 0, None, None] * cx[None, :, None]
+            + h[:, 2, 1, None, None] * cy[None, None, :]
+            + h[:, 2, 2, None, None]).reshape(p, 4)
+  if not np.isfinite(d_flat).all():
+    return None
+  if not np.all((d_flat > 0).all(1) | (d_flat < 0).all(1)):
+    return None
+
+  def uv(ox, oy):
+    den = (h[:, 2, 0, None, None] * ox + h[:, 2, 1, None, None] * oy
+           + h[:, 2, 2, None, None])
+    u = (h[:, 0, 0, None, None] * ox + h[:, 0, 1, None, None] * oy
+         + h[:, 0, 2, None, None]) / den
+    v = (h[:, 1, 0, None, None] * ox + h[:, 1, 1, None, None] * oy
+         + h[:, 1, 2, None, None]) / den
+    return (np.where(np.isfinite(u), u, 0.0),
+            np.where(np.isfinite(v), v, 0.0))
+
+  # Per-row chunk-boundary extents (config-independent; the boundary at
+  # (ci+1)*CHUNK over-reaches the chunk's last pixel by one column, which
+  # only widens the bound — conservative, and exactly what the tables use).
+  n_chunks = width // CHUNK
+  n_strips = height // STRIP
+  rows = np.arange(height, dtype=np.float64)
+  oxb = np.arange(n_chunks + 1, dtype=np.float64) * CHUNK
+  u_b, v_b = uv(oxb[None, None, :], rows[None, :, None])     # [P, H, C+1]
+  u_lo = np.minimum(u_b[..., :-1], u_b[..., 1:])             # [P, H, C]
+  u_hi = np.maximum(u_b[..., :-1], u_b[..., 1:])
+  v_lo = np.minimum(v_b[..., :-1], v_b[..., 1:])
+  v_hi = np.maximum(v_b[..., :-1], v_b[..., 1:])
+  # A chunk-row is tap-free only when every v is <= -1 or >= H (boundary
+  # taps carry weight) — likewise horizontally.
+  empty_v = (v_hi <= -1) | (v_lo >= height)
+  empty_h = (u_hi <= -1) | (u_lo >= width)
+
+  # The device tables floor f32 values; this mirror floors f64 ones. A
+  # divergent floor shifts a QUANTIZED offset (q0 by 8 rows, w0/xmin by
+  # 128 columns, ymin by 8 rows), so coverage must hold under BOTH
+  # resolutions: each floored quantity is evaluated at value-tol and
+  # value+tol and both passes must cover. The coverage comparisons
+  # themselves run in VALUE space with tol slack (as _plan_shared_stats):
+  # a tap within tol of a slice/window boundary carries <= tol bilinear
+  # weight, so admitting it costs <= tol — half the 1e-3 parity budget.
+  tol = 5e-4
+
+  def covers(cfg, eps):
+    tw, bandg, slc, tsrc, n_eff = cfg
+    c_t = tw // CHUNK
+    n_tiles = width // tw
+    # Tile-corner extents -> per-tile band origins (mirrors _corner_mins).
+    oyc = (np.arange(n_strips, dtype=np.float64)[:, None] * STRIP
+           + np.array([0.0, STRIP - 1.0])).reshape(-1)       # [S*2]
+    oxc = (np.arange(n_tiles, dtype=np.float64)[:, None] * tw
+           + np.array([0.0, tw - 1.0])).reshape(-1)          # [T*2]
+    u_c, v_c = uv(oxc[None, None, :], oyc[None, :, None])    # [P, S*2, T*2]
+    umin_tile = u_c.reshape(p, n_strips, 2, n_tiles, 2).min(axis=(2, 4))
+    vmin_tile = v_c.reshape(p, n_strips, 2, n_tiles, 2).min(axis=(2, 4))
+    ymin = np.clip(np.floor(vmin_tile + eps).astype(np.int64) - 1, 0,
+                   height - bandg) // 8 * 8                  # [P, S, T]
+    xmin = np.clip(np.floor(umin_tile + eps).astype(np.int64), 0,
+                   width - tsrc) // WIN * WIN
+
+    tile_of_chunk = np.arange(n_chunks) // c_t
+    ymin_rc = np.repeat(ymin, STRIP, axis=1)[:, :, tile_of_chunk]
+    xmin_rc = np.repeat(xmin, STRIP, axis=1)[:, :, tile_of_chunk]
+    q0 = np.clip((np.floor(v_lo + eps).astype(np.int64) - ymin_rc)
+                 // 8 * 8, 0, bandg - slc)
+    w0 = np.clip((np.floor(u_lo + eps).astype(np.int64) - xmin_rc)
+                 // WIN * WIN, 0, tsrc - n_eff * WIN)
+    ymq = (ymin_rc + q0).astype(np.float64)
+    xmw = (xmin_rc + w0).astype(np.float64)
+    v_ok = empty_v | (
+        (np.maximum(v_lo, 0.0) >= ymq - tol)
+        & (np.minimum(v_hi, height - 1.0) <= ymq + slc - 1 + tol))
+    h_ok = empty_h | (
+        (np.maximum(u_lo, 0.0) >= xmw - tol)
+        & (np.minimum(u_hi + 1.0, width - 1.0)
+           <= xmw + n_eff * WIN - 1 + tol))
+    return bool(v_ok.all() and h_ok.all())
+
+  for cfg in _banded_family(height, width):
+    if covers(cfg, -tol) and covers(cfg, tol):
+      return cfg
+  return None
+
+
 def _sep_tap_extents(h, width: int):
   """Per-chunk integer tap extents [x_lo, x_hi] for separable homographies.
 
@@ -1128,6 +1540,34 @@ def _make_shared(n_taps: int, n_windows: int,
   return shared
 
 
+@functools.lru_cache(maxsize=None)
+def _make_banded(cfg: tuple):
+  """Banded-tier render with a custom VJP.
+
+  The backward always routes through the XLA reference path: the banded
+  tier is the correctness/perf middle ground for large rotations, and its
+  training traffic is rare enough that a dedicated adjoint kernel hasn't
+  earned its complexity yet (the XLA VJP is always correct, just slower).
+  """
+  tw, bandg, slc, tsrc, n_eff = cfg
+
+  @jax.custom_vjp
+  def banded(planes, homs):
+    return _banded_call(planes, homs, tw, bandg, slc, tsrc, n_eff,
+                        jax.default_backend() != "tpu")
+
+  def fwd(planes, homs):
+    return banded(planes, homs), (planes, homs)
+
+  def bwd(res, g):
+    planes, homs = res
+    _, vjp = jax.vjp(_reference_render_batch, planes, homs)
+    return vjp(g)
+
+  banded.defvjp(fwd, bwd)
+  return banded
+
+
 class _SharedGetter:
   """Dict-compatible view over ``_make_shared`` (tests index by plan)."""
 
@@ -1194,10 +1634,15 @@ def plan_fused(homs, height: int, width: int):
                 plan=_sep_windows_needed(homs, hp, wp),
                 adj_plan=render_pallas_bwd.plan_adjoint_sep(homs, hp, wp))
   plan = _plan_shared(homs, hp, wp)
-  if plan is None:
+  if plan is not None:
+    return dict(separable=False, plan=plan,
+                adj_plan=render_pallas_bwd.plan_adjoint_shr(homs, hp, wp))
+  bplan = _plan_banded(homs, hp, wp)
+  if bplan is None:
     return None
-  return dict(separable=False, plan=plan,
-              adj_plan=render_pallas_bwd.plan_adjoint_shr(homs, hp, wp))
+  # Banded middle tier: Pallas forward, XLA backward (adj_plan=None is the
+  # explicit keep-the-XLA-VJP sentinel, always correct).
+  return dict(separable=False, plan=("banded",) + bplan, adj_plan=None)
 
 
 def render_mpi_fused(planes: jnp.ndarray, homs: jnp.ndarray,
@@ -1223,10 +1668,14 @@ def render_mpi_fused(planes: jnp.ndarray, homs: jnp.ndarray,
       is ~4x quicker than the shared-gather general kernel.
     check: when True (the default) and ``homs`` is concrete, verify the
       kernel's coverage envelope (``fits_envelope`` / ``_plan_shared``)
-      and transparently fall back to the XLA ``reference_render`` path if
-      the pose is outside it, so out-of-envelope poses return correct
-      pixels instead of silently dropping taps — microseconds of host math
-      against a ~30 ms 1080p render. The check also statically tunes the
+      and degrade gracefully for poses outside it: general poses past the
+      shared-gather envelope try the banded per-row middle tier
+      (``_plan_banded`` — Pallas forward, XLA backward) before falling
+      all the way back to the XLA ``reference_render`` path, so
+      out-of-envelope poses return correct pixels instead of silently
+      dropping taps — host math costs microseconds-to-sub-second against
+      a ~30 ms 1080p render, memoized per pose set. The check also
+      statically tunes the
       gather-window count (and, on the general path, the tap-fan width)
       from the concrete homographies. Under jit the homographies are
       tracers and NO check is possible, so ``check=True`` raises: pass
@@ -1238,7 +1687,9 @@ def render_mpi_fused(planes: jnp.ndarray, homs: jnp.ndarray,
     plan: with ``check=False`` only — an explicit kernel-variant plan from
       an eager ``plan_fused`` (or ``_plan_shared``) call on the concrete
       poses: ``(n_taps, n_windows)`` for the general path, the window
-      count (int) for the separable path. Jitted/shard_mapped callers use
+      count (int) for the separable path, or a ``("banded", tw, bandg,
+      slice_rows, tsrc, n_windows)`` tag selecting the per-row banded
+      middle tier (large rotations). Jitted/shard_mapped callers use
       this to run the planned variant instead of the conservative
       maximum. Plans for sizes off the tile grid must be made at the
       auto-padded geometry (``plan_fused`` does). Passing the planner's
@@ -1370,14 +1821,24 @@ def _render_mpi_fused_batch(planes, homs, np_homs, separable, check, plan,
     return _make_fused(n_windows, adj)(planes, homs)
 
   # General path: the shared-gather kernel, planned eagerly (tap fan +
-  # window count mirrored from concrete homographies); traced opt-in calls
-  # get an explicit caller-supplied plan (plan_fused) or the conservative
-  # static maximum (3 taps, 3 windows) with the XLA backward.
-  adj = _default_adj(render_pallas_bwd.plan_adjoint_shr)
+  # window count mirrored from concrete homographies); poses past its
+  # envelope try the banded per-row middle tier before falling all the
+  # way to XLA (shared -> banded -> XLA, mirroring the reference's
+  # pose-independent grid_sample path, utils.py:104-134). Traced opt-in
+  # calls get an explicit caller-supplied plan (plan_fused) — which may
+  # name the banded tier — or the conservative static maximum (3 taps,
+  # 3 windows) with the XLA backward.
   if check:
     plan = _plan_shared(np_homs, height, width)
-    if plan is None:
+    if plan is not None:
+      adj = _default_adj(render_pallas_bwd.plan_adjoint_shr)
+      return _make_shared(plan[0], plan[1], adj)(planes, homs)
+    bplan = _plan_banded(np_homs, height, width)
+    if bplan is None:
       return _reference_render_jit(planes, homs)
-    return _make_shared(plan[0], plan[1], adj)(planes, homs)
+    return _make_banded(bplan)(planes, homs)
+  if isinstance(plan, tuple) and plan and plan[0] == "banded":
+    return _make_banded(plan[1:])(planes, homs)
+  adj = _default_adj(render_pallas_bwd.plan_adjoint_shr)
   n_taps, n_windows = (3, 3) if plan is PLAN_UNSET else plan
   return _make_shared(n_taps, n_windows, adj)(planes, homs)

@@ -144,8 +144,13 @@ class TestSharedFusedAutoPlan:
   def test_out_of_envelope_raises(self, rng, scene):
     mpi, depths, k = scene
     m = pmesh.make_mesh()
+    # 90-degree YAW: the homography denominator changes sign over the
+    # image, which every Pallas tier (shared, banded) rejects at any size.
+    # (A 90-degree roll no longer works here: at this tiny image the
+    # banded middle tier legitimately covers it — the whole source fits
+    # one band.)
     wild = np.eye(4, dtype=np.float32)
-    wild[:3, :3] = np.array([[0, -1, 0], [1, 0, 0], [0, 0, 1]], np.float32)
+    wild[:3, :3] = np.array([[0, 0, 1], [0, 1, 0], [-1, 0, 0]], np.float32)
     poses = jnp.asarray(np.stack([wild] * 8))
     with pytest.raises(ValueError, match="outside the fused-kernel"):
       pmesh.render_views_sharded(mpi, poses, depths, k, m,

@@ -300,6 +300,23 @@ class TestPlannedTrainStep:
     assert key != "xla" and key[0] is False  # general (non-separable) plan
     assert key[2] is not None                # Pallas backward engaged
 
+  def test_large_rotation_batch_uses_banded_tier(self, rng):
+    """A pose past the shared envelope trains through the banded Pallas
+    forward (plan tagged 'banded') with the XLA backward (adj_plan None)."""
+    state = tloop.create_train_state(
+        jax.random.PRNGKey(0), num_planes=4, image_size=(32, 32), norm=None)
+    step = tloop.make_train_step_planned(vgg_params=None)
+    roll = np.eye(4, dtype=np.float32)
+    c, s = np.cos(0.35), np.sin(0.35)            # ~20 degrees in-plane
+    roll[:3, :3] = np.array([[c, -s, 0], [s, c, 0], [0, 0, 1]], np.float32)
+    roll[0, 3] = 0.03
+    state, metrics = step(state, _batch_pose(rng, roll))
+    assert np.isfinite(float(metrics["loss"]))
+    (key,) = step.cache
+    assert key != "xla" and key[0] is False
+    assert key[1][0] == "banded"
+    assert key[2] is None                        # XLA backward (middle tier)
+
   def test_out_of_envelope_batch_falls_back_to_xla(self, rng):
     state = tloop.create_train_state(
         jax.random.PRNGKey(0), num_planes=4, image_size=(32, 32), norm=None)

@@ -1,0 +1,128 @@
+"""VGG-perceptual vs plain-L2 training ablation (VERDICT r3 item 9).
+
+The reference trains with a pretrained-VGG16 perceptual loss (notebook
+cell 12:17-60); pretrained ImageNet weights are unreachable offline, so
+every perceptual loss this repo computes uses random (He-init) VGG
+features. This script quantifies what those random features buy over the
+plain L2 metric loss — the honest substitute for the unreproducible
+pretrained-weights comparison:
+
+  * synthesize the hermetic procedural dataset;
+  * train the SAME initial model twice on the SAME batch stream — once
+    with the (random-)VGG perceptual loss, once with plain L2;
+  * render held-out validation novel views with both and report L1 and
+    PSNR against the target frames.
+
+Prints ONE JSON line: {"metric": "vgg_ablation_val_psnr_db", "value":
+<psnr of the VGG-trained model>, "l2_psnr": ..., "vgg_l1": ...,
+"l2_l1": ..., "steps": N, ...}. Run with --img-size 64 for a quick CPU
+pass; defaults are the reference config (224 px, 10 planes, cell 8).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+  ap = argparse.ArgumentParser()
+  ap.add_argument("--img-size", type=int, default=224)    # cell 8:89
+  ap.add_argument("--num-planes", type=int, default=10)   # cell 8:90
+  ap.add_argument("--scenes", type=int, default=8)
+  ap.add_argument("--steps", type=int, default=200)
+  ap.add_argument("--seed", type=int, default=0)
+  args = ap.parse_args()
+
+  import jax
+  import jax.numpy as jnp
+  import numpy as np
+
+  from mpi_vision_tpu import config
+  from mpi_vision_tpu.data import realestate
+  from mpi_vision_tpu.train import loop as train_loop
+  from mpi_vision_tpu.train import loss as loss_lib
+  from mpi_vision_tpu.train import vgg as vgg_lib
+
+  t0 = time.time()
+  tmp = tempfile.TemporaryDirectory(prefix="mpi_ablate_")
+  realestate.synthesize_dataset(tmp.name, num_scenes=args.scenes, frames=4,
+                                img_size=args.img_size, seed=args.seed)
+  cfg = config.TrainConfig(
+      data=config.DataConfig(dataset_path=tmp.name, img_size=args.img_size,
+                             num_planes=args.num_planes))
+  valid = cfg.data.make_dataset(is_valid=True)
+  vgg_params = vgg_lib.default_params()
+
+  def batches(n):
+    """n batches from a FIXED stream, identical for both arms.
+
+    The dataset is rebuilt per arm: RealEstateDataset draws frame
+    triplets from its own stateful rng, so sharing one dataset object
+    would hand the second arm a different triplet sequence and conflate
+    loss choice with batch content.
+    """
+    dataset = cfg.data.make_dataset(rng=np.random.default_rng(args.seed))
+    order = np.random.default_rng(args.seed + 1)
+    got = 0
+    while got < n:
+      for b in realestate.iterate_batches(dataset, batch_size=1, rng=order):
+        yield b
+        got += 1
+        if got >= n:
+          return
+
+  def eval_model(state):
+    """Mean L1 / PSNR of rendered validation novel views vs targets."""
+    l1s, mses = [], []
+    for i in range(len(valid)):
+      ex = valid[i]
+      batch = {k: jnp.asarray(np.asarray(v))[None] for k, v in ex.items()}
+      pred = state.apply_fn({"params": state.params}, batch["net_input"])
+      out = loss_lib.render_novel_view(pred, batch)
+      diff = np.asarray(out[0]) - np.asarray(batch["tgt_img"][0])
+      l1s.append(float(np.abs(diff).mean()))
+      mses.append(float((diff ** 2).mean()))
+    # Images live in [-1, 1]: PSNR against that 2.0 peak-to-peak range.
+    psnr = float(10 * np.log10(4.0 / np.mean(mses)))
+    return float(np.mean(l1s)), psnr
+
+  results = {}
+  for kind in ("vgg", "l2"):
+    state = cfg.make_train_state(jax.random.PRNGKey(args.seed))
+    step = train_loop.make_train_step(
+        vgg_params if kind == "vgg" else None, resize=cfg.vgg_resize)
+    state, losses = train_loop.fit(state, batches(args.steps), step=step)
+    l1, psnr = eval_model(state)
+    results[kind] = dict(l1=l1, psnr=psnr, first_loss=losses[0],
+                         final_loss=losses[-1])
+    print(f"ablate: {kind} trained {len(losses)} steps "
+          f"loss {losses[0]:.4f}->{losses[-1]:.4f} "
+          f"val L1={l1:.4f} PSNR={psnr:.2f} dB", file=sys.stderr)
+
+  print(json.dumps({
+      "metric": "vgg_ablation_val_psnr_db",
+      "value": round(results["vgg"]["psnr"], 3),
+      "unit": "dB",
+      "vs_baseline": None,
+      "l2_psnr": round(results["l2"]["psnr"], 3),
+      "vgg_l1": round(results["vgg"]["l1"], 5),
+      "l2_l1": round(results["l2"]["l1"], 5),
+      "vgg_final_loss": round(results["vgg"]["final_loss"], 5),
+      "l2_final_loss": round(results["l2"]["final_loss"], 5),
+      "img_size": args.img_size,
+      "num_planes": args.num_planes,
+      "steps": args.steps,
+      "seconds": round(time.time() - t0, 1),
+  }))
+  tmp.cleanup()
+
+
+if __name__ == "__main__":
+  main()

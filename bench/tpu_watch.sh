@@ -33,8 +33,8 @@ print(jax.devices())
       bash bench/run_all_tpu.sh >>"$log" 2>&1
       batteries=$((batteries + 1))
       missing=0
-      for n in headline config1 config2 config3 config4 config5 train_speed render_bwd profile; do
-        [ -s "artifacts/tpu_r03_${n}.json" ] || missing=$((missing + 1))
+      for n in headline config1 config2 config3 config4 config5 train_speed render_bwd train_ref224 ablate_vgg profile; do
+        [ -s "artifacts/tpu_r04_${n}.json" ] || missing=$((missing + 1))
       done
       if [ "$missing" -eq 0 ]; then
         echo "battery complete $(date -u +%H:%M:%SZ)" >>"$log"

@@ -21,13 +21,15 @@ VMEM accumulator, farthest plane's alpha ignored per utils.py:152-153):
     in the row (one-signed denominator), so a strip's x-taps per column
     form a fan of 2-3 consecutive columns shared by all 8 rows — the
     gathers amortize across the strip like the separable path. Vertical
-    taps are selected per pixel with single-vreg sublane gathers. All
-    data-dependent scalars come from SMEM tables computed vectorized (in
-    the same jit) from cell-corner homography evaluations.
+    taps are selected per pixel with single-vreg sublane gathers over a
+    slice whose height escalates with the pose (``SHARED_LEVELS``: 24-48
+    rows — about 1 to ~13 degrees of yaw at 1080p, gather cost linear in
+    the slice). All data-dependent scalars come from SMEM tables computed
+    vectorized (in the same jit) from cell-corner homography evaluations.
   - ``_banded_kernel``: the per-row middle tier for rotations past the
-    shared envelope (~1 degree at 1080p). Per-ROW gather windows and band
-    slices with pose-adaptive tile geometry (``_banded_family``) hold to
-    ~10+ degrees; ~8x the shared kernel's gather traffic, still ~an order
+    slice ladder. Per-ROW gather windows and band
+    slices with pose-adaptive tile geometry (``_banded_family``);
+    ~8x the shared kernel's gather traffic, still ~an order
     of magnitude above the XLA gather fallback. Dispatch chains
     shared -> banded -> XLA so cost degrades gradually with pose, where
     the reference's one-size grid_sample path (utils.py:104-134) is
@@ -44,9 +46,9 @@ sampler's zeros padding. Per-plane source extents bounded: the separable
 strip band allows vertical
 scale <= ~1.5; windows cover <= 2*128+1 = 257 source columns per chunk from
 the leftmost tap (3 windows: <= ~2.0 horizontal scale). The shared kernel's
-per-tile rectangles allow several degrees of rotation at 1080p (per-column
-row-drift <= 2 for the 3-tap fan, vertical tap span <= 24 rows per strip-
-chunk, same window bounds). ``fits_envelope`` / ``_plan_shared`` check the
+per-tile rectangles allow up to ~13 degrees of rotation at 1080p
+(per-column row-drift <= 2 for the 3-tap fan, vertical tap span <= 48
+rows per strip-chunk at the top slice-ladder level, same window bounds). ``fits_envelope`` / ``_plan_shared`` check the
 exact contract eagerly — microseconds of host math — and
 ``render_mpi_fused`` falls back to the XLA path for out-of-envelope
 concrete poses. Under jit no check is possible, so checked calls RAISE and
@@ -82,8 +84,17 @@ SEP_WINDOWS = 3   # separable path: 2 unconditional + 1 conditional
 # (a small tap fan covers the rows' x-drift), vertical taps selected by
 # single-vreg sublane gathers.
 G_TILE_W = 384   # preferred output tile width (3 chunks)
-G_BAND = 32      # source rows per tile band (8-aligned start)
-G_SHARED = 24    # band rows in the shared gather slice (3 sublane vregs)
+G_BAND = 32      # source rows per tile band (8-aligned start), base level
+G_SHARED = 24    # band rows in the shared gather slice, base level
+
+# Slice-escalation ladder for the shared kernel: (slice rows, band rows).
+# A chunk's vertical taps must fit its slice, so the slice height caps the
+# per-chunk v-drift — about a degree of yaw at 1080p for the 24-row base.
+# Wider slices buy rotation envelope (~13 degrees at the 48-row top) at a
+# linear cost in gather traffic (every lane gather spans slc sublanes) and
+# DMA amplification (taller tile bands), still far below the banded tier's
+# per-row-window formulation. The planner walks the ladder cheapest-first.
+SHARED_LEVELS = ((24, 32), (32, 48), (40, 64), (48, 80))
 
 
 def pixel_homographies(
@@ -322,17 +333,33 @@ def _separable_kernel(hom_ref, planes_ref, out_ref, band_ref, acc_ref, sems,
     out_ref[0] = acc_ref[:]
 
 
-def _tile_sizes(height: int, width: int, n_windows: int):
+def _tile_sizes(height: int, width: int, n_windows: int,
+                bandg: int = G_BAND):
   """Static tile geometry for the shared-gather general kernel."""
   tw = next(t for t in (G_TILE_W, 256, CHUNK) if width % t == 0)
   tsrc = min(width, 640 if n_windows == 2 else 1024)
-  bandg = G_BAND if height >= G_BAND else BAND
+  bandg = bandg if height >= bandg else BAND
   n_eff = min(n_windows, tsrc // WIN)
   return tw, tsrc, bandg, n_eff
 
 
+def _shared_levels(height: int):
+  """The slice-ladder levels usable at ``height``: (slc, bandg) with the
+  same small-image band fallback as ``_tile_sizes``, slices strictly
+  increasing (a taller band with the same slice adds cost, not coverage).
+  """
+  out = []
+  for slc, bandg in SHARED_LEVELS:
+    bg = bandg if height >= bandg else BAND
+    sl = min(slc, bg)
+    if not out or sl > out[-1][0]:
+      out.append((sl, bg))
+  return tuple(out)
+
+
 def _shr_chunk_sample(usl, vsl, band_ref, slot, ymin, xmin, q0, w0,
-                      n_taps, n_windows, height, width):
+                      n_taps, n_windows, height, width,
+                      slc: int = G_SHARED):
   """Warp-sample one [STRIP, CHUNK] output chunk from a 2-D source band.
 
   The per-chunk sampling core of the shared-gather general path, shared by
@@ -342,7 +369,8 @@ def _shr_chunk_sample(usl, vsl, band_ref, slot, ymin, xmin, q0, w0,
   ``(ymin, xmin)``; ``q0``/``w0`` are the chunk's band-slice offset and
   gather-window base within it. Horizontal taps are a fan of ``n_taps``
   consecutive columns from ``floor(min_row u)`` shared by all strip rows;
-  vertical taps are selected per pixel with single-vreg sublane gathers.
+  vertical taps are selected per pixel with single-vreg sublane gathers
+  over a ``slc``-row slice (a SHARED_LEVELS slice height; the base 24).
   Returns 4 ``[STRIP, CHUNK]`` channels.
   """
   xhat_f = jnp.floor(jnp.min(usl, axis=0, keepdims=True))  # [1, CHUNK]
@@ -366,16 +394,16 @@ def _shr_chunk_sample(usl, vsl, band_ref, slot, ymin, xmin, q0, w0,
     ct = jnp.where((xt >= 0) & (xt <= width - 1), ct, 0.0)
 
     rel0 = xt - xmin - w0            # [1, CHUNK], window-0-relative
-    xle = None                       # per-channel [G_SHARED, CHUNK]
+    xle = None                       # per-channel [slc, CHUNK]
     for wi in range(n_windows):
       rel = rel0 - wi * WIN
       inw = (rel >= 0) & (rel < WIN)
       idx = jnp.broadcast_to(jnp.clip(rel, 0, WIN - 1),
-                             (G_SHARED,) + usl.shape[1:])
+                             (slc,) + usl.shape[1:])
       base = pl.multiple_of(w0 + wi * WIN, WIN)
       outs = []
       for c in range(4):
-        win = band_ref[slot, c, pl.ds(q0, G_SHARED), pl.ds(base, WIN)]
+        win = band_ref[slot, c, pl.ds(q0, slc), pl.ds(base, WIN)]
         g = jnp.take_along_axis(win, idx, axis=1)
         outs.append(jnp.where(inw, g, 0.0))
       xle = outs if xle is None else [a + o for a, o in zip(xle, outs)]
@@ -383,7 +411,7 @@ def _shr_chunk_sample(usl, vsl, band_ref, slot, ymin, xmin, q0, w0,
     for c in range(4):
       acc_a = jnp.zeros(usl.shape, jnp.float32)
       acc_b = jnp.zeros(usl.shape, jnp.float32)
-      for k in range(G_SHARED // 8):
+      for k in range(slc // 8):
         vreg = xle[c][8 * k:8 * (k + 1)]                   # [8, CHUNK]
         ga = jnp.take_along_axis(vreg, jnp.clip(qi - 8 * k, 0, 7), axis=0)
         gb = jnp.take_along_axis(
@@ -398,7 +426,7 @@ def _shr_chunk_sample(usl, vsl, band_ref, slot, ymin, xmin, q0, w0,
 def _shared_kernel(hom_ref, meta_ref, meta_next_ref, wq_ref, planes_ref,
                    out_ref, band_ref, acc_ref, sems,
                    *, num_planes, height, width, n_windows, n_taps, tw,
-                   tsrc, bandg):
+                   tsrc, bandg, slc=G_SHARED):
   """General-homography render on 2-D output tiles (the rotation path).
 
   The key structural fact this kernel exploits: with a one-signed
@@ -407,17 +435,18 @@ def _shared_kernel(hom_ref, meta_ref, meta_next_ref, wq_ref, planes_ref,
   ``floor(u_min)..floor(u_max)+1`` — for small rotations a fan of
   ``n_taps`` (2 or 3) consecutive columns starting at
   ``x̂(j) = floor(min_r u(r, j))``. All 8 rows therefore SHARE one lane
-  gather per (tap, window, channel) over a 24-row band slice, instead of
-  the ~8x gather traffic of a per-row formulation (a pure yaw pan has
-  h01 = h21 = 0: u is exactly row-independent and the fan is 2 — the
-  bilinear taps themselves).
+  gather per (tap, window, channel) over a ``slc``-row band slice (a
+  SHARED_LEVELS ladder level, 24-48 rows), instead of the ~8x gather
+  traffic of a per-row formulation (a pure yaw pan has h01 = h21 = 0: u
+  is exactly row-independent and the fan is 2 — the bilinear taps
+  themselves).
 
   The vertical 2-tap lerp picks, per output pixel, rows
   ``floor(v), floor(v)+1`` of the gathered slice. Sublane-axis
   ``take_along_axis`` is HW-supported for a single [8, 128] vreg with
-  per-sublane/per-lane indices, so each tap is selected with three
-  single-vreg sublane gathers + masks (one per 8-row group of the 24-row
-  slice) — O(1) per pixel, not an O(24) weighted reduction.
+  per-sublane/per-lane indices, so each tap is selected with ``slc/8``
+  single-vreg sublane gathers + masks (one per 8-row group of the
+  slice) — O(1) per pixel, not an O(slc) weighted reduction.
 
   Tiling the output into ``[STRIP, tw]`` blocks bounds source drift per
   tile: each (strip, tile, plane) step DMAs its own ``[4, bandg, tsrc]``
@@ -479,7 +508,7 @@ def _shared_kernel(hom_ref, meta_ref, meta_next_ref, wq_ref, planes_ref,
     q0 = pl.multiple_of(wq_ref[0, 0, 0, p, ci * 2 + 1], 8)
     sl = slice(ci * CHUNK, (ci + 1) * CHUNK)
     pix = _shr_chunk_sample(u[:, sl], v[:, sl], band_ref, slot, ymin, xmin,
-                            q0, w0, n_taps, n_windows, height, width)
+                            q0, w0, n_taps, n_windows, height, width, slc)
     rgb, alpha = pix[:3], pix[3]
     cols = pl.ds(pl.multiple_of(ci * CHUNK, CHUNK), CHUNK)
     for c in range(3):
@@ -540,7 +569,7 @@ def _corner_mins(h9, height: int, width: int, tw: int):
 
 
 def _table_scalars(mins, height: int, width: int, tw: int, tsrc: int,
-                   bandg: int, n_eff: int):
+                   bandg: int, n_eff: int, slc: int = G_SHARED):
   """Aligned table scalars (ymin, xmin [P,S,T]; w0, q0 [P,S,C]) from
   cell-corner minima; the single source of truth for both the SMEM tables
   and the plan's coverage checks."""
@@ -557,7 +586,7 @@ def _table_scalars(mins, height: int, width: int, tw: int, tsrc: int,
   w0 = jnp.clip((jnp.floor(umin_chunk).astype(jnp.int32) - xmin_c)
                 // WIN * WIN, 0, tsrc - n_eff * WIN)
   q0 = jnp.clip((jnp.floor(vmin_chunk).astype(jnp.int32) - ymin_c)
-                // 8 * 8, 0, bandg - min(G_SHARED, bandg))
+                // 8 * 8, 0, bandg - min(slc, bandg))
   return ymin, xmin, ymin_c, xmin_c, w0, q0
 
 
@@ -577,7 +606,7 @@ def _corner_mins_union(h9_stack: jnp.ndarray, height: int, width: int,
 
 def _shared_tables(homs: jnp.ndarray, height: int, width: int,
                    tw: int, tsrc: int, bandg: int, n_eff: int,
-                   mins=None):
+                   mins=None, slc: int = G_SHARED):
   """Device-side (traceable) per-tile/per-chunk scalar tables.
 
   Returns ``meta [S, T, 2, P]`` (tile band origin ymin, xmin) and
@@ -597,7 +626,7 @@ def _shared_tables(homs: jnp.ndarray, height: int, width: int,
   if mins is None:
     mins = _corner_mins(h9, height, width, tw)
   ymin, xmin, _, _, w0, q0 = _table_scalars(
-      mins, height, width, tw, tsrc, bandg, n_eff)
+      mins, height, width, tw, tsrc, bandg, n_eff, slc)
   # Layouts put the per-step-blocked axes first (Pallas requires the last
   # two block dims to equal the array dims for SMEM blocks).
   meta = jnp.stack([ymin, xmin], axis=-1).transpose(1, 2, 3, 0)  # [S,T,2,P]
@@ -632,7 +661,8 @@ def _next_step_index(batch: int, n_strips: int, n_tiles: int,
 
 
 def _shared_grid_setup(planes: jnp.ndarray, homs: jnp.ndarray,
-                       n_windows: int, mins_fn=None):
+                       n_windows: int, mins_fn=None,
+                       slc: int = G_SHARED, bandg: int = G_BAND):
   """Everything a shared-gather-style pallas_call needs besides its kernel
   body and out specs: tile geometry, SMEM tables, grid, in_specs (incl.
   the subtle next-step prefetch index map), and operands. Shared by the
@@ -648,14 +678,15 @@ def _shared_grid_setup(planes: jnp.ndarray, homs: jnp.ndarray,
         f"{height}x{width} (pad the MPI, or use an XLA method)")
   if height < BAND:
     raise ValueError(f"H must be >= {BAND}, got {height}")
-  tw, tsrc, bandg, n_eff = _tile_sizes(height, width, n_windows)
+  tw, tsrc, bandg, n_eff = _tile_sizes(height, width, n_windows, bandg)
   c_t = tw // CHUNK
   n_strips, n_tiles = height // STRIP, width // tw
   homs32 = homs.reshape(batch, num_planes, 9).astype(jnp.float32)
   meta, wq = jax.vmap(
       lambda h: _shared_tables(
           h, height, width, tw, tsrc, bandg, n_eff,
-          mins=None if mins_fn is None else mins_fn(h))
+          mins=None if mins_fn is None else mins_fn(h),
+          slc=min(slc, bandg))
   )(homs32)                          # [B, S, T, 2, P], [B, S, T, P, 2c]
 
   next_index = _next_step_index(batch, n_strips, n_tiles, num_planes)
@@ -675,21 +706,24 @@ def _shared_grid_setup(planes: jnp.ndarray, homs: jnp.ndarray,
   operands = (homs32, meta, meta, wq, planes.astype(jnp.float32))
   geom = dict(tw=tw, tsrc=tsrc, bandg=bandg, n_eff=n_eff, c_t=c_t,
               batch=batch, num_planes=num_planes, height=height,
-              width=width)
+              width=width, slc=min(slc, bandg))
   return grid, in_specs, operands, geom
 
 
 @functools.partial(
-    jax.jit, static_argnames=("n_taps", "n_windows", "interpret"))
+    jax.jit, static_argnames=("n_taps", "n_windows", "interpret", "slc",
+                              "bandg"))
 def _shared_call(planes: jnp.ndarray, homs: jnp.ndarray,
-                 n_taps: int, n_windows: int, interpret: bool) -> jnp.ndarray:
+                 n_taps: int, n_windows: int, interpret: bool,
+                 slc: int = G_SHARED, bandg: int = G_BAND) -> jnp.ndarray:
   """Shared-gather kernel call on a batch ``[B, P, 4, H, W]`` (one launch
   for the whole batch)."""
-  grid, in_specs, operands, g = _shared_grid_setup(planes, homs, n_windows)
+  grid, in_specs, operands, g = _shared_grid_setup(
+      planes, homs, n_windows, slc=slc, bandg=bandg)
   kernel = functools.partial(
       _shared_kernel, num_planes=g["num_planes"], height=g["height"],
       width=g["width"], n_windows=g["n_eff"], n_taps=n_taps, tw=g["tw"],
-      tsrc=g["tsrc"], bandg=g["bandg"])
+      tsrc=g["tsrc"], bandg=g["bandg"], slc=g["slc"])
   return pl.pallas_call(
       kernel,
       grid=grid,
@@ -710,7 +744,8 @@ def _shared_call(planes: jnp.ndarray, homs: jnp.ndarray,
 # --- Banded per-row middle tier (large rotations) -----------------------
 # The shared-gather kernel's strip-shared tap fan caps out when a strip's
 # rows stop sharing x-taps (fan > 3 columns) or a chunk's vertical taps
-# leave the 24-row shared slice — roughly a degree of rotation at 1080p.
+# leave the shared slice — with the SHARED_LEVELS ladder, roughly 13
+# degrees of yaw at 1080p at the 48-row top level.
 # The reference renders ANY pose through one uniform grid_sample path
 # (utils.py:267-294, utils.py:104-134) with pose-independent cost; without
 # a middle tier, poses past the shared envelope fall ~45x to the XLA
@@ -1064,8 +1099,9 @@ def fits_envelope(homs, height: int, width: int,
 def _plan_shared_stats(homs: jnp.ndarray, height: int, width: int):
   """Device-side reductions behind ``_plan_shared`` (traceable, f32).
 
-  Returns five scalars: denominator-one-signed, max per-column floor-span
-  of u across a strip's rows, vertical-coverage ok, and horizontal window
+  Returns: denominator-one-signed, max per-column floor-span of u across
+  a strip's rows, a tuple of vertical-coverage oks (one per
+  ``_shared_levels(height)`` slice-ladder level), and horizontal window
   coverage ok for the 2- and 3-window variants. Runs the SAME table math
   as ``_shared_tables`` (same helpers, same dtype), plus the per-COLUMN
   checks the tables cannot express; per-column u/v extrema over a strip's
@@ -1085,9 +1121,8 @@ def _plan_shared_stats(homs: jnp.ndarray, height: int, width: int):
   den_ok = (jnp.isfinite(d_flat).all()
             & ((d_flat > 0).all(1) | (d_flat < 0).all(1)).all())
 
-  tw, _, bandg, _ = _tile_sizes(height, width, 2)
+  tw, _, _, _ = _tile_sizes(height, width, 2)
   n_strips = height // STRIP
-  slice_rows = min(G_SHARED, bandg)
   mins = _corner_mins(h9, height, width, tw)
 
   # Per-column strip extrema from the strip's top/bottom rows: [P, S, 2, W].
@@ -1124,19 +1159,22 @@ def _plan_shared_stats(homs: jnp.ndarray, height: int, width: int):
   # the f32 ulp <= ~1.2e-4 after the in-image clamps below).
   tol = 5e-4
   chunk_of_col = jnp.arange(width) // CHUNK
-  # Vertical coverage is n_windows-independent (any tsrc gives the same
-  # ymin/q0 formulas); evaluate it with the 2-window geometry.
-  _, _, ymin_c2, _, _, q0_2 = _table_scalars(
-      mins, height, width, tw, min(width, 640), bandg,
-      min(2, min(width, 640) // WIN))
-  ymq = ((ymin_c2 + q0_2)[:, :, chunk_of_col]).astype(jnp.float32)
   # A column is tap-free only when every v is <= -1 or >= H: the boundary
   # taps (row 0 for v in (-1, 0), row H-1 for v in (H-1, H)) carry weight.
   empty_v = (v_hi <= -1) | (v_lo >= height)
-  v_ok = (empty_v | (
-      (jnp.maximum(v_lo, 0.0) >= ymq - tol)
-      & (jnp.minimum(v_hi, height - 1.0)
-         <= ymq + slice_rows - 1 + tol))).all()
+  # Vertical coverage is n_windows-independent (any tsrc gives the same
+  # ymin/q0 formulas); evaluate it with the 2-window geometry, once per
+  # slice-ladder level (ymin/q0 shift with the level's bandg/slc).
+  v_oks = []
+  for slc_l, bandg_l in _shared_levels(height):
+    _, _, ymin_cl, _, _, q0_l = _table_scalars(
+        mins, height, width, tw, min(width, 640), bandg_l,
+        min(2, min(width, 640) // WIN), slc_l)
+    ymq = ((ymin_cl + q0_l)[:, :, chunk_of_col]).astype(jnp.float32)
+    v_oks.append((empty_v | (
+        (jnp.maximum(v_lo, 0.0) >= ymq - tol)
+        & (jnp.minimum(v_hi, height - 1.0)
+           <= ymq + slc_l - 1 + tol))).all())
 
   # The tap fan [xhat, xhat + span + 1] covers each column's x-taps by
   # construction; in-image taps must land in the chunk's window union.
@@ -1145,15 +1183,16 @@ def _plan_shared_stats(homs: jnp.ndarray, height: int, width: int):
   empty_h = (u_hi <= -1) | (u_lo >= width)
   h_oks = []
   for n_windows in (2, 3):
-    _, tsrc, _, n_eff = _tile_sizes(height, width, n_windows)
+    _, tsrc, bandg_h, n_eff = _tile_sizes(height, width, n_windows)
+    # xmin/w0 are bandg/slc-independent; any level gives the same values.
     _, _, _, xmin_c, w0, _ = _table_scalars(
-        mins, height, width, tw, tsrc, bandg, n_eff)
+        mins, height, width, tw, tsrc, bandg_h, n_eff)
     xmw = ((xmin_c + w0)[:, :, chunk_of_col]).astype(jnp.float32)
     h_oks.append((empty_h | (
         (jnp.maximum(u_lo, 0.0) >= xmw - tol)
         & (jnp.minimum(u_hi + 1.0, width - 1.0)
            <= xmw + n_eff * WIN - 1 + tol))).all())
-  return den_ok, span_max, v_ok, h_oks[0], h_oks[1]
+  return den_ok, span_max, tuple(v_oks), h_oks[0], h_oks[1]
 
 
 # --- Host-planning memos -----------------------------------------------
@@ -1197,17 +1236,18 @@ def plan_memo(kind: str, homs_np: np.ndarray, height: int, width: int,
 
 
 def _plan_shared(homs, height: int, width: int):
-  """Static ``(n_taps, n_windows)`` for the shared-gather kernel, or None.
-  Memoized on the pose bytes (see ``plan_memo``).
+  """Static ``(n_taps, n_windows, slc, bandg)`` for the shared-gather
+  kernel, or None. Memoized on the pose bytes (see ``plan_memo``).
 
   Thin host wrapper over the jitted ``_plan_shared_stats``: decides the
   tap-fan width (``2 + max floor-span of u across a strip's rows``, capped
-  at 3) and the minimal window count (2 or 3) whose coverage holds, or
-  returns None (caller falls back to XLA) when the pose is outside the
-  envelope or a homography denominator changes sign over the image (poles
-  break the monotonicity the extrema rely on). ``homs`` must be concrete;
-  leading batch axes flatten into the plane axis ([P, 3, 3] or
-  [B, P, 3, 3] — the plan covers every entry).
+  at 3), the minimal window count (2 or 3) whose coverage holds, and the
+  cheapest SHARED_LEVELS slice-ladder level whose vertical coverage holds;
+  returns None (caller falls back to the banded tier, then XLA) when no
+  level covers the pose or a homography denominator changes sign over the
+  image (poles break the monotonicity the extrema rely on). ``homs`` must
+  be concrete; leading batch axes flatten into the plane axis ([P, 3, 3]
+  or [B, P, 3, 3] — the plan covers every entry).
 
   Precision: the stats run in f32 with the same formulas (and helpers) as
   the device tables, so plan and tables see identical values up to XLA op
@@ -1226,17 +1266,21 @@ def _plan_shared_uncached(homs: np.ndarray, height: int, width: int):
   # ensure_compile_time_eval: callers may sit under an ambient jit trace
   # (concrete homs as jit constants); the stats must still run eagerly.
   with jax.ensure_compile_time_eval():
-    den_ok, span_max, v_ok, h2, h3 = jax.device_get(
+    den_ok, span_max, v_oks, h2, h3 = jax.device_get(
         _plan_shared_stats(jnp.asarray(homs), height, width))
-  if not den_ok or not v_ok:
+  if not den_ok:
     return None
   n_taps = int(span_max) + 2
   if n_taps > 3:
     return None
-  if h2:
-    return n_taps, 2
-  if h3:
-    return n_taps, 3
+  n_windows = 2 if h2 else 3 if h3 else None
+  if n_windows is None:
+    return None
+  # Walk the slice ladder cheapest-first: gather traffic is linear in the
+  # slice height, so the first covering level is the fastest.
+  for (slc, bandg), v_ok in zip(_shared_levels(height), v_oks):
+    if v_ok:
+      return n_taps, n_windows, slc, bandg
   return None
 
 
@@ -1508,23 +1552,29 @@ def _make_fused(n_windows: int,
 
 @functools.lru_cache(maxsize=None)
 def _make_shared(n_taps: int, n_windows: int,
-                 adj_plan: tuple[int, int, int] | str | None = None):
+                 adj_plan: tuple[int, int, int] | str | None = None,
+                 slc: int = G_SHARED, bandg: int = G_BAND):
   """General-path fused render with a custom VJP (see _make_fused: with
   ``adj_plan`` — a ``render_pallas_bwd.plan_adjoint_shr`` result or
   LAZY_ADJ — d planes runs on the Pallas backward; d homs stays on the
-  XLA path, DCE'd under jit when pose gradients are unused)."""
+  XLA path, DCE'd under jit when pose gradients are unused). Plans above
+  the base slice level always take the XLA backward: the backward warp
+  kernel runs the base geometry, and re-sampling a wide-slice pose with
+  it would drop taps (same convention as the banded tier — the XLA VJP
+  is always correct, just slower)."""
 
   @jax.custom_vjp
   def shared(planes, homs):
     return _shared_call(planes, homs, n_taps, n_windows,
-                        jax.default_backend() != "tpu")
+                        jax.default_backend() != "tpu", slc, bandg)
 
   def fwd(planes, homs):
     return shared(planes, homs), (planes, homs)
 
   def bwd(res, g):
     planes, homs = res
-    plan = _resolve_adj(adj_plan, planes, homs, separable=False)
+    plan = (_resolve_adj(adj_plan, planes, homs, separable=False)
+            if (slc, bandg) == (G_SHARED, G_BAND) else None)
     if plan is None:
       _, vjp = jax.vjp(_reference_render_batch, planes, homs)
       return vjp(g)
@@ -1574,6 +1624,10 @@ class _SharedGetter:
   def __getitem__(self, key):
     if len(key) == 2:
       return _make_shared(key[0], key[1])
+    if len(key) == 4 and all(isinstance(k, (int, np.integer)) for k in key):
+      # A _plan_shared 4-tuple (n_taps, n_windows, slc, bandg): the
+      # adjoint-plan slot is positional third in _make_shared.
+      return _make_shared(key[0], key[1], None, key[2], key[3])
     return _make_shared(*key)
 
 
@@ -1635,8 +1689,11 @@ def plan_fused(homs, height: int, width: int):
                 adj_plan=render_pallas_bwd.plan_adjoint_sep(homs, hp, wp))
   plan = _plan_shared(homs, hp, wp)
   if plan is not None:
-    return dict(separable=False, plan=plan,
-                adj_plan=render_pallas_bwd.plan_adjoint_shr(homs, hp, wp))
+    # Wide-slice plans take the XLA backward (the backward warp kernel
+    # runs the base geometry only); don't pay adjoint planning for them.
+    adj = (render_pallas_bwd.plan_adjoint_shr(homs, hp, wp)
+           if (plan[2], plan[3]) == (G_SHARED, G_BAND) else None)
+    return dict(separable=False, plan=plan, adj_plan=adj)
   bplan = _plan_banded(homs, hp, wp)
   if bplan is None:
     return None
@@ -1686,10 +1743,12 @@ def render_mpi_fused(planes: jnp.ndarray, homs: jnp.ndarray,
       path renders unchecked taps by default.
     plan: with ``check=False`` only — an explicit kernel-variant plan from
       an eager ``plan_fused`` (or ``_plan_shared``) call on the concrete
-      poses: ``(n_taps, n_windows)`` for the general path, the window
-      count (int) for the separable path, or a ``("banded", tw, bandg,
-      slice_rows, tsrc, n_windows)`` tag selecting the per-row banded
-      middle tier (large rotations). Jitted/shard_mapped callers use
+      poses: ``(n_taps, n_windows, slc, bandg)`` for the general path
+      (the last two name the SHARED_LEVELS slice-ladder level; legacy
+      2-tuples run the base level), the window count (int) for the
+      separable path, or a ``("banded", tw, bandg, slice_rows, tsrc,
+      n_windows)`` tag selecting the per-row banded middle tier (large
+      rotations). Jitted/shard_mapped callers use
       this to run the planned variant instead of the conservative
       maximum. Plans for sizes off the tile grid must be made at the
       auto-padded geometry (``plan_fused`` does). Passing the planner's
@@ -1832,7 +1891,8 @@ def _render_mpi_fused_batch(planes, homs, np_homs, separable, check, plan,
     plan = _plan_shared(np_homs, height, width)
     if plan is not None:
       adj = _default_adj(render_pallas_bwd.plan_adjoint_shr)
-      return _make_shared(plan[0], plan[1], adj)(planes, homs)
+      return _make_shared(plan[0], plan[1], adj, plan[2], plan[3])(
+          planes, homs)
     bplan = _plan_banded(np_homs, height, width)
     if bplan is None:
       return _reference_render_jit(planes, homs)
@@ -1840,5 +1900,11 @@ def _render_mpi_fused_batch(planes, homs, np_homs, separable, check, plan,
   if isinstance(plan, tuple) and plan and plan[0] == "banded":
     return _make_banded(plan[1:])(planes, homs)
   adj = _default_adj(render_pallas_bwd.plan_adjoint_shr)
-  n_taps, n_windows = (3, 3) if plan is PLAN_UNSET else plan
-  return _make_shared(n_taps, n_windows, adj)(planes, homs)
+  if plan is PLAN_UNSET:
+    n_taps, n_windows, slc, bandg = 3, 3, G_SHARED, G_BAND
+  else:
+    # Legacy 2-tuple plans run the base slice level; _plan_shared /
+    # plan_fused emit 4-tuples naming the slice-ladder level.
+    n_taps, n_windows = plan[0], plan[1]
+    slc, bandg = (plan[2], plan[3]) if len(plan) > 2 else (G_SHARED, G_BAND)
+  return _make_shared(n_taps, n_windows, adj, slc, bandg)(planes, homs)

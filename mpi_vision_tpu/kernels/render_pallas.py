@@ -1036,6 +1036,13 @@ def fits_envelope(homs, height: int, width: int,
   same chain ``render_mpi_fused(check=True)`` walks before falling back to
   XLA. ``homs`` must be concrete; leading batch axes flatten into the plane
   axis ([P, 3, 3] or [B, P, 3, 3]).
+
+  A True result licenses ``check=False`` rendering — but for general
+  poses that only the BANDED tier covers, only together with the explicit
+  ``("banded", ...)`` plan from ``plan_fused``: the shared-gather kernel
+  (what an unplanned ``check=False`` call runs, at the top slice-ladder
+  level) cannot cover banded-only poses at any level. Shared-envelope and
+  separable poses are safe unplanned.
   """
   auto = separable is None
   if auto:
@@ -1552,16 +1559,16 @@ def _make_fused(n_windows: int,
 
 @functools.lru_cache(maxsize=None)
 def _make_shared(n_taps: int, n_windows: int,
-                 adj_plan: tuple[int, int, int] | str | None = None,
+                 adj_plan: tuple | str | None = None,
                  slc: int = G_SHARED, bandg: int = G_BAND):
   """General-path fused render with a custom VJP (see _make_fused: with
   ``adj_plan`` — a ``render_pallas_bwd.plan_adjoint_shr`` result or
   LAZY_ADJ — d planes runs on the Pallas backward; d homs stays on the
-  XLA path, DCE'd under jit when pose gradients are unused). Plans above
-  the base slice level always take the XLA backward: the backward warp
-  kernel runs the base geometry, and re-sampling a wide-slice pose with
-  it would drop taps (same convention as the banded tier — the XLA VJP
-  is always correct, just slower)."""
+  XLA path, DCE'd under jit when pose gradients are unused). The backward
+  re-warp runs the same ``(slc, bandg)`` slice-ladder level the forward
+  planned, so every shared-envelope pose has a Pallas backward; the
+  adjoint warp-transpose kernel plans its own geometry over the inverse
+  map (``plan_adjoint_shr``), independent of the forward's level."""
 
   @jax.custom_vjp
   def shared(planes, homs):
@@ -1573,15 +1580,14 @@ def _make_shared(n_taps: int, n_windows: int,
 
   def bwd(res, g):
     planes, homs = res
-    plan = (_resolve_adj(adj_plan, planes, homs, separable=False)
-            if (slc, bandg) == (G_SHARED, G_BAND) else None)
+    plan = _resolve_adj(adj_plan, planes, homs, separable=False)
     if plan is None:
       _, vjp = jax.vjp(_reference_render_batch, planes, homs)
       return vjp(g)
     from mpi_vision_tpu.kernels import render_pallas_bwd
     dplanes = render_pallas_bwd.backward_planes(
-        planes, homs, g, separable=False, fwd_plan=(n_taps, n_windows),
-        adj_plan=plan)
+        planes, homs, g, separable=False,
+        fwd_plan=(n_taps, n_windows, slc, bandg), adj_plan=plan)
     _, vjp_h = jax.vjp(lambda hh: _reference_render_batch(planes, hh), homs)
     (dhoms,) = vjp_h(g)
     return dplanes, dhoms
@@ -1689,11 +1695,11 @@ def plan_fused(homs, height: int, width: int):
                 adj_plan=render_pallas_bwd.plan_adjoint_sep(homs, hp, wp))
   plan = _plan_shared(homs, hp, wp)
   if plan is not None:
-    # Wide-slice plans take the XLA backward (the backward warp kernel
-    # runs the base geometry only); don't pay adjoint planning for them.
-    adj = (render_pallas_bwd.plan_adjoint_shr(homs, hp, wp)
-           if (plan[2], plan[3]) == (G_SHARED, G_BAND) else None)
-    return dict(separable=False, plan=plan, adj_plan=adj)
+    # The backward re-warp runs the planned slice level and the adjoint
+    # kernel plans its own inverse-map geometry, so every shared-envelope
+    # pose gets a Pallas backward when the adjoint planner accepts it.
+    return dict(separable=False, plan=plan,
+                adj_plan=render_pallas_bwd.plan_adjoint_shr(homs, hp, wp))
   bplan = _plan_banded(homs, hp, wp)
   if bplan is None:
     return None
@@ -1901,7 +1907,15 @@ def _render_mpi_fused_batch(planes, homs, np_homs, separable, check, plan,
     return _make_banded(plan[1:])(planes, homs)
   adj = _default_adj(render_pallas_bwd.plan_adjoint_shr)
   if plan is PLAN_UNSET:
-    n_taps, n_windows, slc, bandg = 3, 3, G_SHARED, G_BAND
+    # Conservative static maximum: 3 taps, 3 windows, and the TOP usable
+    # slice-ladder level — its vertical coverage is a superset of every
+    # lower level's, so any pose the shared planner would accept at ANY
+    # level renders correctly here (a fits_envelope=True caller may sit
+    # anywhere on the ladder). Costs more DMA than a planned call; poses
+    # that only the banded tier covers still need an explicit
+    # ("banded", ...) plan from plan_fused.
+    n_taps, n_windows = 3, 3
+    slc, bandg = _shared_levels(height)[-1]
   else:
     # Legacy 2-tuple plans run the base slice level; _plan_shared /
     # plan_fused emit 4-tuples naming the slice-ladder level.

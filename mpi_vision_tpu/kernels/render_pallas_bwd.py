@@ -120,7 +120,7 @@ def _warp_sep_call(planes, homs, n_windows: int, interpret: bool):
 def _warp_shr_kernel(hom_ref, meta_ref, meta_next_ref, wq_ref, planes_ref,
                      out_ref, band_ref, sems,
                      *, num_planes, height, width, n_windows, n_taps, tw,
-                     tsrc, bandg):
+                     tsrc, bandg, slc):
   """Shared-gather (general homography) warp of every plane."""
   bi = pl.program_id(0)
   s = pl.program_id(1)
@@ -173,22 +173,24 @@ def _warp_shr_kernel(hom_ref, meta_ref, meta_next_ref, wq_ref, planes_ref,
     sl = slice(ci * CHUNK, (ci + 1) * CHUNK)
     pix = rp._shr_chunk_sample(u[:, sl], v[:, sl], band_ref, slot, ymin,
                                xmin, q0, w0, n_taps, n_windows, height,
-                               width)
+                               width, slc)
     cols = pl.ds(pl.multiple_of(ci * CHUNK, CHUNK), CHUNK)
     for c in range(4):
       out_ref[0, 0, c, :, cols] = pix[c]
 
 
 @functools.partial(
-    jax.jit, static_argnames=("n_taps", "n_windows", "interpret"))
+    jax.jit, static_argnames=("n_taps", "n_windows", "interpret", "slc",
+                              "bandg"))
 def _warp_shr_call(planes, homs, n_taps: int, n_windows: int,
-                   interpret: bool):
-  grid, in_specs, operands, g = rp._shared_grid_setup(planes, homs,
-                                                      n_windows)
+                   interpret: bool, slc: int = rp.G_SHARED,
+                   bandg: int = rp.G_BAND):
+  grid, in_specs, operands, g = rp._shared_grid_setup(
+      planes, homs, n_windows, slc=slc, bandg=bandg)
   kernel = functools.partial(
       _warp_shr_kernel, num_planes=g["num_planes"], height=g["height"],
       width=g["width"], n_windows=g["n_eff"], n_taps=n_taps, tw=g["tw"],
-      tsrc=g["tsrc"], bandg=g["bandg"])
+      tsrc=g["tsrc"], bandg=g["bandg"], slc=g["slc"])
   return pl.pallas_call(
       kernel,
       grid=grid,
@@ -211,13 +213,20 @@ def warp_planes_fused(planes, homs, separable: bool,
   """Warp every plane (no composite): ``[B, P, 4, H, W]`` warped stack.
 
   ``fwd_plan`` is the forward kernel-variant choice: ``n_windows`` (int)
-  for the separable path, ``(n_taps, n_windows)`` for the general path.
+  for the separable path, a ``_plan_shared`` result for the general path —
+  ``(n_taps, n_windows, slc, bandg)`` naming the SHARED_LEVELS slice-
+  ladder level, or a legacy ``(n_taps, n_windows)`` 2-tuple running the
+  base level. The warp re-runs exactly the slice geometry the forward
+  planned, so every pose the shared forward accepts has a Pallas re-warp.
   """
   interpret = jax.default_backend() != "tpu"
   if separable:
     return _warp_sep_call(planes, homs, fwd_plan, interpret)
-  n_taps, n_windows = fwd_plan
-  return _warp_shr_call(planes, homs, n_taps, n_windows, interpret)
+  n_taps, n_windows = fwd_plan[:2]
+  slc, bandg = (fwd_plan[2:] if len(fwd_plan) == 4
+                else (rp.G_SHARED, rp.G_BAND))
+  return _warp_shr_call(planes, homs, n_taps, n_windows, interpret,
+                        int(slc), int(bandg))
 
 
 # ---------------------------------------------------------------------------
@@ -520,7 +529,7 @@ def _shifted_scalars(hom, dx, dy):
 def _adjoint_shr_kernel(hom_ref, meta_ref, meta_next_ref, wq_ref, grad_ref,
                         homf_ref, out_ref, band_ref, sems,
                         *, num_planes, height, width, n_windows, n_tx,
-                        n_ty, tw, tsrc, bandg):
+                        n_ty, tw, tsrc, bandg, slc):
   """General warp transpose on 2-D source tiles.
 
   ``hom_ref`` holds the INVERSE homographies (fan origins + tables);
@@ -596,12 +605,11 @@ def _adjoint_shr_kernel(hom_ref, meta_ref, meta_next_ref, wq_ref, grad_ref,
       for wi in range(n_windows):
         rel = rel0 - wi * WIN
         inw = (rel >= 0) & (rel < WIN)
-        idx = jnp.broadcast_to(jnp.clip(rel, 0, WIN - 1),
-                               (rp.G_SHARED, CHUNK))
+        idx = jnp.broadcast_to(jnp.clip(rel, 0, WIN - 1), (slc, CHUNK))
         base = pl.multiple_of(w0 + wi * WIN, WIN)
         outs = []
         for ch in range(4):
-          win = band_ref[slot, ch, pl.ds(q0, rp.G_SHARED), pl.ds(base, WIN)]
+          win = band_ref[slot, ch, pl.ds(q0, slc), pl.ds(base, WIN)]
           g = jnp.take_along_axis(win, idx, axis=1)
           outs.append(jnp.where(inw, g, 0.0))
         xle = outs if xle is None else [a + o for a, o in zip(xle, outs)]
@@ -622,7 +630,7 @@ def _adjoint_shr_kernel(hom_ref, meta_ref, meta_next_ref, wq_ref, grad_ref,
         qi = it - (ymin + q0)
         for ch in range(4):
           sel = jnp.zeros((STRIP, CHUNK), jnp.float32)
-          for k in range(rp.G_SHARED // 8):
+          for k in range(slc // 8):
             vreg = xle[ch][8 * k:8 * (k + 1)]            # [8, CHUNK]
             gk = jnp.take_along_axis(vreg, jnp.clip(qi - 8 * k, 0, 7),
                                      axis=0)
@@ -654,20 +662,22 @@ def _union_mins_fn(height, width, tw):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("n_tx", "n_ty", "n_windows", "interpret"))
+    jax.jit, static_argnames=("n_tx", "n_ty", "n_windows", "interpret",
+                              "slc", "bandg"))
 def _adjoint_shr_call(grad_warped, homs, n_tx: int, n_ty: int,
-                      n_windows: int, interpret: bool):
+                      n_windows: int, interpret: bool,
+                      slc: int = rp.G_SHARED, bandg: int = rp.G_BAND):
   batch, num_planes, _, height, width = grad_warped.shape
   homs32 = homs.reshape(batch, num_planes, 3, 3).astype(jnp.float32)
   hinv = _inv_homs(homs32)
   tw = rp._tile_sizes(height, width, n_windows)[0]
   grid, in_specs, operands, g = rp._shared_grid_setup(
       grad_warped, hinv.reshape(batch, num_planes, 9), n_windows,
-      mins_fn=_union_mins_fn(height, width, tw))
+      mins_fn=_union_mins_fn(height, width, tw), slc=slc, bandg=bandg)
   kernel = functools.partial(
       _adjoint_shr_kernel, num_planes=g["num_planes"], height=g["height"],
       width=g["width"], n_windows=g["n_eff"], n_tx=n_tx, n_ty=n_ty,
-      tw=g["tw"], tsrc=g["tsrc"], bandg=g["bandg"])
+      tw=g["tw"], tsrc=g["tsrc"], bandg=g["bandg"], slc=g["slc"])
   return pl.pallas_call(
       kernel,
       grid=grid,
@@ -692,7 +702,8 @@ def _plan_adjoint_shr_stats(homs: jnp.ndarray, height: int, width: int):
   Mirrors ``_plan_shared_stats``'s strategy on the INVERSE homographies
   with the 4-shift union extents: the very f32 values the adjoint call's
   tables and the kernel's fan origins see. Returns (den_ok, span_x,
-  span_y, v_ok, h2_ok, h3_ok).
+  span_y, v_oks, h2_ok, h3_ok) — ``v_oks`` one per
+  ``_shared_levels(height)`` slice-ladder level, as the forward's.
   """
   h9 = homs.reshape(-1, 3, 3).astype(jnp.float32)
   p = h9.shape[0]
@@ -710,7 +721,6 @@ def _plan_adjoint_shr_stats(homs: jnp.ndarray, height: int, width: int):
 
   tw, _, bandg, _ = rp._tile_sizes(height, width, 2)
   n_strips = height // STRIP
-  slice_rows = min(rp.G_SHARED, bandg)
   shifts = _shift_matrices()
   stack = jnp.einsum("pij,kjl->kpil", hinv, shifts)       # [4, P, 3, 3]
   mins = rp._corner_mins_union(stack, height, width, tw)
@@ -747,15 +757,19 @@ def _plan_adjoint_shr_stats(homs: jnp.ndarray, height: int, width: int):
             - jnp.floor(i_lo_px - tol).astype(jnp.int32)).max()
 
   chunk_of_col = jnp.arange(width) // CHUNK
-  _, _, ymin_c2, _, _, q0_2 = rp._table_scalars(
-      mins, height, width, tw, min(width, 640), bandg,
-      min(2, min(width, 640) // WIN))
-  ymq = ((ymin_c2 + q0_2)[:, :, chunk_of_col]).astype(jnp.float32)
   empty_v = (i_hi <= -1) | (i_lo >= height)
-  v_ok = (empty_v | (
-      (jnp.maximum(i_lo, 0.0) >= ymq - tol)
-      & (jnp.minimum(i_hi, height - 1.0)
-         <= ymq + slice_rows - 1 + tol))).all()
+  # Vertical coverage per slice-ladder level (ymin/q0 shift with the
+  # level's bandg/slc), exactly as the forward's _plan_shared_stats.
+  v_oks = []
+  for slc_l, bandg_l in rp._shared_levels(height):
+    _, _, ymin_cl, _, _, q0_l = rp._table_scalars(
+        mins, height, width, tw, min(width, 640), bandg_l,
+        min(2, min(width, 640) // WIN), slc_l)
+    ymq = ((ymin_cl + q0_l)[:, :, chunk_of_col]).astype(jnp.float32)
+    v_oks.append((empty_v | (
+        (jnp.maximum(i_lo, 0.0) >= ymq - tol)
+        & (jnp.minimum(i_hi, height - 1.0)
+           <= ymq + slc_l - 1 + tol))).all())
 
   empty_h = (j_hi <= -1) | (j_lo >= width)
   h_oks = []
@@ -768,11 +782,14 @@ def _plan_adjoint_shr_stats(homs: jnp.ndarray, height: int, width: int):
         (jnp.maximum(j_lo, 0.0) >= xmw - tol)
         & (jnp.minimum(j_hi, width - 1.0)
            <= xmw + n_eff * WIN - 1 + tol))).all())
-  return den_ok, span_x, span_y, v_ok, h_oks[0], h_oks[1]
+  return den_ok, span_x, span_y, tuple(v_oks), h_oks[0], h_oks[1]
 
 
 def plan_adjoint_shr(homs, height: int, width: int):
-  """Static ``(n_tx, n_ty, n_windows)`` for the general adjoint, or None.
+  """Static ``(n_tx, n_ty, n_windows, slc, bandg)`` for the general
+  adjoint, or None — the last two name the SHARED_LEVELS slice-ladder
+  level the adjoint's inverse-map geometry needs (chosen cheapest-first,
+  independently of the forward's level).
 
   The tap fans must cover the shift-union contributor extents: ``span + 1``
   taps each way, capped at 5 (beyond that the pose is cheaper on the XLA
@@ -788,19 +805,23 @@ def _plan_adjoint_shr_uncached(homs: np.ndarray, height: int, width: int):
   # ensure_compile_time_eval: callers may sit under an ambient jit trace
   # (concrete homs as jit constants); the stats must still run eagerly.
   with jax.ensure_compile_time_eval():
-    den_ok, span_x, span_y, v_ok, h2, h3 = jax.device_get(
+    den_ok, span_x, span_y, v_oks, h2, h3 = jax.device_get(
         _plan_adjoint_shr_stats(jnp.asarray(homs), height, width))
-  if not den_ok or not v_ok:
+  if not den_ok:
     return None
   # +1 to cover the span; vertical +1 more as the interior-row safety tap
   # (the stats sample per-pixel spreads at strip-edge rows only).
   n_tx, n_ty = int(span_x) + 1, int(span_y) + 2
   if n_tx > 5 or n_ty > 5:
     return None
-  if h2:
-    return n_tx, n_ty, 2
-  if h3:
-    return n_tx, n_ty, 3
+  n_windows = 2 if h2 else 3 if h3 else None
+  if n_windows is None:
+    return None
+  # Cheapest covering slice-ladder level first, as the forward planner:
+  # gather traffic is linear in the slice height.
+  for (slc, bandg), v_ok in zip(rp._shared_levels(height), v_oks):
+    if v_ok:
+      return n_tx, n_ty, n_windows, int(slc), int(bandg)
   return None
 
 
@@ -814,12 +835,16 @@ def backward_planes(planes, homs, g, separable: bool, fwd_plan,
   VJP, warp transpose. All arguments batched (``[B, P, 4, H, W]`` planes,
   ``[B, P, 3, 3]`` homs, ``[B, 3, H, W]`` g). ``adj_plan`` comes from
   ``plan_adjoint_sep`` (separable: ``(n_taps, n_windows)``) or
-  ``plan_adjoint_shr`` (general: ``(n_tx, n_ty, n_windows)``)."""
+  ``plan_adjoint_shr`` (general: ``(n_tx, n_ty, n_windows, slc, bandg)``,
+  slice-ladder level last; legacy 3-tuples run the base level)."""
   interpret = jax.default_backend() != "tpu"
   warped = warp_planes_fused(planes, homs, separable, fwd_plan)
   dwarped = _composite_bwd(warped, g)
   if separable:
     n_taps, n_windows = adj_plan
     return _adjoint_sep_call(dwarped, homs, n_taps, n_windows, interpret)
-  n_tx, n_ty, n_windows = adj_plan
-  return _adjoint_shr_call(dwarped, homs, n_tx, n_ty, n_windows, interpret)
+  n_tx, n_ty, n_windows = adj_plan[:3]
+  slc, bandg = (adj_plan[3:] if len(adj_plan) == 5
+                else (rp.G_SHARED, rp.G_BAND))
+  return _adjoint_shr_call(dwarped, homs, n_tx, n_ty, n_windows, interpret,
+                           int(slc), int(bandg))

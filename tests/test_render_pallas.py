@@ -576,3 +576,77 @@ class TestBandedTier:
       if rp._plan_banded(np.asarray(homs), h, w) is not None:
         accepted += 1
     assert accepted >= 4, f"banded tier accepted only {accepted}/12 poses"
+
+
+def _roll_homs(h, w, p, deg, tx=0.0):
+  """In-plane roll: v drifts with the tile column, escalating the
+  SHARED_LEVELS slice ladder at small geometries (3 deg -> (32, 48),
+  6 deg -> (40, 64) at 64x384; 9+ deg falls to the banded tier)."""
+  rz = np.radians(deg)
+  pose = np.eye(4, dtype=np.float32)
+  c, s = np.cos(rz), np.sin(rz)
+  pose[:3, :3] = np.array([[c, -s, 0], [s, c, 0], [0, 0, 1]], np.float32)
+  pose[0, 3] = tx
+  depths = inv_depths(1.0, 100.0, p)
+  return rp.pixel_homographies(
+      jnp.asarray(pose)[None], depths, _intrinsics(h, w), h, w)[:, 0]
+
+
+class TestSharedLadderLevels:
+  """Parity coverage for the wide-slice SHARED_LEVELS ladder (round-4
+  forward variants that previously only the TPU bench would exercise)."""
+
+  @pytest.mark.parametrize("deg,level", [(3.0, (32, 48)), (6.0, (40, 64))])
+  def test_wide_level_parity_vs_reference(self, rng, deg, level):
+    p, h, w = 3, 64, 384
+    planes = _mpi(rng, p, h, w)
+    homs = _roll_homs(h, w, p, deg)
+    plan = rp._plan_shared(homs, h, w)
+    assert plan is not None and (plan[2], plan[3]) == level, (
+        f"roll {deg} deg planned {plan}; expected level {level}")
+    got = rp._SHARED[plan](planes[None], homs[None])[0]
+    want = rp.reference_render(planes, homs)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=1e-3, rtol=0)
+
+  def test_checked_dispatch_walks_the_ladder(self, rng):
+    """render_mpi_fused(check=True) on a wide-ladder pose matches the
+    reference (the checked path plans and runs the wide level)."""
+    p, h, w = 3, 64, 384
+    planes = _mpi(rng, p, h, w)
+    homs = _roll_homs(h, w, p, 6.0)
+    got = rp.render_mpi_fused(planes, homs, separable=False)
+    want = rp.reference_render(planes, homs)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=1e-3, rtol=0)
+
+  def test_unplanned_unchecked_conservative_covers_ladder(self, rng):
+    """check=False with NO plan runs the top-ladder conservative kernel:
+    a pose that plans a wide level must still render correctly (the
+    PLAN_UNSET default used to run the base level and would drop taps)."""
+    p, h, w = 3, 64, 384
+    planes = _mpi(rng, p, h, w)
+    homs = _roll_homs(h, w, p, 6.0)
+    assert rp.fits_envelope(homs, h, w, False)
+    got = jax.jit(
+        lambda pl_, hh: rp.render_mpi_fused(pl_, hh, separable=False,
+                                            check=False))(planes, homs)
+    want = rp.reference_render(planes, homs)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=1e-3, rtol=0)
+
+  def test_wide_level_gradients_match_xla(self, rng):
+    """End-to-end grad through the checked dispatch at a wide ladder
+    level (the restored Pallas backward for above-base poses)."""
+    p, h, w = 2, 64, 384
+    planes = _mpi(rng, p, h, w)
+    homs = _roll_homs(h, w, p, 3.0)
+    plan = rp._plan_shared(homs, h, w)
+    assert plan is not None and (plan[2], plan[3]) != (rp.G_SHARED,
+                                                       rp.G_BAND)
+    g_got = jax.grad(
+        lambda x: rp.render_mpi_fused(x, homs, separable=False).sum())(
+            planes)
+    g_ref = jax.grad(lambda x: rp.reference_render(x, homs).sum())(planes)
+    np.testing.assert_allclose(
+        np.asarray(g_got), np.asarray(g_ref), atol=1e-3, rtol=0)

@@ -43,6 +43,20 @@ def _homs(h, w, p=4, **pose_kw):
       _pose(**pose_kw), depths, _intrinsics(h, w), h, w)[:, 0]
 
 
+def _roll_homs(h, w, p, deg, tx=0.0):
+  """In-plane roll: v drifts with the tile column, escalating the
+  SHARED_LEVELS slice ladder at small geometries (3 deg -> (32, 48),
+  6 deg -> (40, 64) at 64x384)."""
+  rz = np.radians(deg)
+  pose = np.eye(4, dtype=np.float32)
+  c, s = np.cos(rz), np.sin(rz)
+  pose[:3, :3] = np.array([[c, -s, 0], [s, c, 0], [0, 0, 1]], np.float32)
+  pose[0, 3] = tx
+  depths = inv_depths(1.0, 100.0, p)
+  return rp.pixel_homographies(
+      jnp.asarray(pose)[None], depths, _intrinsics(h, w), h, w)[:, 0]
+
+
 def _reference_warp(planes, homs):
   """Per-plane XLA warp (reference_render without the composite)."""
   from mpi_vision_tpu.core import geometry, sampling
@@ -184,8 +198,9 @@ class TestBackwardPlanesGeneral:
     h, w = 32, 256
     plan = rpb.plan_adjoint_shr(_homs(h, w, **ROTATION), h, w)
     assert plan is not None
-    n_tx, n_ty, n_windows = plan
+    n_tx, n_ty, n_windows, slc, bandg = plan
     assert 2 <= n_tx <= 5 and 2 <= n_ty <= 5 and n_windows in (2, 3)
+    assert (slc, bandg) in rp._shared_levels(h)
 
   def test_property_random_rotation_poses(self, rng):
     """Accepted general poses' Pallas backward matches the XLA VJP."""
@@ -298,4 +313,70 @@ class TestFusedVjpIntegration:
     assert calls, "jit-constant-pose gradient fell back to the XLA VJP"
     want = jax.grad(lambda pl_: jnp.sum(
         rp.reference_render(pl_, homs) ** 2))(planes)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-3)
+
+
+class TestPlanFormatCompat:
+  """Pin the plan-format contract between render_pallas and
+  render_pallas_bwd: whatever ``_plan_shared`` returns must feed
+  ``warp_planes_fused``/``backward_planes`` verbatim (the round-4 banded
+  commit widened the tuple and crashed this path)."""
+
+  def test_plan_shared_tuple_feeds_backward_verbatim(self, rng):
+    p, h, w = 3, 32, 256
+    homs = _homs(h, w, p, **ROTATION)
+    plan = rp._plan_shared(homs, h, w)
+    assert plan is not None and len(plan) == 4
+    planes = _mpi(rng, p, h, w, batch=1)
+    warped = rpb.warp_planes_fused(planes, homs[None], False, plan)
+    assert warped.shape == (1, p, 4, h, w)
+
+  def test_legacy_two_tuple_still_accepted(self, rng):
+    p, h, w = 3, 32, 256
+    homs = _homs(h, w, p, **ROTATION)
+    plan = rp._plan_shared(homs, h, w)
+    assert plan is not None
+    planes = _mpi(rng, p, h, w, batch=1)
+    got4 = rpb.warp_planes_fused(planes, homs[None], False, plan)
+    got2 = rpb.warp_planes_fused(planes, homs[None], False, plan[:2])
+    # At the base ladder level the two spellings run identical geometry.
+    if (plan[2], plan[3]) == (rp.G_SHARED, rp.G_BAND):
+      np.testing.assert_allclose(np.asarray(got4), np.asarray(got2),
+                                 atol=1e-6)
+
+  @pytest.mark.parametrize("deg,level", [(3.0, (32, 48)), (6.0, (40, 64))])
+  def test_wide_slice_plan_runs_planned_geometry(self, rng, deg, level):
+    """A pose whose plan sits ABOVE the base slice level re-warps through
+    the planned wide-slice geometry and matches the XLA warp (this is the
+    pose class render_pallas.py used to silently demote to the XLA
+    backward). Roll drives v-drift across a tile, escalating the ladder."""
+    p, h, w = 3, 64, 384
+    homs = _roll_homs(h, w, p, deg)
+    plan = rp._plan_shared(homs, h, w)
+    assert plan is not None, "probe pose fell out of the shared envelope"
+    assert (plan[2], plan[3]) == level, (
+        f"roll {deg} deg planned {plan}; expected ladder level {level}")
+    planes = _mpi(rng, p, h, w, batch=1)
+    got = rpb.warp_planes_fused(planes, homs[None], False, plan)[0]
+    want = _reference_warp(planes[0], homs)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-3)
+
+  def test_wide_slice_backward_planes_matches_xla_vjp(self, rng):
+    """backward_planes with a wide-slice forward plan + independently
+    planned adjoint matches the XLA VJP — the restored Pallas backward
+    for above-base poses."""
+    p, h, w = 3, 64, 384
+    homs = _roll_homs(h, w, p, 3.0)
+    fwd_plan = rp._plan_shared(homs, h, w)
+    assert fwd_plan is not None and (fwd_plan[2], fwd_plan[3]) != (
+        rp.G_SHARED, rp.G_BAND)
+    adj_plan = rpb.plan_adjoint_shr(homs, h, w)
+    if adj_plan is None:
+      pytest.skip("adjoint planner rejected the roll pose")
+    planes = _mpi(rng, p, h, w, batch=1)
+    g = jnp.asarray(rng.normal(size=(1, 3, h, w)).astype(np.float32))
+    got = rpb.backward_planes(planes, homs[None], g, False, fwd_plan,
+                              adj_plan)
+    _, vjp = jax.vjp(rp._reference_render_batch, planes, homs[None])
+    want, _ = vjp(g)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-3)

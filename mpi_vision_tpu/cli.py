@@ -97,17 +97,39 @@ def cmd_train(args: argparse.Namespace) -> dict:
     cfg = dataclasses.replace(cfg, learning_rate=lr_found)
     state = cfg.make_train_state(jax.random.PRNGKey(args.seed))
 
-  if args.lr_find and args.vgg_loss:
-    # Reuse the sweep's resolved VGG params (default_params() can load an
-    # orbax checkpoint from disk — don't do that twice).
-    step = cfg.make_train_step(sweep_vgg, planned=args.planned_render)
-  else:
-    step = cfg.make_train_step("default" if args.vgg_loss else None,
-                               planned=args.planned_render)
+  # Resolve VGG params ONCE and share them between the train and eval
+  # steps (default_params() can load an orbax checkpoint from disk).
+  vgg_params = None
+  if args.vgg_loss:
+    if args.lr_find:
+      vgg_params = sweep_vgg
+    else:
+      from mpi_vision_tpu.train import vgg as vgg_lib
+
+      vgg_params = vgg_lib.default_params()
+  step = cfg.make_train_step(vgg_params, planned=args.planned_render)
+
+  # Per-epoch validation on the test split's FIXED triplets (the reference
+  # reports train AND valid loss each epoch — cell 16's table, final valid
+  # 1.3152 — on the same loss surface as training).
+  valid_batches, eval_step = [], None
+  if args.valid:
+    valid_ds = cfg.data.make_dataset(is_valid=True)
+    if len(valid_ds):
+      # Cache as host numpy (not device arrays): a large test split held
+      # on-device for the whole run would add permanent HBM pressure; the
+      # eval step transfers per epoch instead.
+      valid_batches = [jax.tree.map(np.asarray, b)
+                       for b in realestate.iterate_batches(
+                           valid_ds, batch_size=cfg.data.batch_size,
+                           shuffle=False)]
+      eval_step = cfg.make_eval_step(vgg_params)
+    else:
+      _log("valid: test split empty; skipping per-epoch validation")
 
   order = np.random.default_rng(args.seed + 1)
   t0 = time.time()
-  all_losses = []
+  all_losses, valid_losses = [], []
   for epoch in range(cfg.epochs):
     state, losses = train_loop.fit(
         state, realestate.prefetch_batches(realestate.iterate_batches(
@@ -115,8 +137,12 @@ def cmd_train(args: argparse.Namespace) -> dict:
         step=step)
     all_losses.extend(losses)
     if losses:
-      _log(f"epoch {epoch}: mean loss {np.mean(losses):.4f} "
-           f"({time.time() - t0:.0f}s elapsed)")
+      msg = (f"epoch {epoch}: train loss {np.mean(losses):.4f}")
+      if valid_batches:
+        valid_losses.append(train_loop.evaluate(
+            state, valid_batches, eval_step))
+        msg += f" valid loss {valid_losses[-1]:.4f}"
+      _log(msg + f" ({time.time() - t0:.0f}s elapsed)")
   if not all_losses:
     raise SystemExit(
         "no training steps ran: check --epochs and that the dataset has at "
@@ -148,6 +174,9 @@ def cmd_train(args: argparse.Namespace) -> dict:
       "steps": len(all_losses),
       "first_loss": round(all_losses[0], 5),
       "final_loss": round(all_losses[-1], 5),
+      **({"first_valid_loss": round(valid_losses[0], 5),
+          "final_valid_loss": round(valid_losses[-1], 5)}
+         if valid_losses else {}),
       "seconds": round(time.time() - t0, 1),
   }
 
@@ -201,6 +230,10 @@ def build_parser() -> argparse.ArgumentParser:
                  default=False,
                  help="run the U-Net and VGG-loss convs in bfloat16 on the "
                       "MXU (params/optimizer state stay f32)")
+  t.add_argument("--valid", action=argparse.BooleanOptionalAction,
+                 default=True,
+                 help="evaluate the test split's fixed triplets each epoch "
+                      "(the reference's per-epoch valid loss, cell 16)")
   t.add_argument("--seed", type=int, default=0)
   t.add_argument("--ckpt", default="", help="orbax checkpoint directory")
   t.add_argument("--export-html", default="",

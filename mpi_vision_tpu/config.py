@@ -72,17 +72,12 @@ class TrainConfig:
         image_size=(self.data.img_size, self.data.img_size),
         learning_rate=self.learning_rate, norm=self.norm, dtype=dtype)
 
-  def make_train_step(self, vgg_params="default", planned: bool = False):
-    """Jitted train step with the reference loss. ``vgg_params='default'``
-    resolves ``train.vgg.default_params()`` (a real checkpoint when
-    ``MPI_VISION_VGG16_CKPT`` points at one, else the fixed fallback);
-    pass ``None`` for the L2-only metric loss. ``planned=True`` renders the
-    loss through the fused Pallas kernels forward AND backward, planning
-    each batch's poses on the host (``train.loop.make_train_step_planned``;
-    out-of-envelope batches fall back to the XLA step)."""
+  def _resolve_loss_params(self, vgg_params):
+    """Shared train/eval loss-surface resolution: ``'default'`` ->
+    ``train.vgg.default_params()``, ``compute_dtype`` -> jnp dtype. One
+    helper so the valid-loss column can never diverge from the training
+    loss surface."""
     from mpi_vision_tpu.train import vgg
-    from mpi_vision_tpu.train.loop import (make_train_step,
-                                           make_train_step_planned)
 
     if isinstance(vgg_params, str) and vgg_params == "default":
       vgg_params = vgg.default_params()
@@ -91,11 +86,35 @@ class TrainConfig:
       import jax.numpy as jnp
 
       vgg_dtype = jnp.dtype(self.compute_dtype)
+    return vgg_params, vgg_dtype
+
+  def make_train_step(self, vgg_params="default", planned: bool = False):
+    """Jitted train step with the reference loss. ``vgg_params='default'``
+    resolves ``train.vgg.default_params()`` (a real checkpoint when
+    ``MPI_VISION_VGG16_CKPT`` points at one, else the fixed fallback);
+    pass ``None`` for the L2-only metric loss. ``planned=True`` renders the
+    loss through the fused Pallas kernels forward AND backward, planning
+    each batch's poses on the host (``train.loop.make_train_step_planned``;
+    out-of-envelope batches fall back to the XLA step)."""
+    from mpi_vision_tpu.train.loop import (make_train_step,
+                                           make_train_step_planned)
+
+    vgg_params, vgg_dtype = self._resolve_loss_params(vgg_params)
     if planned:
       return make_train_step_planned(vgg_params, resize=self.vgg_resize,
                                      vgg_dtype=vgg_dtype)
     return make_train_step(vgg_params, resize=self.vgg_resize,
                            vgg_dtype=vgg_dtype)
+
+  def make_eval_step(self, vgg_params="default"):
+    """Jitted loss-only step on the same loss surface as
+    ``make_train_step`` (the valid column of the reference's cell-16
+    table). ``vgg_params`` resolves as in ``make_train_step``."""
+    from mpi_vision_tpu.train.loop import make_eval_step
+
+    vgg_params, vgg_dtype = self._resolve_loss_params(vgg_params)
+    return make_eval_step(vgg_params, resize=self.vgg_resize,
+                          vgg_dtype=vgg_dtype)
 
 
 @dataclasses.dataclass(frozen=True)

@@ -347,6 +347,37 @@ def lr_find(state: TrainState, batches,
           "suggestion": suggestion}
 
 
+def make_eval_step(vgg_params: Any | None = None,
+                   resize: int | None = 224,
+                   vgg_dtype: Any = None):
+  """A jitted loss-only ``(state, batch) -> loss`` step (no gradients).
+
+  The same loss surface as ``make_train_step`` (VGG-perceptual when
+  ``vgg_params`` given, else L2) evaluated without the update — the
+  per-epoch valid column of the reference's training table (notebook
+  cell 16: fastai reports train AND valid loss each epoch; final valid
+  1.3152)."""
+  loss_fn = make_loss_fn(vgg_params, resize, vgg_dtype=vgg_dtype)
+
+  @jax.jit
+  def step(state: TrainState, batch: Batch):
+    return loss_fn(state.params, state.apply_fn, batch)
+
+  return step
+
+
+def evaluate(state: TrainState, batches, eval_step=None) -> float:
+  """Mean loss over an iterable of batches (losses stay on-device during
+  the loop; one fetch at the end)."""
+  import numpy as np
+
+  eval_step = eval_step or make_eval_step()
+  losses = [eval_step(state, batch) for batch in batches]
+  if not losses:
+    raise ValueError("evaluate: no batches")
+  return float(np.mean(jax.device_get(losses)))
+
+
 def fit(state: TrainState, batches, step=None, log_every: int = 0):
   """Minimal epoch driver over an iterable of batches; returns final state
   and the list of per-step losses.

@@ -97,3 +97,31 @@ def test_train_synthetic_planned_render(capsys, bf16):
   assert rc == 0
   out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
   assert out["steps"] == 2 and np.isfinite(out["final_loss"])
+
+
+def test_train_reports_valid_loss(capsys):
+  """Per-epoch validation on the test split's fixed triplets: the summary
+  carries first/final valid loss (the reference reports train AND valid
+  loss each epoch — notebook cell 16's table)."""
+  rc = cli.main([
+      "train", "--synthetic", "--synthetic-scenes", "2",
+      "--img-size", "32", "--num-planes", "4", "--epochs", "2",
+      "--no-vgg-loss",
+  ])
+  assert rc == 0
+  captured = capsys.readouterr()
+  out = json.loads(captured.out.strip().splitlines()[-1])
+  assert np.isfinite(out["first_valid_loss"])
+  assert np.isfinite(out["final_valid_loss"])
+  assert "valid loss" in captured.err
+
+
+def test_train_no_valid_omits_fields(capsys):
+  rc = cli.main([
+      "train", "--synthetic", "--synthetic-scenes", "2",
+      "--img-size", "32", "--num-planes", "4", "--epochs", "1",
+      "--no-vgg-loss", "--no-valid",
+  ])
+  assert rc == 0
+  out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+  assert "final_valid_loss" not in out

@@ -2,14 +2,22 @@
 
 Prints ONE JSON line on stdout (diagnostics go to stderr) with fields
 {"metric", "value", "unit", "vs_baseline", "separable_fps", "rotation_fps",
-"rot10_fps", "xla_fps", "eager_separable_fps", "eager_rotation_fps"}.
-``value`` is the WORST of the two real novel-view cases —
-separable (truck + dolly) and rotation (1-degree pan, the tiled general
-kernel) — because the renderer must treat arbitrary poses uniformly, as the
-reference does (utils.py:267-294). ``vs_baseline`` is that value relative to
-the BASELINE.json north-star target of 30 FPS on TPU v5e-1. Failed paths
-report null; a missing headline path is a hard failure (rc != 0), never a
-silently-inflated number.
+"rot10_fps", "banded_fps", "banded_deg", "xla_fps", "eager_separable_fps",
+"eager_rotation_fps"}. ``value`` is the WORST of the two real novel-view
+cases — separable (truck + dolly) and rotation (1-degree pan, the tiled
+general kernel) — because the renderer must treat arbitrary poses
+uniformly, as the reference does (utils.py:267-294). ``vs_baseline`` is
+that value relative to the BASELINE.json north-star target of 30 FPS on
+TPU v5e-1. Failed paths report null; a missing headline path is a hard
+failure (rc != 0), never a silently-inflated number.
+
+Tier fields beyond the headline: ``rot10_fps`` times a 10-degree pan —
+since the round-4 SHARED_LEVELS ladder this sits INSIDE the shared-gather
+envelope (a wide-slice level), so it measures the ladder's top, not the
+banded tier. ``banded_fps`` times the banded per-row middle tier at the
+smallest swept angle (14-24 deg) the shared ladder rejects — discovered at
+bench time so the field keeps naming the banded kernel even as the ladder
+envelope moves.
 
 The timed region is the full novel-view render (BASELINE config 4's per-chip
 work): 32 plane homographies + bilinear warps of 1920x1080 RGBA planes + the
@@ -71,18 +79,40 @@ def _make_inputs():
   homs_rot = render_pallas.pixel_homographies(
       jnp.asarray(rot)[None], depths, jnp.asarray(intrinsics)[None],
       HEIGHT, WIDTH)[:, 0]
-  # A 10-degree pan: far outside the shared kernel's envelope — the banded
-  # per-row middle tier's case (the reference renders it through the same
-  # grid_sample path as any other pose, utils.py:104-134).
-  rot10 = np.eye(4, dtype=np.float32)
-  c10, s10 = np.cos(np.radians(10.0)), np.sin(np.radians(10.0))
-  rot10[:3, :3] = [[c10, 0, s10], [0, 1, 0], [-s10, 0, c10]]
-  rot10[0, 3] = 0.05
-  homs_rot10 = render_pallas.pixel_homographies(
-      jnp.asarray(rot10)[None], depths, jnp.asarray(intrinsics)[None],
-      HEIGHT, WIDTH)[:, 0]
+  # A 10-degree pan: since the round-4 SHARED_LEVELS ladder this is a
+  # wide-slice SHARED pose (the ladder covers ~13 deg of yaw at 1080p) —
+  # it times the ladder's upper levels, not the banded tier.
+  homs_rot10 = _pan_homs(10.0, depths, intrinsics)
   return (planes, homs, homs_rot, homs_rot10, jnp.asarray(pose)[None],
           depths, jnp.asarray(intrinsics)[None])
+
+
+def _pan_homs(deg: float, depths, intrinsics):
+  rot = np.eye(4, dtype=np.float32)
+  c, s = np.cos(np.radians(deg)), np.sin(np.radians(deg))
+  rot[:3, :3] = [[c, 0, s], [0, 1, 0], [-s, 0, c]]
+  rot[0, 3] = 0.05
+  return render_pallas.pixel_homographies(
+      jnp.asarray(rot)[None], depths, jnp.asarray(intrinsics)[None],
+      HEIGHT, WIDTH)[:, 0]
+
+
+def _find_banded_pose(depths, intrinsics):
+  """Smallest swept pan angle the shared ladder rejects but the banded
+  tier covers (the reference renders ANY pose through one grid_sample
+  path, utils.py:104-134 — this is the graceful-degradation datapoint).
+  Returns (deg, homs); raises SystemExit if the sweep finds none (a
+  banded-tier envelope regression, not an infra flake)."""
+  for deg in (14.0, 16.0, 18.0, 20.0, 22.0, 24.0):
+    homs = _pan_homs(deg, depths, intrinsics)
+    if render_pallas._plan_shared(homs, HEIGHT, WIDTH) is not None:
+      continue
+    if render_pallas._plan_banded(homs, HEIGHT, WIDTH) is not None:
+      return deg, homs
+  raise SystemExit(
+      "no swept pan angle (14-24 deg) lands in the banded tier: either "
+      "the shared ladder now covers 24 deg (move the sweep) or the banded "
+      "envelope regressed")
 
 
 def _fps(fn, *args, iters: int = 30) -> float:
@@ -113,20 +143,31 @@ def main() -> None:
 
   # Guards so no field can mislabel which kernel ran: the truck+dolly case
   # must take the separable fast path, the 1-degree pan must be general AND
-  # inside the shared kernel's plan, and the 10-degree pan must land in the
-  # banded middle tier — else a field would silently time a different tier
-  # than its name claims. Explicit raises, not asserts: python -O must not
-  # strip them.
+  # inside the shared kernel's plan, and the 10-degree pan must be shared
+  # too (a wide-slice ladder level since round 4) — else a field would
+  # silently time a different tier than its name claims. Explicit raises,
+  # not asserts: python -O must not strip them. The banded-tier pose is
+  # discovered by sweep (_find_banded_pose), which enforces its own tier.
   if not render_pallas.is_separable(homs):
     raise SystemExit("truck+dolly homographies unexpectedly non-separable")
   if render_pallas.is_separable(homs_rot):
     raise SystemExit("rotation homographies unexpectedly separable")
   if render_pallas._plan_shared(homs_rot, HEIGHT, WIDTH) is None:
     raise SystemExit("rotation pose fell out of the shared-kernel envelope")
-  if render_pallas._plan_shared(homs_rot10, HEIGHT, WIDTH) is not None:
-    raise SystemExit("10-degree pose unexpectedly inside the shared plan")
-  if render_pallas._plan_banded(homs_rot10, HEIGHT, WIDTH) is None:
-    raise SystemExit("10-degree pose fell out of the banded-tier envelope")
+  plan10 = render_pallas._plan_shared(homs_rot10, HEIGHT, WIDTH)
+  if plan10 is None:
+    raise SystemExit(
+        "10-degree pose fell out of the shared ladder (it planned a "
+        "wide-slice level when this guard was written); re-point the "
+        "field at the tier it now lands in")
+  if (plan10[2], plan10[3]) == (render_pallas.G_SHARED,
+                                render_pallas.G_BAND):
+    raise SystemExit(
+        "10-degree pose planned the BASE slice level; rot10_fps claims to "
+        "time a wide-slice ladder level — re-point the field")
+  banded_deg, homs_banded = _find_banded_pose(depths, intrinsics)
+  print(f"bench: banded-tier pose = {banded_deg:.0f}-degree pan",
+        file=sys.stderr)
 
   def planned_renderer(case_homs, want):
     """Jit the planned render for one pose set (the steady-state API)."""
@@ -146,7 +187,8 @@ def main() -> None:
   for key, case_homs, want, iters in (
       ("separable", homs, "separable", 30),
       ("rotation", homs_rot, "shared", 30),
-      ("rot10", homs_rot10, "banded", 10),
+      ("rot10", homs_rot10, "shared", 10),
+      ("banded", homs_banded, "banded", 10),
   ):
     try:
       fn = planned_renderer(case_homs, want)
@@ -199,6 +241,8 @@ def main() -> None:
       "separable_fps": rnd("separable"),
       "rotation_fps": rnd("rotation"),
       "rot10_fps": rnd("rot10"),
+      "banded_fps": rnd("banded"),
+      "banded_deg": banded_deg,
       "xla_fps": rnd("xla_fused"),
       "eager_separable_fps": rnd("eager_separable"),
       "eager_rotation_fps": rnd("eager_rotation"),

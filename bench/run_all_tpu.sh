@@ -27,24 +27,24 @@ run() {
   echo "rc=$rc $(cat artifacts/${name}.json 2>/dev/null | tail -1)"
 }
 echo "battery start $stamp"
-run tpu_r04_headline bench.py
-run tpu_r04_config1 bench/config1_composite.py
-run tpu_r04_config2 bench/config2_render512.py
-run tpu_r04_config3 bench/config3_sweep.py
-run tpu_r04_config4 bench/config4_sharded.py
-run tpu_r04_config5 bench/config5_tiny_unet.py
-run tpu_r04_train_speed bench/train_speed.py
-run tpu_r04_render_bwd bench/render_bwd.py
+run tpu_r05_headline bench.py
+run tpu_r05_config1 bench/config1_composite.py
+run tpu_r05_config2 bench/config2_render512.py
+run tpu_r05_config3 bench/config3_sweep.py
+run tpu_r05_config4 bench/config4_sharded.py
+run tpu_r05_config5 bench/config5_tiny_unet.py
+run tpu_r05_train_speed bench/train_speed.py
+run tpu_r05_render_bwd bench/render_bwd.py
 # The reference training config end-to-end (VERDICT r3 item 5): 224 px,
 # 10 planes, synthetic scenes, planned Pallas render fwd+bwd in the loss,
 # viewer HTML of a validation MPI exported alongside.
-run tpu_r04_train_ref224 -m mpi_vision_tpu train --synthetic \
+run tpu_r05_train_ref224 -m mpi_vision_tpu train --synthetic \
     --synthetic-scenes 8 --img-size 224 --num-planes 10 --epochs 25 \
     --planned-render --lr-find --lr-find-steps 40 \
     --ckpt "$(pwd)/artifacts/train_ref224_ckpt" \
     --export-html artifacts/train_ref224_viewer.html
 # Random-VGG vs plain-L2 ablation at the reference config (VERDICT r3
 # item 9).
-run tpu_r04_ablate_vgg bench/ablate_vgg.py
-run tpu_r04_profile bench/profile_render.py
+run tpu_r05_ablate_vgg bench/ablate_vgg.py
+run tpu_r05_profile bench/profile_render.py
 echo "battery done $(date -u +%H:%M:%SZ)"

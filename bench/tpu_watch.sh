@@ -34,7 +34,7 @@ print(jax.devices())
       batteries=$((batteries + 1))
       missing=0
       for n in headline config1 config2 config3 config4 config5 train_speed render_bwd train_ref224 ablate_vgg profile; do
-        [ -s "artifacts/tpu_r04_${n}.json" ] || missing=$((missing + 1))
+        [ -s "artifacts/tpu_r05_${n}.json" ] || missing=$((missing + 1))
       done
       if [ "$missing" -eq 0 ]; then
         echo "battery complete $(date -u +%H:%M:%SZ)" >>"$log"

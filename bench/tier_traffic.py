@@ -1,0 +1,114 @@
+"""Which render tier do TRAINING batches actually hit? (VERDICT r4 item 7)
+
+The banded per-row middle tier keeps its XLA backward on the argument
+that training traffic rarely lands there (kernels/render_pallas.py,
+_make_banded docstring). With the SHARED_LEVELS slice ladder covering
+~13 degrees of yaw at 1080p, the banded tier now starts at rotations the
+stereo-magnification training distribution (notebook cell 8: consecutive
+RealEstate10K frames, timestamp window 16e3-500e3 microseconds) should
+essentially never produce. This script measures that claim instead of
+asserting it: plan every batch of a training epoch stream exactly as the
+planned train step does (train.loop.plan_batch_render) and count tiers.
+
+Prints ONE JSON line:
+  {"metric": "train_tier_banded_frac", "value": <fraction of batches in
+   the banded tier>, "separable": n, "shared_base": n, "shared_wide": n,
+   "banded": n, "xla": n, ...}
+and mirrors it to artifacts/tier_traffic.json when run from the repo.
+
+The dataset is the hermetic synthetic one (same generator the bench
+battery and train_ref224 use); poses are camera trucks, so expect the
+separable tier to dominate — the measurement exists to put a number on
+the banded share (and to be re-run against a real RealEstate10K layout
+via --dataset when one is available).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+  ap = argparse.ArgumentParser()
+  ap.add_argument("--dataset", default=None,
+                  help="RealEstate10K-layout root (default: synthesize)")
+  ap.add_argument("--img-size", type=int, default=224)   # cell 8:89
+  ap.add_argument("--num-planes", type=int, default=10)  # cell 8:90
+  ap.add_argument("--scenes", type=int, default=8)
+  ap.add_argument("--batches", type=int, default=200)
+  ap.add_argument("--seed", type=int, default=0)
+  args = ap.parse_args()
+
+  import numpy as np
+
+  from mpi_vision_tpu import config
+  from mpi_vision_tpu.data import realestate
+  from mpi_vision_tpu.kernels import render_pallas as rp
+  from mpi_vision_tpu.train.loop import plan_batch_render
+
+  t0 = time.time()
+  root = args.dataset
+  tmp = None
+  if root is None:
+    tmp = tempfile.TemporaryDirectory(prefix="mpi_tier_")
+    root = tmp.name
+    realestate.synthesize_dataset(root, num_scenes=args.scenes, frames=4,
+                                  img_size=args.img_size, seed=args.seed)
+  cfg = config.DataConfig(dataset_path=root, img_size=args.img_size,
+                          num_planes=args.num_planes)
+  dataset = cfg.make_dataset(rng=np.random.default_rng(args.seed))
+  order = np.random.default_rng(args.seed + 1)
+
+  counts = {"separable": 0, "shared_base": 0, "shared_wide": 0,
+            "banded": 0, "xla": 0}
+  got = 0
+  while got < args.batches:
+    for batch in realestate.iterate_batches(dataset, batch_size=1,
+                                            rng=order):
+      bundle = plan_batch_render(batch)
+      if bundle is None:
+        counts["xla"] += 1
+      elif bundle["separable"]:
+        counts["separable"] += 1
+      elif isinstance(bundle["plan"], tuple) and bundle["plan"][0] == "banded":
+        counts["banded"] += 1
+      elif (bundle["plan"][2], bundle["plan"][3]) == (rp.G_SHARED,
+                                                      rp.G_BAND):
+        counts["shared_base"] += 1
+      else:
+        counts["shared_wide"] += 1
+      got += 1
+      if got >= args.batches:
+        break
+
+  out = {
+      "metric": "train_tier_banded_frac",
+      "value": round(counts["banded"] / max(1, got), 4),
+      "unit": "fraction",
+      "vs_baseline": None,
+      **counts,
+      "batches": got,
+      "img_size": args.img_size,
+      "num_planes": args.num_planes,
+      "dataset": "synthetic" if tmp is not None else args.dataset,
+      "seconds": round(time.time() - t0, 1),
+  }
+  print(json.dumps(out))
+  art = os.path.join(os.path.dirname(os.path.dirname(
+      os.path.abspath(__file__))), "artifacts")
+  if os.path.isdir(art):
+    with open(os.path.join(art, "tier_traffic.json"), "w") as fh:
+      fh.write(json.dumps(out) + "\n")
+  if tmp is not None:
+    tmp.cleanup()
+
+
+if __name__ == "__main__":
+  main()

@@ -40,6 +40,7 @@ from __future__ import annotations
 
 import functools
 import json
+import os
 import sys
 import time
 
@@ -183,6 +184,25 @@ def main() -> None:
     return jax.jit(functools.partial(
         render_pallas.render_mpi_fused, separable=bundle["separable"],
         check=False, plan=bundle["plan"], adj_plan=None))
+
+  if os.environ.get("BENCH_DRY"):
+    # Guard/planning smoke mode: everything above (tier guards, banded
+    # sweep, per-case plan_fused + tier assertion below) runs on the
+    # host; the kernels themselves are never dispatched — so the whole
+    # decision path is testable off-chip, where 1080p interpret-mode
+    # timing is infeasible. Round 4's bench died on a stale guard; this
+    # mode exists so that class of failure is caught before a tunnel
+    # window is spent on it.
+    for key, case_homs, want in (("separable", homs, "separable"),
+                                 ("rotation", homs_rot, "shared"),
+                                 ("rot10", homs_rot10, "shared"),
+                                 ("banded", homs_banded, "banded")):
+      planned_renderer(case_homs, want)
+      print(f"bench: dry {key}: plan ok ({want})", file=sys.stderr)
+    print(json.dumps({"metric": "bench_dry_run", "value": 1,
+                      "unit": "ok", "vs_baseline": None,
+                      "banded_deg": banded_deg}))
+    return
 
   for key, case_homs, want, iters in (
       ("separable", homs, "separable", 30),

@@ -1,0 +1,35 @@
+"""The headline bench's decision path, off-chip (BENCH_DRY=1).
+
+Round 4's only bench attempt died before timing anything: a tier guard
+went stale when the slice ladder widened the shared envelope past the
+10-degree pose the guard assumed banded (ADVICE r4, high). Every part of
+that failure was host math — plan_fused, the tier guards, the banded-pose
+sweep — and none of it needs a TPU. This test runs bench.py in its
+dry-run mode in a subprocess (own env: the bench must plan at 1080p with
+the REAL planners, not the conftest mesh) so guard rot can never again
+survive to a tunnel window.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+
+def test_bench_dry_run_plans_all_tiers():
+  repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+  env = dict(os.environ)
+  env.pop("PALLAS_AXON_POOL_IPS", None)
+  env["JAX_PLATFORMS"] = "cpu"
+  env["BENCH_DRY"] = "1"
+  proc = subprocess.run(
+      [sys.executable, os.path.join(repo, "bench.py")],
+      capture_output=True, text=True, timeout=1200, env=env, cwd=repo)
+  assert proc.returncode == 0, (
+      f"bench dry run failed:\n{proc.stderr[-2000:]}")
+  out = json.loads(proc.stdout.strip().splitlines()[-1])
+  assert out["metric"] == "bench_dry_run" and out["value"] == 1
+  # The swept banded pose must sit beyond the shared ladder's ~13-degree
+  # 1080p envelope; if this moves, re-check the sweep range in bench.py.
+  assert 13.0 < out["banded_deg"] <= 24.0
+  assert "dry banded: plan ok" in proc.stderr

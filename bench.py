@@ -185,7 +185,7 @@ def main() -> None:
         render_pallas.render_mpi_fused, separable=bundle["separable"],
         check=False, plan=bundle["plan"], adj_plan=None))
 
-  if os.environ.get("BENCH_DRY"):
+  if os.environ.get("BENCH_DRY", "") not in ("", "0", "false"):
     # Guard/planning smoke mode: everything above (tier guards, banded
     # sweep, per-case plan_fused + tier assertion below) runs on the
     # host; the kernels themselves are never dispatched — so the whole

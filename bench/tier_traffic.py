@@ -43,6 +43,10 @@ def main() -> None:
   ap.add_argument("--num-planes", type=int, default=10)  # cell 8:90
   ap.add_argument("--scenes", type=int, default=8)
   ap.add_argument("--batches", type=int, default=200)
+  ap.add_argument("--rot-deg", type=float, default=0.0,
+                  help="per-frame rotation jitter for the synthetic "
+                       "scenes (deg); real clips carry small inter-frame "
+                       "rotations, so run the census at e.g. 2.0 too")
   ap.add_argument("--seed", type=int, default=0)
   args = ap.parse_args()
 
@@ -56,11 +60,16 @@ def main() -> None:
   t0 = time.time()
   root = args.dataset
   tmp = None
+  if root is not None and args.rot_deg:
+    raise SystemExit(
+        "--rot-deg only applies to the synthesized dataset; a real "
+        "--dataset carries its own poses (drop one of the two flags)")
   if root is None:
     tmp = tempfile.TemporaryDirectory(prefix="mpi_tier_")
     root = tmp.name
     realestate.synthesize_dataset(root, num_scenes=args.scenes, frames=4,
-                                  img_size=args.img_size, seed=args.seed)
+                                  img_size=args.img_size, seed=args.seed,
+                                  rot_deg=args.rot_deg)
   cfg = config.DataConfig(dataset_path=root, img_size=args.img_size,
                           num_planes=args.num_planes)
   dataset = cfg.make_dataset(rng=np.random.default_rng(args.seed))
@@ -98,13 +107,16 @@ def main() -> None:
       "img_size": args.img_size,
       "num_planes": args.num_planes,
       "dataset": "synthetic" if tmp is not None else args.dataset,
+      "rot_deg": args.rot_deg,
       "seconds": round(time.time() - t0, 1),
   }
   print(json.dumps(out))
   art = os.path.join(os.path.dirname(os.path.dirname(
       os.path.abspath(__file__))), "artifacts")
   if os.path.isdir(art):
-    with open(os.path.join(art, "tier_traffic.json"), "w") as fh:
+    name = ("tier_traffic.json" if args.rot_deg == 0.0
+            else f"tier_traffic_rot{args.rot_deg:g}.json")
+    with open(os.path.join(art, name), "w") as fh:
       fh.write(json.dumps(out) + "\n")
   if tmp is not None:
     tmp.cleanup()

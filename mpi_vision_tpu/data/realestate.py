@@ -273,12 +273,19 @@ def prefetch_batches(batches: Iterator, size: int = 2) -> Iterator:
 
 
 def synthesize_dataset(root: str, num_scenes: int = 3, frames: int = 4,
-                       img_size: int = 64, seed: int = 0) -> str:
+                       img_size: int = 64, seed: int = 0,
+                       rot_deg: float = 0.0) -> str:
   """Write a tiny procedural dataset in the RealEstate10K layout.
 
   Scenes are textured gradients with drifting blobs viewed by a camera
   trucking sideways; timestamps are spaced so the reference min_dist=16e3
   window admits triplets. Purely for hermetic tests/benchmarks.
+
+  ``rot_deg`` > 0 adds per-frame camera rotation jitter (uniform yaw /
+  pitch / roll up to that many degrees; default off, keeping the legacy
+  pure-truck poses byte-identical). Real RealEstate10K clips carry small
+  inter-frame rotations, so rotation-aware measurements (e.g.
+  ``bench/tier_traffic.py``) opt in to a non-degenerate pose stream.
   """
   from PIL import Image
 
@@ -306,6 +313,15 @@ def synthesize_dataset(root: str, num_scenes: int = 3, frames: int = 4,
 
       pose = np.eye(4, dtype=np.float32)
       pose[0, 3] = -0.1 * f  # camera trucking right in world space
+      if rot_deg > 0.0:
+        rx, ry, rz = np.radians(rng.uniform(-rot_deg, rot_deg, 3))
+        cx, sx = np.cos(rx), np.sin(rx)
+        cy, sy = np.cos(ry), np.sin(ry)
+        cz, sz = np.cos(rz), np.sin(rz)
+        rot_x = np.array([[1, 0, 0], [0, cx, -sx], [0, sx, cx]])
+        rot_y = np.array([[cy, 0, sy], [0, 1, 0], [-sy, 0, cy]])
+        rot_z = np.array([[cz, -sz, 0], [sz, cz, 0], [0, 0, 1]])
+        pose[:3, :3] = (rot_z @ rot_y @ rot_x).astype(np.float32)
       row = ([str(ts), "0.9", "0.9", "0.5", "0.5", "0", "0"]
              + [f"{v:.6f}" for v in pose[:3].reshape(-1)])
       lines.append(" ".join(row))

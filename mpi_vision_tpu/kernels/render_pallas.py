@@ -758,17 +758,27 @@ def _shared_call(planes: jnp.ndarray, homs: jnp.ndarray,
 # the cheapest covering member, so small rotations that just miss the
 # shared envelope pay near-shared-tier DMA cost, not worst-case.
 
-_BANDED_LEVELS = ((32, 16), (48, 24), (64, 32))   # (bandg, slice_rows)
+# (bandg, slice_rows): the two tall members trade DMA amplification for
+# rotation envelope — at 1080p they carry yaw to ~20 deg and roll past
+# ~12 deg where the (64, 32) member stops covering (planner-verified per
+# pose; VMEM stays modest: a [2, 4, 128, 896] f32 band is 3.7 MB).
+_BANDED_LEVELS = ((32, 16), (48, 24), (64, 32), (96, 48), (128, 64))
 
 
 def _banded_family(height: int, width: int):
   """Static (tw, bandg, slice_rows, tsrc, n_eff) configs, cheapest first.
 
-  Cost ranks by DMA bytes per output pixel (bandg*tsrc / (STRIP*tw));
-  coverage is verified exactly per config by ``_plan_banded``, so the
-  ranking only decides preference among covering configs. ``tw`` must
-  divide the (tile-padded) width; W % 128 == 0 guarantees at least the
-  CHUNK-wide member.
+  Cost ranks by DMA bytes per output pixel (bandg*tsrc / (STRIP*tw))
+  PLUS the per-row gather traffic (n_eff * slice_rows vreg-gathers per
+  chunk-row), calibrated so the two terms match the roofline's measured
+  proportions at the (128-tile, 64-band, 32-slice, 3-window) member
+  (artifacts/general_kernel_roofline.md: ~19 FPS gather vs ~41 FPS DMA
+  ceiling — gathers bind, so a taller slice must not be preferred just
+  because its wider tile reads fewer bytes). Coverage is verified
+  exactly per config by ``_plan_banded``, so the ranking only decides
+  preference among covering configs. ``tw`` must divide the
+  (tile-padded) width; W % 128 == 0 guarantees at least the CHUNK-wide
+  member.
   """
   cfgs = []
   for tw in (t for t in (G_TILE_W, 256, CHUNK) if width % t == 0):
@@ -780,7 +790,8 @@ def _banded_family(height: int, width: int):
         n_eff = min(n_win, tsrc // WIN)
         cfgs.append((tw, bg, sl, tsrc, n_eff))
   seen, out = set(), []
-  for c in sorted(cfgs, key=lambda c: (c[1] * c[3]) / (STRIP * c[0])):
+  for c in sorted(cfgs, key=lambda c: (c[1] * c[3]) / (STRIP * c[0])
+                  + c[4] * c[2]):
     if c not in seen:
       seen.add(c)
       out.append(c)

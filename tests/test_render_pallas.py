@@ -468,11 +468,15 @@ class TestBandedTier:
         _rot_pose_deg(deg, axis), depths, _intrinsics(h, w), h, w)[:, 0]
 
   def test_fallback_chain_tiering(self):
-    """Small pose -> shared plan; mid pose -> banded only; extreme -> None."""
-    h, w = 48, 384
+    """Small pose -> shared plan; mid pose -> banded only; extreme -> None.
+
+    H = 144 so the tallest (128-row) band member cannot trivially hold
+    the whole image — at H <= bandg the banded tier covers ANY one-signed
+    pose (the band IS the image) and no rotation is 'extreme'."""
+    h, w = 144, 384
     small = self._homs(0.2, h, w)
     mid = self._homs(10.0, h, w)
-    extreme = self._homs(30.0, h, w)
+    extreme = self._homs(40.0, h, w)
     assert rp._plan_shared(np.asarray(small), h, w) is not None
     assert rp._plan_shared(np.asarray(mid), h, w) is None
     assert rp._plan_banded(np.asarray(mid), h, w) is not None
@@ -650,3 +654,54 @@ class TestSharedLadderLevels:
     g_ref = jax.grad(lambda x: rp.reference_render(x, homs).sum())(planes)
     np.testing.assert_allclose(
         np.asarray(g_got), np.asarray(g_ref), atol=1e-3, rtol=0)
+
+
+class TestBandedTallMembers:
+  """The (96, 48) / (128, 64) banded family members: rotation envelope
+  past the old (64, 32) cap (at 1080p: yaw to ~24 deg, roll to ~24 deg;
+  measured by the host planners — see the roofline addendum)."""
+
+  def _homs(self, deg, h, w, p=3, axis="roll"):
+    depths = inv_depths(1.0, 100.0, p)
+    return rp.pixel_homographies(
+        _rot_pose_deg(deg, axis), depths, _intrinsics(h, w), h, w)[:, 0]
+
+  @pytest.mark.parametrize("deg,min_slice", [(13.0, 48), (20.0, 64)])
+  def test_tall_member_parity_vs_oracle(self, rng, deg, min_slice):
+    p, h, w = 3, 64, 384
+    planes = _mpi(rng, p, h, w)
+    homs = self._homs(deg, h, w, p)
+    assert rp._plan_shared(np.asarray(homs), h, w) is None
+    bplan = rp._plan_banded(np.asarray(homs), h, w)
+    assert bplan is not None, deg
+    assert bplan[2] >= min_slice, (
+        f"roll {deg} deg picked {bplan}; expected a tall member "
+        f"(slice >= {min_slice}) — the cheap members must not cover it")
+    got = rp._make_banded(bplan)(planes[None], homs[None])[0]
+    want = rp.reference_render(planes, homs)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=2e-4, rtol=0)
+
+  def test_old_family_cap_now_covered(self, rng):
+    """A pose the pre-widening family rejected (roll 20 deg) renders
+    through the checked dispatch and matches the oracle."""
+    p, h, w = 3, 64, 384
+    planes = _mpi(rng, p, h, w)
+    homs = self._homs(20.0, h, w, p)
+    got = rp.render_mpi_fused(planes, homs)
+    want = rp.reference_render(planes, homs)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=2e-4, rtol=0)
+
+  def test_tall_member_gradients_via_xla_vjp(self, rng):
+    """The tall members keep the banded tier's XLA backward (adj_plan
+    None by design; artifacts/tier_traffic*.json records zero training
+    traffic here)."""
+    p, h, w = 2, 64, 384
+    planes = _mpi(rng, p, h, w)
+    homs = self._homs(13.0, h, w, p)
+    g_got = jax.grad(
+        lambda x: rp.render_mpi_fused(x, homs).sum())(planes)
+    g_ref = jax.grad(lambda x: rp.reference_render(x, homs).sum())(planes)
+    np.testing.assert_allclose(
+        np.asarray(g_got), np.asarray(g_ref), atol=1e-4, rtol=0)

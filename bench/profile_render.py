@@ -1,9 +1,13 @@
 """Capture a jax.profiler trace of the fused render kernels (TPU only).
 
-Writes a perfetto/tensorboard-compatible trace of ~20 frames of each
-headline path — separable (truck+dolly) and general (1-degree pan) at
-1080p x 32 planes — plus Pallas-backward gradients of the rotation path,
-under ``artifacts/trace_r03/``. The trace is the input for the next round's
+Writes a perfetto/tensorboard-compatible trace of steady-state frames of
+every render tier at 1080p x 32 planes — separable (truck+dolly), shared
+base (1-degree pan), shared wide-slice ladder (10-degree pan), and the
+banded per-row tier (14-degree pan) — plus Pallas-backward gradients of
+the base rotation path, under ``artifacts/trace_r05/``. All forward paths
+run the PLANNED-JIT API (plan_fused once, then one compiled dispatch per
+frame): eager check=True timing through the axon tunnel measures host
+dispatch, not kernels (the round-4 lesson). The trace is the input for
 kernel-level optimization (which ops bind: gathers, DMA waits, or the
 scalar core) without needing live chip time to investigate.
 
@@ -26,7 +30,7 @@ import numpy as np  # noqa: E402
 import _common  # noqa: E402
 
 TRACE_DIR = os.path.join(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))), "artifacts", "trace_r03")
+    os.path.abspath(__file__))), "artifacts", "trace_r05")
 
 
 def main() -> None:
@@ -59,43 +63,54 @@ def main() -> None:
     return rp.pixel_homographies(
         jnp.asarray(pose)[None], depths, jnp.asarray(k)[None], h, w)[:, 0]
 
-  homs_sep = homs_for(0.0, 0.08, -0.05)
-  homs_rot = homs_for(1.0, 0.05, -0.03)
+  import functools
+  import shutil
+  import time
 
-  # Warm up (compile outside the trace so the trace holds steady-state).
-  jax.block_until_ready(rp.render_mpi_fused(planes, homs_sep, separable=True))
-  jax.block_until_ready(rp.render_mpi_fused(planes, homs_rot,
-                                            separable=False))
+  cases = {
+      "separable": homs_for(0.0, 0.08, -0.05),
+      "rot1": homs_for(1.0, 0.05, -0.03),
+      "rot10": homs_for(10.0, 0.05, 0.0),    # shared wide-slice ladder
+      "banded14": homs_for(14.0, 0.05, 0.0),  # banded per-row tier
+  }
+  renderers = {}
+  for name, case_homs in cases.items():
+    bundle = rp.plan_fused(case_homs, h, w)
+    if bundle is None:
+      _common.log(f"{name}: plan_fused rejected the pose; skipping")
+      continue
+    fn = jax.jit(functools.partial(
+        rp.render_mpi_fused, separable=bundle["separable"], check=False,
+        plan=bundle["plan"], adj_plan=None))
+    jax.block_until_ready(fn(planes, case_homs))   # compile outside trace
+    renderers[name] = fn
 
   # Gradient through the fused render (the training hot path): warm up so
   # the trace holds steady-state kernels, not compiles.
+  homs_rot = cases["rot1"]
   grad_rot = jax.jit(jax.grad(
       lambda pl_: jnp.sum(rp.render_mpi_fused(pl_, homs_rot,
                                               separable=False) ** 2)))
   jax.block_until_ready(grad_rot(planes))
 
-  import shutil
-  import time
   # Clear stale captures: a leftover trace from a killed previous run must
   # not let a failed capture report trace_written=1.0.
   shutil.rmtree(TRACE_DIR, ignore_errors=True)
   os.makedirs(TRACE_DIR, exist_ok=True)
+  timings = {}
   with jax.profiler.trace(TRACE_DIR):
-    t0 = time.perf_counter()
-    for _ in range(20):
-      out = rp.render_mpi_fused(planes, homs_sep, separable=True)
-    jax.block_until_ready(out)
-    t_sep = (time.perf_counter() - t0) / 20
-    t0 = time.perf_counter()
-    for _ in range(20):
-      out = rp.render_mpi_fused(planes, homs_rot, separable=False)
-    jax.block_until_ready(out)
-    t_rot = (time.perf_counter() - t0) / 20
+    for name, fn in renderers.items():
+      iters = 20 if name in ("separable", "rot1") else 8
+      t0 = time.perf_counter()
+      for _ in range(iters):
+        out = fn(planes, cases[name])
+      jax.block_until_ready(out)
+      timings[name] = (time.perf_counter() - t0) / iters
     t0 = time.perf_counter()
     for _ in range(5):
       g = grad_rot(planes)
     jax.block_until_ready(g)
-    t_bwd = (time.perf_counter() - t0) / 5
+    timings["rot1_grad"] = (time.perf_counter() - t0) / 5
 
   written = bool(glob.glob(os.path.join(TRACE_DIR, "**", "*.pb"),
                            recursive=True)
@@ -103,14 +118,11 @@ def main() -> None:
                               recursive=True)
                  or glob.glob(os.path.join(TRACE_DIR, "**", "*.trace*"),
                               recursive=True))
-  _common.log(f"trace at {TRACE_DIR} (written={written}); "
-              f"separable {t_sep * 1e3:.1f} ms, rotation {t_rot * 1e3:.1f} ms, "
-              f"rotation grad {t_bwd * 1e3:.1f} ms")
+  _common.log(f"trace at {TRACE_DIR} (written={written}); " + ", ".join(
+      f"{k} {v * 1e3:.1f} ms" for k, v in timings.items()))
   _common.emit("render_profile_trace_written", 1.0 if written else 0.0,
-               "bool", 1.0 if written else 0.0,
-               separable_ms=round(t_sep * 1e3, 2),
-               rotation_ms=round(t_rot * 1e3, 2),
-               rotation_grad_ms=round(t_bwd * 1e3, 2), trace_dir=TRACE_DIR)
+               "bool", 1.0 if written else 0.0, trace_dir=TRACE_DIR,
+               **{f"{k}_ms": round(v * 1e3, 2) for k, v in timings.items()})
 
 
 if __name__ == "__main__":

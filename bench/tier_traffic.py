@@ -77,6 +77,11 @@ def main() -> None:
 
   counts = {"separable": 0, "shared_base": 0, "shared_wide": 0,
             "banded": 0, "xla": 0}
+  # How often the BACKWARD stays on Pallas: a batch with a kernel plan
+  # but adj_plan None keeps the Pallas forward with the XLA backward
+  # (the banded tier by design; a shared/separable batch only when the
+  # adjoint planner rejects its pose).
+  adj_engaged = adj_fallback = 0
   got = 0
   while got < args.batches:
     for batch in realestate.iterate_batches(dataset, batch_size=1,
@@ -93,6 +98,11 @@ def main() -> None:
         counts["shared_base"] += 1
       else:
         counts["shared_wide"] += 1
+      if bundle is not None:
+        if bundle["adj_plan"] is not None:
+          adj_engaged += 1
+        else:
+          adj_fallback += 1
       got += 1
       if got >= args.batches:
         break
@@ -103,6 +113,8 @@ def main() -> None:
       "unit": "fraction",
       "vs_baseline": None,
       **counts,
+      "pallas_backward_engaged": adj_engaged,
+      "xla_backward_fallback": adj_fallback,
       "batches": got,
       "img_size": args.img_size,
       "num_planes": args.num_planes,

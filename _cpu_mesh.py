@@ -42,6 +42,25 @@ def force_cpu_mesh(n_devices: int = 8) -> None:
   os.environ.update(hardened_env(n_devices))
   os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 
+  # Long test sessions (hundreds of XLA:CPU compilations in one process)
+  # have segfaulted INSIDE LLVM on the main thread (rc=139 in
+  # backend_compile_and_load, deterministic at ~40 min into the full
+  # suite, absent from any half-suite run). The classic mechanism is
+  # compiler recursion overrunning the default 8 MB main-thread stack —
+  # Linux grows the main stack on fault up to RLIMIT_STACK, so raising
+  # the soft limit early gives LLVM headroom without affecting anything
+  # else. Harmless if the crash had another cause.
+  try:
+    import resource
+
+    soft, hard = resource.getrlimit(resource.RLIMIT_STACK)
+    want = 512 * 1024 * 1024
+    if soft != resource.RLIM_INFINITY and soft < want:
+      new_soft = want if hard == resource.RLIM_INFINITY else min(want, hard)
+      resource.setrlimit(resource.RLIMIT_STACK, (new_soft, hard))
+  except (ImportError, ValueError, OSError):
+    pass
+
   import jax
   import jax._src.xla_bridge as xb
 

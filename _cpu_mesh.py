@@ -72,3 +72,20 @@ def force_cpu_mesh(n_devices: int = 8) -> None:
       xb.register_backend_factory(
           plat, _disabled, priority=-1000, fail_quietly=True)
   jax.config.update("jax_platforms", "cpu")
+
+  # Persistent on-disk compilation cache (repo-local, gitignored). Two
+  # reasons: (1) full-suite runs in ONE process segfault inside LLVM
+  # after hundreds of XLA:CPU compilations (rc=139, deterministic,
+  # ~40 min in; absent from half-suite runs; unaffected by the stack
+  # raise above) — with the cache, a rerun loads the executables
+  # compiled before any crash and performs a fraction of the native
+  # compilations, sidestepping the accumulation; (2) iteration speed —
+  # interpret-mode kernel tests dominate suite time with compiles.
+  try:
+    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             ".jax_cache")
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
+  except Exception:
+    pass

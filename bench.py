@@ -38,6 +38,7 @@ tunnel-latency-bound, reported for visibility, not part of the headline.
 
 from __future__ import annotations
 
+import argparse
 import functools
 import json
 import os
@@ -126,18 +127,58 @@ def _fps(fn, *args, iters: int = 30) -> float:
   return iters / (time.perf_counter() - t0)
 
 
-def main() -> None:
+def _acquire_device(allow_cpu: bool):
   try:
-    dev = jax.devices()[0]
+    return jax.devices()[0]
   except RuntimeError as e:
-    # Honest hard failure (rc=1), but legible: the axon tunnel being down
-    # is an infra condition, not a code path — say so in one line. See
+    # Without --allow-cpu: honest hard failure (rc=1), but legible — the
+    # axon tunnel being down is an infra condition, not a code path. See
     # artifacts/tpu_session_notes_r03.md for the outage record and
     # bench/tpu_watch.sh for the auto-retry.
     first = (str(e).splitlines() or ["<no message>"])[0]
-    raise SystemExit(f"bench: no usable device — TPU tunnel down? ({first})")
+    if not allow_cpu:
+      raise SystemExit(f"bench: no usable device — TPU tunnel down? ({first})")
+    if os.environ.get("_BENCH_CPU_REEXEC"):
+      raise SystemExit(f"bench: CPU fallback failed too ({first})")
+    # The failed backend init poisons this process (jax caches it); re-exec
+    # under the hardened CPU env with the fallback marker set so the run
+    # still produces its one JSON line (device-tagged "cpu") instead of
+    # losing the round to a tunnel outage.
+    print(f"bench: no TPU ({first}); re-exec on CPU (--allow-cpu)",
+          file=sys.stderr, flush=True)
+    from _cpu_mesh import hardened_env
+
+    env = hardened_env(1)
+    env["_BENCH_CPU_REEXEC"] = "1"
+    env["BENCH_ALLOW_CPU"] = "1"
+    os.execve(sys.executable,
+              [sys.executable, os.path.abspath(__file__)] + sys.argv[1:],
+              env)
+
+
+def main(argv=None) -> None:
+  ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+  ap.add_argument("--allow-cpu", action="store_true",
+                  help="when no TPU is reachable, still emit the single "
+                       "JSON line (device-tagged 'cpu', planning-only, "
+                       "null FPS) instead of exiting 1 with no JSON "
+                       "(also env BENCH_ALLOW_CPU=1)")
+  args = ap.parse_args(argv)
+  allow_cpu = args.allow_cpu or (
+      os.environ.get("BENCH_ALLOW_CPU", "") not in ("", "0", "false"))
+  dry = os.environ.get("BENCH_DRY", "") not in ("", "0", "false")
+  dev = _acquire_device(allow_cpu)
   print(f"bench: backend={jax.default_backend()} device={dev.device_kind}",
         file=sys.stderr)
+  # 1080p interpret-mode kernel timing on CPU is infeasible (hours, or a
+  # driver timeout — another lost round); CPU runs either plan-only
+  # (--allow-cpu fallback line, BENCH_DRY test mode) or refuse fast.
+  cpu_fallback = jax.default_backend() == "cpu" and not dry
+  if cpu_fallback and not allow_cpu:
+    raise SystemExit(
+        "bench: CPU backend and no --allow-cpu/BENCH_ALLOW_CPU=1 — "
+        "refusing to time 1080p kernels in interpret mode (pass the flag "
+        "for the planning-only fallback JSON line)")
   planes, homs, homs_rot, homs_rot10, pose, depths, intrinsics = (
       _make_inputs())
   results = {}
@@ -185,23 +226,34 @@ def main() -> None:
         render_pallas.render_mpi_fused, separable=bundle["separable"],
         check=False, plan=bundle["plan"], adj_plan=None))
 
-  if os.environ.get("BENCH_DRY", "") not in ("", "0", "false"):
+  if dry or cpu_fallback:
     # Guard/planning smoke mode: everything above (tier guards, banded
     # sweep, per-case plan_fused + tier assertion below) runs on the
     # host; the kernels themselves are never dispatched — so the whole
     # decision path is testable off-chip, where 1080p interpret-mode
     # timing is infeasible. Round 4's bench died on a stale guard; this
     # mode exists so that class of failure is caught before a tunnel
-    # window is spent on it.
+    # window is spent on it. The --allow-cpu fallback rides the same
+    # path but keeps the headline metric name (null value, device
+    # "cpu") so a tunnel outage still leaves a parseable round record.
+    mode = "dry" if dry else "cpu-fallback"
     for key, case_homs, want in (("separable", homs, "separable"),
                                  ("rotation", homs_rot, "shared"),
                                  ("rot10", homs_rot10, "shared"),
                                  ("banded", homs_banded, "banded")):
       planned_renderer(case_homs, want)
-      print(f"bench: dry {key}: plan ok ({want})", file=sys.stderr)
-    print(json.dumps({"metric": "bench_dry_run", "value": 1,
-                      "unit": "ok", "vs_baseline": None,
-                      "banded_deg": banded_deg}))
+      print(f"bench: {mode} {key}: plan ok ({want})", file=sys.stderr)
+    if dry:
+      print(json.dumps({"metric": "bench_dry_run", "value": 1,
+                        "unit": "ok", "vs_baseline": None,
+                        "device": jax.default_backend(),
+                        "banded_deg": banded_deg}))
+    else:
+      print(json.dumps({"metric": "mpi_render_1080p_32plane_fps",
+                        "value": None, "unit": "frames/s",
+                        "vs_baseline": None, "device": "cpu",
+                        "cpu_fallback": True, "plans_ok": True,
+                        "banded_deg": banded_deg}))
     return
 
   for key, case_homs, want, iters in (
@@ -258,6 +310,7 @@ def main() -> None:
       "value": round(value, 3),
       "unit": "frames/s",
       "vs_baseline": round(value / TARGET_FPS, 3),
+      "device": jax.default_backend(),
       "separable_fps": rnd("separable"),
       "rotation_fps": rnd("rotation"),
       "rot10_fps": rnd("rot10"),

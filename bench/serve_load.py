@@ -1,0 +1,162 @@
+"""Closed-loop load generator for the serve/ subsystem.
+
+Drives ``serve.RenderService`` in-process (no sockets — the HTTP shell is
+a thin JSON wrapper; what this measures is the cache -> scheduler ->
+engine path, which is where batching and tail latency live). C worker
+threads run a closed loop: pick a scene (round-robin with a hot-scene
+skew so the cache sees realistic reuse), draw a small random pose, call
+``service.render``, repeat. Closed loop means offered concurrency == C,
+so micro-batching is exercised exactly as a threaded HTTP front end
+would exercise it.
+
+Prints ONE JSON line (stdout; diagnostics on stderr) with the headline
+serving numbers::
+
+  {"metric": "serve_load", "value": <renders_per_sec>,
+   "unit": "renders/s", "renders_per_sec": ..., "p50_ms": ...,
+   "p99_ms": ..., "cache_hit_rate": ..., ...}
+
+``--dry`` (env ``SERVE_LOAD_DRY=1``) shrinks scenes and duration so the
+whole loop runs in seconds on CPU — the tier-1 smoke mode
+(tests/test_serve_load_dry.py), mirroring bench.py's BENCH_DRY.
+
+Usage: python bench/serve_load.py [--duration 10] [--concurrency 8] ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(1, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+from _common import log as _log
+
+
+def build_parser() -> argparse.ArgumentParser:
+  ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+  ap.add_argument("--duration", type=float, default=10.0,
+                  help="measured load seconds (after warm-up)")
+  ap.add_argument("--concurrency", type=int, default=8,
+                  help="closed-loop worker threads")
+  ap.add_argument("--scenes", type=int, default=4)
+  ap.add_argument("--img-size", type=int, default=256)
+  ap.add_argument("--num-planes", type=int, default=16)
+  ap.add_argument("--max-batch", type=int, default=8)
+  ap.add_argument("--max-wait-ms", type=float, default=3.0)
+  ap.add_argument("--cache-mb", type=int, default=2048)
+  ap.add_argument("--method", default="fused",
+                  choices=("fused", "scan", "assoc"))
+  ap.add_argument("--sharded", default="auto", choices=("auto", "on", "off"))
+  ap.add_argument("--seed", type=int, default=0)
+  ap.add_argument("--dry", action="store_true",
+                  help="tier-1 smoke mode: tiny scenes, ~2 s of load "
+                       "(also env SERVE_LOAD_DRY=1)")
+  return ap
+
+
+def random_pose(rng: np.random.Generator) -> np.ndarray:
+  """A small random truck/dolly/pedestal move (typical MPI viewing)."""
+  pose = np.eye(4, dtype=np.float32)
+  pose[:3, 3] = rng.uniform(-0.05, 0.05, 3).astype(np.float32)
+  return pose
+
+
+def main(argv=None) -> int:
+  args = build_parser().parse_args(argv)
+  if os.environ.get("SERVE_LOAD_DRY", "") not in ("", "0", "false"):
+    args.dry = True
+  if args.dry:
+    args.duration = min(args.duration, 2.0)
+    args.concurrency = min(args.concurrency, 4)
+    args.scenes = min(args.scenes, 2)
+    args.img_size = min(args.img_size, 32)
+    args.num_planes = min(args.num_planes, 4)
+
+  from mpi_vision_tpu.serve import RenderService
+
+  use_mesh = {"auto": None, "on": True, "off": False}[args.sharded]
+  svc = RenderService(
+      cache_bytes=args.cache_mb << 20, max_batch=args.max_batch,
+      max_wait_ms=args.max_wait_ms, method=args.method, use_mesh=use_mesh)
+  ids = svc.add_synthetic_scenes(
+      args.scenes, height=args.img_size, width=args.img_size,
+      planes=args.num_planes, seed=args.seed)
+  _log(f"serve_load: {len(ids)} scenes "
+       f"[{args.img_size}x{args.img_size}x{args.num_planes}], "
+       f"engine {svc.engine.describe()}")
+
+  # Warm-up outside the measured window: bake every scene and compile all
+  # batch buckets so the measurement is steady-state serving, not XLA
+  # compiles.
+  svc.warmup()
+  svc.metrics.reset()  # measured window starts clean
+  _log("serve_load: warm-up done")
+
+  stop = threading.Event()
+  errors: list[Exception] = []
+  counts = [0] * args.concurrency
+
+  def worker(idx: int) -> None:
+    rng = np.random.default_rng(args.seed + 1 + idx)
+    while not stop.is_set():
+      # Hot-scene skew: half the traffic on scene 0, the rest uniform —
+      # the cache must show reuse, not a uniform scan.
+      sid = ids[0] if (rng.random() < 0.5 or len(ids) == 1) \
+          else ids[int(rng.integers(1, len(ids)))]
+      try:
+        svc.render(sid, random_pose(rng), timeout=600)
+      except Exception as e:  # noqa: BLE001 - recorded, loop exits
+        errors.append(e)
+        return
+      counts[idx] += 1
+
+  threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+             for i in range(args.concurrency)]
+  t0 = time.perf_counter()
+  for t in threads:
+    t.start()
+  time.sleep(args.duration)
+  stop.set()
+  for t in threads:
+    t.join(60)
+  elapsed = time.perf_counter() - t0
+  svc.close()
+
+  if errors:
+    raise SystemExit(f"serve_load: worker failed: {errors[0]!r}")
+  total = sum(counts)
+  if total == 0:
+    raise SystemExit("serve_load: no requests completed in the window")
+
+  stats = svc.stats()
+  lat = stats["latency_ms"] or {}
+  rps = total / elapsed
+  print(json.dumps({
+      "metric": "serve_load",
+      "value": round(rps, 3),
+      "unit": "renders/s",
+      "renders_per_sec": round(rps, 3),
+      "p50_ms": lat.get("p50"),
+      "p99_ms": lat.get("p99"),
+      "cache_hit_rate": stats["cache"]["hit_rate"],
+      "requests": total,
+      "batches": stats["batches"],
+      "mean_batch_size": stats["mean_batch_size"],
+      "concurrency": args.concurrency,
+      "device": stats["engine"]["platform"],
+      "sharded": stats["engine"]["sharded"],
+      "dry": bool(args.dry),
+  }))
+  return 0
+
+
+if __name__ == "__main__":
+  sys.exit(main())

@@ -9,8 +9,11 @@ export cell 18). These commands make the same flow scriptable:
     checkpointing (orbax) and exporting a viewer HTML of a validation MPI.
   * ``export-viewer`` — render a baked PNG MPI directory (e.g. the
     reference's ``test/rgba_*.png``) into the standalone HTML viewer.
+  * ``serve`` — run the batched render-serving subsystem (serve/): scene
+    cache + micro-batching scheduler + HTTP front end (``/render``,
+    ``/healthz``, ``/stats``) over synthetic scenes or a baked PNG MPI.
 
-Both print a one-line JSON summary on stdout (diagnostics on stderr).
+All print a one-line JSON summary on stdout (diagnostics on stderr).
 """
 
 from __future__ import annotations
@@ -195,6 +198,77 @@ def cmd_export_viewer(args: argparse.Namespace) -> dict:
   }
 
 
+def cmd_serve(args: argparse.Namespace) -> dict:
+  import numpy as np
+
+  from mpi_vision_tpu.serve import RenderService, make_http_server
+
+  use_mesh = {"auto": None, "on": True, "off": False}[args.sharded]
+  svc = RenderService(
+      cache_bytes=args.cache_mb << 20, max_batch=args.max_batch,
+      max_wait_ms=args.max_wait_ms, method=args.method, use_mesh=use_mesh,
+      max_queue=args.max_queue)
+  if args.mpi_dir:
+    from mpi_vision_tpu.core.camera import intrinsics_matrix, inv_depths
+    from mpi_vision_tpu.viewer import export
+
+    mpi = export.load_fixture_mpi(args.mpi_dir, prefix=args.prefix)
+    h, w, p = mpi.shape[0], mpi.shape[1], mpi.shape[2]
+    fx = 0.5 * w / np.tan(np.radians(args.fov) / 2.0)
+    k = np.asarray(intrinsics_matrix(fx, fx, w / 2.0, h / 2.0), np.float32)
+    scene_id = os.path.basename(os.path.normpath(args.mpi_dir))
+    svc.add_scene(scene_id, mpi,
+                  np.asarray(inv_depths(args.near, args.far, p)), k)
+    _log(f"serve: loaded MPI scene {scene_id!r} [{h}x{w}x{p}]")
+  else:
+    ids = svc.add_synthetic_scenes(
+        args.scenes, height=args.img_size, width=args.img_size,
+        planes=args.num_planes)
+    _log(f"serve: {len(ids)} synthetic scenes "
+         f"[{args.img_size}x{args.img_size}x{args.num_planes}]")
+
+  if args.warmup:
+    # Pay the compiles before traffic, not inside request latencies.
+    svc.warmup()
+    _log("serve: warm-up done (all batch buckets compiled)")
+
+  httpd = make_http_server(svc, host=args.host, port=args.port)
+  port = httpd.server_address[1]
+  import threading
+
+  thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+  thread.start()
+  _log(f"serve: listening on http://{args.host}:{port} "
+       f"(/render, /healthz, /stats); engine {svc.engine.describe()}")
+  t0 = time.time()
+  try:
+    if args.duration > 0:
+      time.sleep(args.duration)
+    else:
+      while True:
+        time.sleep(3600)
+  except KeyboardInterrupt:
+    _log("serve: interrupted")
+  finally:
+    httpd.shutdown()
+    stats = svc.stats()
+    svc.close()
+  return {
+      "command": "serve",
+      "host": args.host,
+      "port": port,
+      "scenes": len(svc.scene_ids()),
+      "seconds": round(time.time() - t0, 1),
+      "requests": stats["requests"],
+      "renders_per_sec": stats["renders_per_sec"],
+      "latency_ms": stats["latency_ms"],
+      "mean_batch_size": stats["mean_batch_size"],
+      "cache_hit_rate": stats["cache"]["hit_rate"],
+      "devices": stats["engine"]["devices"],
+      "sharded": stats["engine"]["sharded"],
+  }
+
+
 def build_parser() -> argparse.ArgumentParser:
   ap = argparse.ArgumentParser(
       prog="mpi_vision_tpu",
@@ -249,6 +323,43 @@ def build_parser() -> argparse.ArgumentParser:
   e.add_argument("--far", type=float, default=100.0)
   e.add_argument("--fov", type=float, default=60.0)
   e.set_defaults(fn=cmd_export_viewer)
+
+  s = sub.add_parser(
+      "serve", help="run the batched MPI render-serving subsystem")
+  s.add_argument("--host", default="127.0.0.1")
+  s.add_argument("--port", type=int, default=8080,
+                 help="HTTP port (0 = ephemeral; logged on stderr)")
+  s.add_argument("--duration", type=float, default=0.0,
+                 help="seconds to serve; <= 0 runs until interrupted")
+  s.add_argument("--scenes", type=int, default=4,
+                 help="synthetic scene count (ignored with --mpi-dir)")
+  s.add_argument("--img-size", type=int, default=256)
+  s.add_argument("--num-planes", type=int, default=16)
+  s.add_argument("--mpi-dir", default="",
+                 help="serve a baked PNG MPI directory instead")
+  s.add_argument("--prefix", default="rgba_")
+  s.add_argument("--near", type=float, default=1.0)
+  s.add_argument("--far", type=float, default=100.0)
+  s.add_argument("--fov", type=float, default=60.0)
+  s.add_argument("--max-batch", type=int, default=8,
+                 help="micro-batch cap per device dispatch")
+  s.add_argument("--max-wait-ms", type=float, default=3.0,
+                 help="straggler window before a partial batch dispatches")
+  s.add_argument("--cache-mb", type=int, default=2048,
+                 help="baked-scene cache byte budget")
+  s.add_argument("--max-queue", type=int, default=1024,
+                 help="pending-request cap; beyond it /render sheds "
+                      "load with 503")
+  s.add_argument("--method", default="fused",
+                 choices=("fused", "scan", "assoc"),
+                 help="per-view render method (core/render.py)")
+  s.add_argument("--sharded", default="auto", choices=("auto", "on", "off"),
+                 help="shard view batches over the device mesh "
+                      "(auto: when >1 device is visible)")
+  s.add_argument("--warmup", action=argparse.BooleanOptionalAction,
+                 default=True,
+                 help="compile with one request before serving traffic")
+  s.set_defaults(fn=cmd_serve)
   return ap
 
 

@@ -43,6 +43,35 @@ from mpi_vision_tpu.data.realestate import (  # noqa: F401  (host-side, backend-
 _BACKENDS = ("jax", "torch")
 
 
+# --- JAX version compatibility ------------------------------------------
+# ``shard_map`` moved: jax >= 0.6 exports it at top level with a
+# ``check_vma`` kwarg; earlier releases (the installed 0.4.x included)
+# only have ``jax.experimental.shard_map.shard_map`` whose equivalent
+# kwarg is ``check_rep``. Import through this shim (parallel/mesh.py,
+# serve/engine.py) so the repo runs on both without touching call sites.
+
+try:  # jax >= 0.6
+  from jax import shard_map as _shard_map_impl
+
+  _SHARD_MAP_VMA_KW = "check_vma"
+except ImportError:  # jax < 0.6
+  from jax.experimental.shard_map import shard_map as _shard_map_impl
+
+  _SHARD_MAP_VMA_KW = "check_rep"
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+  """Version-portable ``shard_map`` (new-API keyword surface).
+
+  Accepts the jax >= 0.6 keywords; on older JAX the ``check_vma`` flag is
+  forwarded as ``check_rep`` (same semantics: verify that outputs declared
+  replicated really are).
+  """
+  return _shard_map_impl(f, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs,
+                         **{_SHARD_MAP_VMA_KW: check_vma})
+
+
 def _check_backend(backend: str) -> bool:
   """True for torch, False for jax; raises otherwise (import-guarded)."""
   if backend not in _BACKENDS:

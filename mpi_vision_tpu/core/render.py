@@ -94,6 +94,32 @@ def warp_planes(
   return sampling.bilinear_sample(planes, coords)
 
 
+def render_views(
+    rgba_layers: jnp.ndarray,
+    tgt_poses: jnp.ndarray,
+    depths: jnp.ndarray,
+    intrinsics: jnp.ndarray,
+    convention: Convention = Convention.REF_HOMOGRAPHY,
+    method: str = "fused",
+    **render_kwargs,
+) -> jnp.ndarray:
+  """Render a batch of V target views of ONE scene.
+
+  The batched-pose entry the serving layer and the mesh shards share: one
+  baked MPI, many poses — ``rgba_layers [H, W, P, 4]`` + ``tgt_poses
+  [V, 4, 4]`` -> ``[V, H, W, 3]``. The MPI and intrinsics broadcast across
+  the view axis (no copy under jit); everything else is ``render_mpi``
+  with batch = V, so a V-view batch is element-for-element the same
+  computation as V single renders (micro-batched serving relies on that
+  to return bit-identical images whatever batch a request lands in).
+  """
+  v = tgt_poses.shape[0]
+  planes = jnp.broadcast_to(rgba_layers[None], (v,) + rgba_layers.shape)
+  k = jnp.broadcast_to(jnp.asarray(intrinsics)[None], (v, 3, 3))
+  return render_mpi(planes, tgt_poses, depths, k, convention=convention,
+                    method=method, **render_kwargs)
+
+
 def render_mpi(
     rgba_layers: jnp.ndarray,
     tgt_pose: jnp.ndarray,

@@ -52,3 +52,19 @@ def checked(fn: Callable, errors=checkify.float_checks) -> Callable:
     return out
 
   return wrapper
+
+
+def lowered_text(lowered, debug_info: bool = True) -> str:
+  """StableHLO text of a ``jax.jit(...).lower(...)`` result, with source/
+  scope locations.
+
+  Version-portable: jax >= 0.5 takes ``as_text(debug_info=...)``; on
+  older releases the same output comes from the MLIR module's
+  ``get_asm(enable_debug_info=...)``. Named scopes (``render/warp`` etc.)
+  only appear in the debug-info form.
+  """
+  try:
+    return lowered.as_text(debug_info=debug_info)
+  except TypeError:  # jax < 0.5: no debug_info kwarg
+    return lowered.compiler_ir().operation.get_asm(
+        enable_debug_info=debug_info)

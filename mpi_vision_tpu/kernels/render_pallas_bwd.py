@@ -643,8 +643,34 @@ def _adjoint_shr_kernel(hom_ref, meta_ref, meta_next_ref, wq_ref, grad_ref,
 
 
 def _inv_homs(homs32):
-  """Normalized f32 inverses of ``[..., 3, 3]`` homographies."""
-  inv = jnp.linalg.inv(homs32)
+  """Normalized f32 inverses of ``[..., 3, 3]`` homographies.
+
+  Closed-form adjugate, not ``jnp.linalg.inv``: the LU path lowers through
+  ``lax.custom_linear_solve``, whose closure tracing breaks with an
+  UnexpectedTracerError when the jitted stats are re-traced under
+  ``ensure_compile_time_eval`` on jax 0.4.x (the planners' calling
+  convention) — and the cofactor form is cheaper for 3x3 anyway.
+  """
+  m = homs32
+  c00 = m[..., 1, 1] * m[..., 2, 2] - m[..., 1, 2] * m[..., 2, 1]
+  c01 = m[..., 1, 2] * m[..., 2, 0] - m[..., 1, 0] * m[..., 2, 2]
+  c02 = m[..., 1, 0] * m[..., 2, 1] - m[..., 1, 1] * m[..., 2, 0]
+  c10 = m[..., 0, 2] * m[..., 2, 1] - m[..., 0, 1] * m[..., 2, 2]
+  c11 = m[..., 0, 0] * m[..., 2, 2] - m[..., 0, 2] * m[..., 2, 0]
+  c12 = m[..., 0, 1] * m[..., 2, 0] - m[..., 0, 0] * m[..., 2, 1]
+  c20 = m[..., 0, 1] * m[..., 1, 2] - m[..., 0, 2] * m[..., 1, 1]
+  c21 = m[..., 0, 2] * m[..., 1, 0] - m[..., 0, 0] * m[..., 1, 2]
+  c22 = m[..., 0, 0] * m[..., 1, 1] - m[..., 0, 1] * m[..., 1, 0]
+  det = m[..., 0, 0] * c00 + m[..., 0, 1] * c01 + m[..., 0, 2] * c02
+  adj = jnp.stack([jnp.stack([c00, c10, c20], -1),
+                   jnp.stack([c01, c11, c21], -1),
+                   jnp.stack([c02, c12, c22], -1)], -2)
+  # The det division looks redundant (the [2,2] renormalization cancels
+  # it) but is kept deliberately: a singular homography must yield
+  # inf/nan here — exactly as jnp.linalg.inv did — so the planners'
+  # isfinite checks reject the pose; adj/adj[2,2] alone would return
+  # finite garbage for det=0 and let a degenerate pose plan a kernel.
+  inv = adj / det[..., None, None]
   return inv / inv[..., 2:3, 2:3]
 
 

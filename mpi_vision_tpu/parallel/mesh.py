@@ -28,8 +28,9 @@ import functools
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from mpi_vision_tpu.compat import shard_map
 
 from mpi_vision_tpu.core import compose, render
 from mpi_vision_tpu.core.sampling import Convention
@@ -119,11 +120,9 @@ def render_views_sharded(
 
   def local_render(mpi, poses, k):
     # mpi [1, H, W, P, 4] (replicated), poses [V/n, 4, 4].
-    vn = poses.shape[0]
-    planes = jnp.broadcast_to(mpi, (vn,) + mpi.shape[1:])
-    return render.render_mpi(planes, poses, depths, k.reshape(1, 3, 3).repeat(vn, 0),
-                             convention=convention, method=method,
-                             **render_kwargs)
+    return render.render_views(mpi[0], poses, depths, k.reshape(3, 3),
+                               convention=convention, method=method,
+                               **render_kwargs)
 
   # fused_pallas only: pallas_call outputs don't carry the vma metadata the
   # checker needs (each shard's render is fully local, so nothing is lost);

@@ -1,0 +1,21 @@
+"""Batched MPI render serving: scene cache, micro-batching, metrics, HTTP.
+
+The request path the one-shot CLI lacks (ROADMAP north star: serve heavy
+traffic): bake scenes once into a byte-budgeted LRU cache (``cache``),
+coalesce concurrent same-scene pose requests into one batched device
+dispatch (``scheduler`` -> ``engine``, sharded across visible devices),
+export latency/throughput/batch/cache metrics (``metrics``), and front it
+all with an in-process API plus a stdlib HTTP server (``server``).
+``python -m mpi_vision_tpu serve`` runs it; ``bench/serve_load.py`` is the
+closed-loop load generator.
+"""
+
+from mpi_vision_tpu.serve.cache import BakedScene, SceneCache, bake_scene
+from mpi_vision_tpu.serve.engine import RenderEngine
+from mpi_vision_tpu.serve.metrics import ServeMetrics
+from mpi_vision_tpu.serve.scheduler import MicroBatcher, QueueFullError
+from mpi_vision_tpu.serve.server import (
+    RenderService,
+    make_http_server,
+    synthetic_scene,
+)

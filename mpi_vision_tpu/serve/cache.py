@@ -1,0 +1,128 @@
+"""LRU cache of baked MPI scenes with a byte budget.
+
+Serving splits the render pipeline the way FastNeRF splits cache from
+sample: *baking* a scene — producing its MPI and placing it on device — is
+expensive and per-scene cacheable, while *serving* a pose is cheap and
+batches well. This module holds the baked side: device-resident
+``BakedScene``s keyed by scene id, least-recently-used eviction once the
+byte budget is exceeded, and hit/miss/eviction counters that feed
+``serve/metrics.py`` (cache hit rate is a first-class serving metric — a
+thrashing scene cache turns every request into a bake).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from collections import OrderedDict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class BakedScene:
+  """One servable scene, resident on device."""
+
+  scene_id: str
+  rgba_layers: jnp.ndarray  # [H, W, P, 4], planes back-to-front
+  depths: jnp.ndarray       # [P], descending (see camera.inv_depths)
+  intrinsics: jnp.ndarray   # [3, 3]
+  nbytes: int
+
+
+def bake_scene(scene_id, rgba_layers, depths, intrinsics) -> BakedScene:
+  """Place host arrays on device as one servable scene (f32).
+
+  Blocks until the transfer lands so the bake cost is paid here, inside
+  the cache-miss accounting, not silently inside the first render.
+  """
+  rgba = jnp.asarray(rgba_layers, jnp.float32)
+  d = jnp.asarray(depths, jnp.float32)
+  k = jnp.asarray(intrinsics, jnp.float32)
+  if rgba.ndim != 4 or rgba.shape[-1] != 4:
+    raise ValueError(f"rgba_layers must be [H, W, P, 4], got {rgba.shape}")
+  if d.shape != (rgba.shape[2],):
+    raise ValueError(
+        f"depths {d.shape} must be [P] matching rgba planes {rgba.shape[2]}")
+  if k.shape != (3, 3):
+    raise ValueError(f"intrinsics must be [3, 3], got {k.shape}")
+  jax.block_until_ready(rgba)
+  nbytes = sum(int(np.prod(a.shape)) * a.dtype.itemsize
+               for a in (rgba, d, k))
+  return BakedScene(str(scene_id), rgba, d, k, nbytes)
+
+
+class SceneCache:
+  """Thread-safe LRU over ``BakedScene`` with byte-budget eviction.
+
+  Eviction keeps at least the most recent scene even when it alone
+  exceeds the budget — a cache that refuses every scene cannot serve.
+  """
+
+  def __init__(self, byte_budget: int = 2 << 30):
+    if byte_budget <= 0:
+      raise ValueError(f"byte_budget must be positive, got {byte_budget}")
+    self.byte_budget = int(byte_budget)
+    self._scenes: OrderedDict[str, BakedScene] = OrderedDict()
+    self._bytes = 0
+    self._lock = threading.Lock()
+    self.hits = 0
+    self.misses = 0
+    self.evictions = 0
+
+  def get(self, scene_id: str) -> BakedScene | None:
+    with self._lock:
+      scene = self._scenes.get(scene_id)
+      if scene is None:
+        self.misses += 1
+        return None
+      self._scenes.move_to_end(scene_id)
+      self.hits += 1
+      return scene
+
+  def put(self, scene: BakedScene) -> None:
+    with self._lock:
+      old = self._scenes.pop(scene.scene_id, None)
+      if old is not None:
+        self._bytes -= old.nbytes
+      self._scenes[scene.scene_id] = scene
+      self._bytes += scene.nbytes
+      self._evict_locked()
+
+  def get_or_bake(self, scene_id: str, bake) -> BakedScene:
+    """Cached scene, or ``bake()``'s result inserted (miss accounted)."""
+    scene = self.get(scene_id)
+    if scene is not None:
+      return scene
+    scene = bake()
+    self.put(scene)
+    return scene
+
+  def _evict_locked(self) -> None:
+    while self._bytes > self.byte_budget and len(self._scenes) > 1:
+      _, evicted = self._scenes.popitem(last=False)
+      self._bytes -= evicted.nbytes
+      self.evictions += 1
+
+  def __contains__(self, scene_id: str) -> bool:
+    with self._lock:
+      return scene_id in self._scenes
+
+  def __len__(self) -> int:
+    with self._lock:
+      return len(self._scenes)
+
+  def stats(self) -> dict:
+    with self._lock:
+      lookups = self.hits + self.misses
+      return {
+          "scenes": len(self._scenes),
+          "bytes": self._bytes,
+          "byte_budget": self.byte_budget,
+          "hits": self.hits,
+          "misses": self.misses,
+          "evictions": self.evictions,
+          "hit_rate": (self.hits / lookups) if lookups else None,
+      }

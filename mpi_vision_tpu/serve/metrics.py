@@ -1,0 +1,89 @@
+"""Serving metrics: request latencies, throughput, batches, queue depth.
+
+Lock-guarded counters plus a bounded window of recent request latencies;
+``snapshot()`` returns a plain-JSON dict (the ``/stats`` payload and the
+load generator's source of truth). Percentiles are nearest-rank over the
+last ``window`` completed requests — serving tails, not lifetime means,
+are what capacity planning reads (p99 is the headline number for "heavy
+traffic from millions of users", ROADMAP).
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+
+
+def percentile(sorted_values, q: float) -> float:
+  """Nearest-rank percentile of an already-sorted non-empty sequence."""
+  idx = round(q * (len(sorted_values) - 1))
+  return float(sorted_values[idx])
+
+
+class ServeMetrics:
+  """Aggregates the serving layer's observability counters."""
+
+  def __init__(self, window: int = 4096, clock=time.monotonic):
+    self._clock = clock
+    self._lock = threading.Lock()
+    self._window = window
+    self.reset()
+
+  def reset(self) -> None:
+    """Zero every counter and restart the uptime clock (load generators
+    call this after warm-up so measurements are steady-state only)."""
+    with self._lock:
+      self._t0 = self._clock()
+      self._latencies = collections.deque(maxlen=self._window)
+      self._batch_hist = collections.Counter()
+      self._queue_depth = 0
+      self.requests = 0
+      self.batches = 0
+      self.render_seconds = 0.0
+
+  def record_request(self, latency_s: float) -> None:
+    """One request completed, queue-to-response latency."""
+    with self._lock:
+      self.requests += 1
+      self._latencies.append(latency_s)
+
+  def record_batch(self, size: int, render_s: float) -> None:
+    """One device dispatch of ``size`` coalesced requests."""
+    with self._lock:
+      self.batches += 1
+      self._batch_hist[int(size)] += 1
+      self.render_seconds += render_s
+
+  def set_queue_depth(self, depth: int) -> None:
+    with self._lock:
+      self._queue_depth = int(depth)
+
+  def snapshot(self, cache_stats: dict | None = None) -> dict:
+    """JSON-ready state: latency percentiles, throughput, batch shape."""
+    with self._lock:
+      uptime = max(self._clock() - self._t0, 1e-9)
+      lat = sorted(self._latencies)
+      out = {
+          "uptime_s": round(uptime, 3),
+          "requests": self.requests,
+          "renders_per_sec": round(self.requests / uptime, 3),
+          "latency_ms": None,
+          "batches": self.batches,
+          "batch_size_hist": {str(k): v
+                              for k, v in sorted(self._batch_hist.items())},
+          "mean_batch_size": (round(self.requests / self.batches, 3)
+                              if self.batches else None),
+          "device_render_seconds": round(self.render_seconds, 3),
+          "queue_depth": self._queue_depth,
+      }
+      if lat:
+        out["latency_ms"] = {
+            "p50": round(percentile(lat, 0.50) * 1e3, 3),
+            "p95": round(percentile(lat, 0.95) * 1e3, 3),
+            "p99": round(percentile(lat, 0.99) * 1e3, 3),
+            "max": round(lat[-1] * 1e3, 3),
+        }
+    if cache_stats is not None:
+      out["cache"] = cache_stats
+    return out

@@ -1,0 +1,225 @@
+"""Micro-batching scheduler: coalesce same-scene pose renders.
+
+The serving win (Potamoi-style streaming renderers, PAPERS.md): per-pose
+renders of an already-baked scene are cheap and *batch on the view axis
+for free*, so concurrent requests for the same scene should ride one
+device dispatch, not N. Requests enter a FIFO; a single dispatcher thread
+takes the oldest pending request, coalesces every other pending request
+for the SAME scene (up to ``max_batch``), waits up to ``max_wait_ms``
+from that request's enqueue for stragglers, and dispatches the batch to
+the engine as one compiled call. Each request's future resolves with its
+own view — bit-identical to an unbatched render of the same pose
+(``core.render.render_views`` batches element-independently; the engine
+pads with repeated poses, never altering live views).
+
+One dispatch in flight at a time: the device is the serialized resource,
+and the queue is the backpressure signal (depth exported via metrics).
+Requests for other scenes keep FIFO order among themselves.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future, TimeoutError as FuturesTimeoutError
+
+import numpy as np
+
+from mpi_vision_tpu.serve.engine import RenderEngine
+from mpi_vision_tpu.serve.metrics import ServeMetrics
+
+
+class QueueFullError(RuntimeError):
+  """Backpressure signal: the request queue is at ``max_queue``.
+
+  Raised at submit time so overload is shed at the door (HTTP maps it to
+  503) instead of building an unbounded backlog of requests whose callers
+  will have timed out by the time the device reaches them.
+  """
+
+
+@dataclasses.dataclass
+class _Pending:
+  scene_id: str
+  pose: np.ndarray
+  future: Future
+  t_enqueue: float
+
+
+class MicroBatcher:
+  """Request queue + dispatcher thread in front of a ``RenderEngine``.
+
+  Args:
+    engine: the device dispatch layer.
+    scene_provider: ``scene_id -> BakedScene`` (typically
+      ``SceneCache.get_or_bake`` partial'd over the server's scene
+      registry); exceptions fail the whole batch's futures.
+    metrics: counters sink (a private one is made if omitted).
+    max_batch: hard cap on coalesced requests per dispatch.
+    max_wait_ms: straggler window measured from the oldest request's
+      enqueue time. 0 disables waiting (whatever is pending when the
+      dispatcher wakes still coalesces).
+    max_queue: pending-request cap; submissions beyond it raise
+      ``QueueFullError`` (shed load instead of queueing past the point
+      where callers' timeouts make the work dead anyway).
+  """
+
+  def __init__(self, engine: RenderEngine, scene_provider,
+               metrics: ServeMetrics | None = None,
+               max_batch: int = 8, max_wait_ms: float = 2.0,
+               max_queue: int = 1024):
+    if max_batch < 1:
+      raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+    if max_queue < 1:
+      raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+    self.engine = engine
+    self.scene_provider = scene_provider
+    self.metrics = ServeMetrics() if metrics is None else metrics
+    self.max_batch = max_batch
+    self.max_wait_s = max(max_wait_ms, 0.0) / 1e3
+    self.max_queue = max_queue
+    self.rejected = 0
+    self._queue: deque[_Pending] = deque()
+    self._cond = threading.Condition()
+    self._stop = False
+    self._thread: threading.Thread | None = None
+
+  # -- lifecycle ----------------------------------------------------------
+
+  def start(self) -> "MicroBatcher":
+    if self._thread is not None:
+      raise RuntimeError("MicroBatcher already started")
+    self._thread = threading.Thread(target=self._loop,
+                                    name="mpi-serve-dispatch", daemon=True)
+    self._thread.start()
+    return self
+
+  def stop(self, timeout: float = 10.0) -> None:
+    with self._cond:
+      self._stop = True
+      self._cond.notify_all()
+    if self._thread is not None:
+      self._thread.join(timeout)
+      self._thread = None
+    with self._cond:
+      while self._queue:  # drain: fail leftovers instead of hanging callers
+        req = self._queue.popleft()
+        if req.future.set_running_or_notify_cancel():
+          req.future.set_exception(RuntimeError("scheduler stopped"))
+      self.metrics.set_queue_depth(0)
+
+  # -- request path -------------------------------------------------------
+
+  def submit(self, scene_id: str, pose) -> Future:
+    """Enqueue one pose render; the future resolves to ``[H, W, 3]``."""
+    pose = np.asarray(pose, np.float32)
+    if pose.shape != (4, 4):
+      raise ValueError(f"pose must be [4, 4], got {pose.shape}")
+    fut: Future = Future()
+    req = _Pending(str(scene_id), pose, fut, time.monotonic())
+    with self._cond:
+      if self._stop or self._thread is None:
+        raise RuntimeError("scheduler is not running")
+      if len(self._queue) >= self.max_queue:
+        self.rejected += 1
+        raise QueueFullError(
+            f"request queue full ({self.max_queue} pending)")
+      self._queue.append(req)
+      self.metrics.set_queue_depth(len(self._queue))
+      self._cond.notify_all()
+    return fut
+
+  def render(self, scene_id: str, pose, timeout: float = 60.0) -> np.ndarray:
+    """Synchronous render: submit + wait.
+
+    On timeout the request is cancelled (best-effort) so an overloaded
+    queue is not burning device dispatches on results nobody will read.
+    """
+    fut = self.submit(scene_id, pose)
+    try:
+      return fut.result(timeout)
+    except FuturesTimeoutError:
+      fut.cancel()
+      raise
+
+  # -- dispatcher ---------------------------------------------------------
+
+  def _take_batch(self) -> list[_Pending]:
+    """Block for work, then coalesce one same-scene batch (FIFO head's
+    scene). Returns [] only on stop."""
+    with self._cond:
+      while True:
+        # Cancelled requests (caller timed out) must neither stall the
+        # head slot nor burn a dispatch; drop them eagerly.
+        while self._queue and self._queue[0].future.cancelled():
+          self._queue.popleft()
+        if self._stop:
+          return []
+        if not self._queue:
+          self.metrics.set_queue_depth(0)
+          self._cond.wait()
+          continue
+        head = self._queue[0]
+        deadline = head.t_enqueue + self.max_wait_s
+        # Straggler window: keep collecting same-scene requests until the
+        # batch is full or the head request's wait budget is spent.
+        while True:
+          same = sum(1 for r in self._queue
+                     if r.scene_id == head.scene_id
+                     and not r.future.cancelled())
+          remaining = deadline - time.monotonic()
+          if same >= self.max_batch or remaining <= 0 or self._stop:
+            break
+          self._cond.wait(remaining)
+        batch, rest = [], deque()
+        for req in self._queue:
+          if req.future.cancelled():
+            continue
+          if req.scene_id == head.scene_id and len(batch) < self.max_batch:
+            batch.append(req)
+          else:
+            rest.append(req)
+        self._queue = rest
+        self.metrics.set_queue_depth(len(self._queue))
+        if batch:
+          return batch
+        # Everything same-scene was cancelled during the wait; go around
+        # (other-scene requests are back in the queue, NOT a stop).
+
+  def _dispatch(self, batch: list[_Pending]) -> None:
+    # Claim every future first (PENDING -> RUNNING): a future that was
+    # cancelled between dequeue and here drops out, and a claimed one can
+    # no longer be cancelled under us (set_result would InvalidStateError,
+    # killing the only dispatcher thread).
+    batch = [r for r in batch if r.future.set_running_or_notify_cancel()]
+    if not batch:
+      return
+    try:
+      # Scene lookup BEFORE the render timer: a cache-miss bake (blocking
+      # host->device transfer) must show up in cache stats, not inflate
+      # device_render_seconds/batch latency as a phantom slow kernel.
+      scene = self.scene_provider(batch[0].scene_id)
+      t0 = time.perf_counter()
+      out = self.engine.render_batch(
+          scene, np.stack([r.pose for r in batch]))
+    except Exception as e:  # noqa: BLE001 - forwarded to every caller
+      for req in batch:
+        req.future.set_exception(e)
+      return
+    render_s = time.perf_counter() - t0
+    done = time.monotonic()
+    self.metrics.record_batch(len(batch), render_s)
+    for i, req in enumerate(batch):
+      self.metrics.record_request(done - req.t_enqueue)
+      # Copy: out[i] is a view into the whole padded batch buffer; a
+      # caller holding one image must not pin bucket x image bytes.
+      req.future.set_result(out[i].copy())
+
+  def _loop(self) -> None:
+    while True:
+      batch = self._take_batch()
+      if not batch:
+        return
+      self._dispatch(batch)

@@ -1,0 +1,268 @@
+"""Serving front ends: in-process service + stdlib HTTP server.
+
+``RenderService`` wires cache + engine + scheduler + metrics into one
+object with a pure-Python API — tests and ``bench/serve_load.py`` drive it
+directly, no sockets. ``make_http_server`` wraps a service in a threaded
+stdlib ``http.server`` front end:
+
+  GET  /healthz -> {"status": "ok", "devices", "scenes", ...}
+  GET  /stats   -> the metrics snapshot (latency percentiles, throughput,
+                   batch-size histogram, queue depth, cache hit rate)
+  POST /render  -> body {"scene_id": str, "pose": [[...4x4...]]} ->
+                   {"scene_id", "shape", "dtype", "image_b64"} — raw
+                   little-endian f32 pixels, base64 (shape [H, W, 3]).
+
+Scenes register host-side (``add_scene``) and bake lazily through the
+LRU cache on first request, so cache hit/miss accounting reflects real
+traffic. 404 for unknown scenes, 400 for malformed requests, 503 when
+the scheduler sheds load (queue at ``max_queue``); handler threads block
+on the scheduler future, so HTTP concurrency turns into micro-batch
+coalescing on the device.
+"""
+
+from __future__ import annotations
+
+import base64
+import functools
+import json
+import threading
+import zlib
+from concurrent.futures import TimeoutError as FuturesTimeoutError
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from mpi_vision_tpu.core import camera
+from mpi_vision_tpu.core.camera import inv_depths
+from mpi_vision_tpu.serve import cache as cache_mod
+from mpi_vision_tpu.serve.engine import RenderEngine
+from mpi_vision_tpu.serve.metrics import ServeMetrics
+from mpi_vision_tpu.serve.scheduler import MicroBatcher, QueueFullError
+
+
+def synthetic_scene(scene_id: str, height: int = 256, width: int = 256,
+                    planes: int = 16, seed: int = 0):
+  """A procedural (rgba_layers, depths, intrinsics) scene for demos/load.
+
+  Smooth per-plane color gradients with sparse alpha, seeded by
+  ``(seed, scene_id)`` so distinct ids render distinctly even at one
+  seed — enough structure that renders differ across poses and scenes,
+  hermetic enough for CI.
+  """
+  rng = np.random.default_rng([seed, zlib.crc32(str(scene_id).encode())])
+  yy, xx = np.meshgrid(np.linspace(0, 1, height, dtype=np.float32),
+                       np.linspace(0, 1, width, dtype=np.float32),
+                       indexing="ij")
+  layers = np.empty((height, width, planes, 4), np.float32)
+  for p in range(planes):
+    phase = rng.uniform(0, 2 * np.pi, 3)
+    freq = rng.uniform(1.0, 4.0, 3)
+    for c in range(3):
+      layers[..., p, c] = 0.5 + 0.5 * np.sin(
+          freq[c] * (xx + yy) * np.pi + phase[c])
+    alpha = 0.5 + 0.5 * np.sin(freq[0] * xx * 7 + phase[0] + p)
+    layers[..., p, 3] = np.clip(alpha - 0.3, 0.0, 1.0)
+  depths = np.asarray(inv_depths(1.0, 100.0, planes), np.float32)
+  fx = 0.5 * width
+  k = np.asarray(camera.intrinsics_matrix(fx, fx, width / 2.0, height / 2.0),
+                 np.float32)
+  return layers, depths, k
+
+
+class RenderService:
+  """The in-process serving API (the HTTP layer is a thin shell on this).
+
+  Args:
+    cache_bytes: scene-cache byte budget.
+    max_batch / max_wait_ms: micro-batching knobs (scheduler.py).
+    method / use_mesh: renderer routing knobs (engine.py).
+  """
+
+  def __init__(self, cache_bytes: int = 2 << 30, max_batch: int = 8,
+               max_wait_ms: float = 2.0, method: str = "fused",
+               use_mesh: bool | None = None, max_queue: int = 1024,
+               engine: RenderEngine | None = None):
+    self.engine = engine if engine is not None else RenderEngine(
+        method=method, use_mesh=use_mesh)
+    self.cache = cache_mod.SceneCache(byte_budget=cache_bytes)
+    self.metrics = ServeMetrics()
+    self._scene_data: dict[str, tuple] = {}
+    self._scene_lock = threading.Lock()
+    self.scheduler = MicroBatcher(
+        self.engine, self._get_scene, metrics=self.metrics,
+        max_batch=max_batch, max_wait_ms=max_wait_ms,
+        max_queue=max_queue).start()
+    self._closed = False
+
+  # -- scenes -------------------------------------------------------------
+
+  def add_scene(self, scene_id: str, rgba_layers, depths,
+                intrinsics) -> None:
+    """Register a scene (host arrays); it bakes lazily on first request."""
+    entry = (np.asarray(rgba_layers, np.float32),
+             np.asarray(depths, np.float32),
+             np.asarray(intrinsics, np.float32))
+    with self._scene_lock:
+      self._scene_data[str(scene_id)] = entry
+
+  def add_synthetic_scenes(self, n: int, height: int = 256, width: int = 256,
+                           planes: int = 16, seed: int = 0) -> list[str]:
+    ids = []
+    for i in range(n):
+      sid = f"scene_{i:03d}"
+      self.add_scene(sid, *synthetic_scene(sid, height, width, planes,
+                                           seed=seed + i))
+      ids.append(sid)
+    return ids
+
+  def scene_ids(self) -> list[str]:
+    with self._scene_lock:
+      return sorted(self._scene_data)
+
+  def _get_scene(self, scene_id: str) -> cache_mod.BakedScene:
+    def bake():
+      with self._scene_lock:
+        entry = self._scene_data.get(scene_id)
+      if entry is None:
+        raise KeyError(f"unknown scene {scene_id!r}")
+      return cache_mod.bake_scene(scene_id, *entry)
+
+    return self.cache.get_or_bake(scene_id, bake)
+
+  def warmup(self, scene_ids=None) -> None:
+    """Bake scenes (default: all registered) and compile every batch
+    bucket up to the scheduler's ``max_batch`` for the first scene's
+    geometry, so steady-state traffic never pays an XLA compile."""
+    ids = list(scene_ids) if scene_ids is not None else self.scene_ids()
+    if not ids:
+      return
+    scenes = [self._get_scene(sid) for sid in ids]
+    eye = np.eye(4, dtype=np.float32)
+    buckets = sorted({self.engine.batch_bucket(v)
+                      for v in range(1, self.scheduler.max_batch + 1)})
+    for b in buckets:
+      self.engine.render_batch(scenes[0], np.broadcast_to(eye, (b, 4, 4)))
+
+  # -- request path -------------------------------------------------------
+
+  def render(self, scene_id: str, pose, timeout: float = 60.0) -> np.ndarray:
+    """Blocking render of one ``[4, 4]`` pose -> ``[H, W, 3]`` f32."""
+    return self.scheduler.render(scene_id, pose, timeout=timeout)
+
+  def render_async(self, scene_id: str, pose):
+    """Non-blocking render; returns a ``concurrent.futures.Future``."""
+    return self.scheduler.submit(scene_id, pose)
+
+  # -- observability ------------------------------------------------------
+
+  def stats(self) -> dict:
+    out = self.metrics.snapshot(cache_stats=self.cache.stats())
+    out["rejected"] = self.scheduler.rejected
+    out["engine"] = self.engine.describe()
+    return out
+
+  def healthz(self) -> dict:
+    return {
+        "status": "closed" if self._closed else "ok",
+        "devices": len(self.engine.devices),
+        "platform": self.engine.devices[0].platform,
+        "scenes": len(self.scene_ids()),
+    }
+
+  def close(self) -> None:
+    if not self._closed:
+      self._closed = True
+      self.scheduler.stop()
+
+  def __enter__(self):
+    return self
+
+  def __exit__(self, *exc):
+    self.close()
+
+
+# A /render body is a scene id + 4x4 pose (< 1 KB); anything near this cap
+# is malformed or hostile, and the handler must not buffer it.
+_MAX_BODY_BYTES = 1 << 20
+
+
+class _Handler(BaseHTTPRequestHandler):
+  """One request per thread (ThreadingHTTPServer); blocking on the
+  scheduler future is what feeds concurrent HTTP load into one batch."""
+
+  service: RenderService  # bound via functools.partial in make_http_server
+
+  def __init__(self, service: RenderService, *args, **kwargs):
+    self.service = service
+    super().__init__(*args, **kwargs)
+
+  def log_message(self, fmt, *args):  # noqa: A003 - stdlib signature
+    pass  # request logging is the metrics layer's job, not stderr's
+
+  def _send_json(self, payload: dict, status: int = 200) -> None:
+    body = json.dumps(payload).encode()
+    self.send_response(status)
+    self.send_header("Content-Type", "application/json")
+    self.send_header("Content-Length", str(len(body)))
+    self.end_headers()
+    self.wfile.write(body)
+
+  def do_GET(self):  # noqa: N802 - stdlib name
+    if self.path == "/healthz":
+      self._send_json(self.service.healthz())
+    elif self.path == "/stats":
+      self._send_json(self.service.stats())
+    else:
+      self._send_json({"error": f"unknown path {self.path}"}, status=404)
+
+  def do_POST(self):  # noqa: N802 - stdlib name
+    if self.path != "/render":
+      self._send_json({"error": f"unknown path {self.path}"}, status=404)
+      return
+    try:
+      length = int(self.headers.get("Content-Length", "0"))
+      if not 0 <= length <= _MAX_BODY_BYTES:
+        # Negative lengths would turn rfile.read into a block-until-EOF
+        # on a held-open socket — the same thread-leak DoS as oversize.
+        raise ValueError(f"bad body length ({length} bytes)")
+      req = json.loads(self.rfile.read(length) or b"{}")
+      if not isinstance(req, dict):
+        raise ValueError(f"body must be a JSON object, got {type(req).__name__}")
+      scene_id = req["scene_id"]
+      pose = np.asarray(req["pose"], np.float32)
+      if pose.shape != (4, 4):
+        raise ValueError(f"pose must be 4x4, got {pose.shape}")
+    except (KeyError, TypeError, ValueError, json.JSONDecodeError) as e:
+      self._send_json({"error": f"bad request: {e}"}, status=400)
+      return
+    try:
+      img = self.service.render(scene_id, pose)
+    except KeyError as e:
+      self._send_json({"error": str(e)}, status=404)
+      return
+    except QueueFullError as e:
+      self._send_json({"error": str(e)}, status=503)
+      return
+    except FuturesTimeoutError:
+      self._send_json({"error": "render timed out in queue"}, status=504)
+      return
+    except Exception as e:  # noqa: BLE001 - surfaced to the client
+      self._send_json({"error": f"render failed: {e}"}, status=500)
+      return
+    img = np.ascontiguousarray(img, np.dtype("<f4"))
+    self._send_json({
+        "scene_id": scene_id,
+        "shape": list(img.shape),
+        "dtype": "<f4",
+        "image_b64": base64.b64encode(img.tobytes()).decode(),
+    })
+
+
+def make_http_server(service: RenderService, host: str = "127.0.0.1",
+                     port: int = 0) -> ThreadingHTTPServer:
+  """A ready-to-``serve_forever`` threaded HTTP server (port 0 = ephemeral;
+  the bound port is ``server.server_address[1]``)."""
+  handler = functools.partial(_Handler, service)
+  server = ThreadingHTTPServer((host, port), handler)
+  server.daemon_threads = True
+  return server

@@ -215,7 +215,7 @@ def shard_train_step_planned(mesh: Mesh, vgg_params: Any | None = None,
   single-chip step; place ``state`` with ``replicate`` and the batch with
   ``shard_batch``.
   """
-  from jax import shard_map as _smap
+  from mpi_vision_tpu.compat import shard_map as _smap
   from mpi_vision_tpu.parallel.mesh import batch_spec
 
   cache: dict = {}

@@ -1,0 +1,316 @@
+"""In-process serve/ subsystem tests: cache, scheduler, metrics, HTTP.
+
+Everything runs through ``RenderService``'s pure-Python API (plus one
+socketed HTTP round-trip) on tiny scenes; the acceptance invariant is
+that micro-batching is *invisible* in the pixels — a request's image is
+bit-identical whether it rode a coalesced batch or a lone dispatch.
+"""
+
+import base64
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mpi_vision_tpu.serve import (
+    RenderService,
+    SceneCache,
+    bake_scene,
+    make_http_server,
+    synthetic_scene,
+)
+from mpi_vision_tpu.serve.metrics import ServeMetrics, percentile
+
+H = W = 16
+P = 4
+
+
+def _pose(tx=0.0, tz=0.0):
+  pose = np.eye(4, dtype=np.float32)
+  pose[0, 3], pose[2, 3] = tx, tz
+  return pose
+
+
+@pytest.fixture(scope="module")
+def svc():
+  service = RenderService(max_batch=4, max_wait_ms=250.0, use_mesh=False)
+  service.add_synthetic_scenes(2, height=H, width=W, planes=P)
+  yield service
+  service.close()
+
+
+# --- cache ---------------------------------------------------------------
+
+
+def _baked(sid, seed=0):
+  return bake_scene(sid, *synthetic_scene(sid, H, W, P, seed=seed))
+
+
+def test_cache_lru_eviction_and_counters():
+  one = _baked("a").nbytes
+  cache = SceneCache(byte_budget=2 * one)  # room for two scenes
+  for sid in ("a", "b", "c"):
+    assert cache.get(sid) is None  # 3 misses
+    cache.put(_baked(sid))
+  assert len(cache) == 2 and "a" not in cache  # LRU evicted
+  assert cache.get("c").scene_id == "c"
+  assert cache.get("b") is not None  # b now most recent
+  cache.put(_baked("d"))  # evicts c (LRU after the b touch)
+  assert "c" not in cache and "b" in cache
+  stats = cache.stats()
+  assert stats["evictions"] == 2 and stats["misses"] == 3
+  assert stats["hits"] == 2 and stats["hit_rate"] == pytest.approx(0.4)
+  assert stats["bytes"] <= stats["byte_budget"]
+
+
+def test_cache_keeps_newest_scene_over_budget():
+  cache = SceneCache(byte_budget=1)  # smaller than any scene
+  cache.put(_baked("a"))
+  assert "a" in cache  # must still serve
+
+
+def test_bake_scene_validates_shapes():
+  rgba, depths, k = synthetic_scene("s", H, W, P)
+  with pytest.raises(ValueError, match="rgba_layers"):
+    bake_scene("s", rgba[..., :3], depths, k)
+  with pytest.raises(ValueError, match="depths"):
+    bake_scene("s", rgba, depths[:-1], k)
+  with pytest.raises(ValueError, match="intrinsics"):
+    bake_scene("s", rgba, depths, k[:2])
+
+
+# --- metrics -------------------------------------------------------------
+
+
+def test_percentile_nearest_rank():
+  vals = sorted(range(1, 101))
+  assert percentile(vals, 0.50) == 51  # nearest rank on 0..99 indices
+  assert percentile(vals, 0.99) == 99
+  assert percentile([7.0], 0.99) == 7.0
+
+
+def test_metrics_snapshot_schema():
+  m = ServeMetrics(window=8)
+  for lat in (0.010, 0.020, 0.030):
+    m.record_request(lat)
+  m.record_batch(3, 0.025)
+  m.set_queue_depth(5)
+  snap = m.snapshot(cache_stats={"hit_rate": 0.5})
+  assert snap["requests"] == 3 and snap["batches"] == 1
+  assert snap["batch_size_hist"] == {"3": 1}
+  assert snap["queue_depth"] == 5 and snap["cache"]["hit_rate"] == 0.5
+  assert snap["latency_ms"]["p50"] == pytest.approx(20.0)
+  assert snap["latency_ms"]["p99"] == pytest.approx(30.0)
+  assert snap["renders_per_sec"] > 0
+
+
+# --- scheduler + engine: the acceptance invariant ------------------------
+
+
+def test_concurrent_requests_coalesce_and_match_unbatched(svc):
+  """>= 2 concurrent same-scene requests ride ONE device dispatch and
+  each result is bit-identical to its unbatched render."""
+  poses = [_pose(0.01 * i, -0.005 * i) for i in range(4)]
+  before = svc.engine.dispatches
+  futs = [svc.render_async("scene_000", p) for p in poses]
+  outs = [f.result(120) for f in futs]
+  assert svc.engine.dispatches - before == 1  # one coalesced dispatch
+  hist = svc.stats()["batch_size_hist"]
+  assert max(int(k) for k in hist) >= 2
+  for pose, out in zip(poses, outs):
+    solo = svc.render("scene_000", pose)  # its own batch-of-1 dispatch
+    assert out.dtype == np.float32
+    np.testing.assert_array_equal(out, solo)
+
+
+def test_mixed_scene_requests_batch_per_scene(svc):
+  futs = [svc.render_async("scene_000", _pose(0.01)),
+          svc.render_async("scene_001", _pose(0.01)),
+          svc.render_async("scene_000", _pose(0.02))]
+  outs = [f.result(120) for f in futs]
+  # Different scenes render differently; same scheduler, no cross-talk.
+  assert not np.array_equal(outs[0], outs[1])
+  np.testing.assert_array_equal(
+      outs[2], svc.render("scene_000", _pose(0.02)))
+
+
+def test_unknown_scene_fails_that_request_only(svc):
+  bad = svc.render_async("no_such_scene", _pose())
+  good = svc.render_async("scene_000", _pose())
+  with pytest.raises(KeyError, match="no_such_scene"):
+    bad.result(120)
+  assert good.result(120).shape == (H, W, 3)
+
+
+def test_stats_serving_schema(svc):
+  svc.render("scene_000", _pose(0.03))
+  stats = svc.stats()
+  assert json.loads(json.dumps(stats)) == stats  # JSON-clean
+  for key in ("p50", "p95", "p99"):
+    assert stats["latency_ms"][key] > 0
+  assert stats["renders_per_sec"] > 0
+  assert 0 < stats["cache"]["hit_rate"] <= 1
+  assert stats["engine"]["devices"] >= 1
+  assert stats["uptime_s"] > 0 and stats["queue_depth"] == 0
+
+
+def test_scheduler_rejects_bad_pose(svc):
+  with pytest.raises(ValueError, match="pose"):
+    svc.render_async("scene_000", np.eye(3))
+
+
+def test_queue_full_sheds_load():
+  """Past max_queue, submissions fail fast with QueueFullError (the HTTP
+  layer's 503) instead of growing a dead backlog."""
+  import time
+
+  from mpi_vision_tpu.serve.scheduler import MicroBatcher, QueueFullError
+
+  gate = threading.Event()
+
+  class _GateEngine:
+    dispatches = 0
+
+    def render_batch(self, scene, poses):
+      gate.wait(30)
+      _GateEngine.dispatches += 1
+      return np.zeros((len(poses), 2, 2, 3), np.float32)
+
+  mb = MicroBatcher(_GateEngine(), scene_provider=lambda sid: None,
+                    max_batch=1, max_wait_ms=0.0, max_queue=2).start()
+  try:
+    first = mb.submit("s", _pose())      # taken by the dispatcher, gated
+    for _ in range(100):                 # wait for the queue to drain to it
+      if mb.metrics.snapshot()["queue_depth"] == 0:
+        break
+      time.sleep(0.01)
+    backlog = [mb.submit("s", _pose()) for _ in range(2)]  # fills max_queue
+    with pytest.raises(QueueFullError, match="queue full"):
+      mb.submit("s", _pose())
+    assert mb.rejected == 1
+    gate.set()
+    for fut in [first] + backlog:
+      assert fut.result(30).shape == (2, 2, 3)
+  finally:
+    gate.set()
+    mb.stop()
+
+
+def test_cancelled_head_does_not_kill_dispatcher():
+  """A cancelled request at the queue head must be dropped, not treated
+  as the stop signal — requests behind it still get served."""
+  import time
+
+  from mpi_vision_tpu.serve.scheduler import MicroBatcher
+
+  gate = threading.Event()
+
+  class _GateEngine:
+    def render_batch(self, scene, poses):
+      gate.wait(30)
+      return np.zeros((len(poses), 2, 2, 3), np.float32)
+
+  mb = MicroBatcher(_GateEngine(), scene_provider=lambda sid: None,
+                    max_batch=2, max_wait_ms=0.0, max_queue=8).start()
+  try:
+    first = mb.submit("scene_a", _pose())   # taken by the dispatcher, gated
+    for _ in range(100):
+      if mb.metrics.snapshot()["queue_depth"] == 0:
+        break
+      time.sleep(0.01)
+    doomed = mb.submit("scene_b", _pose())  # next head once the gate opens
+    live = mb.submit("scene_c", _pose())
+    assert doomed.cancel()
+    gate.set()
+    assert live.result(30).shape == (2, 2, 3)
+    assert first.result(30).shape == (2, 2, 3)
+    assert mb._thread.is_alive()
+  finally:
+    gate.set()
+    mb.stop()
+
+
+def test_closed_service_rejects_submissions():
+  service = RenderService(max_batch=2, max_wait_ms=1.0, use_mesh=False)
+  service.add_synthetic_scenes(1, height=H, width=W, planes=P)
+  service.close()
+  with pytest.raises(RuntimeError, match="not running"):
+    service.render_async("scene_000", _pose())
+
+
+# --- HTTP front end ------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def http_base(svc):
+  httpd = make_http_server(svc, port=0)
+  thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+  thread.start()
+  yield f"http://127.0.0.1:{httpd.server_address[1]}"
+  httpd.shutdown()
+
+
+def _get_json(url):
+  with urllib.request.urlopen(url, timeout=60) as resp:
+    return json.load(resp)
+
+
+def test_http_healthz(http_base):
+  out = _get_json(http_base + "/healthz")
+  assert out["status"] == "ok" and out["scenes"] == 2 and out["devices"] >= 1
+
+
+def test_http_render_roundtrip_bitwise(svc, http_base):
+  pose = _pose(0.015)
+  body = json.dumps({"scene_id": "scene_000",
+                     "pose": pose.tolist()}).encode()
+  req = urllib.request.Request(http_base + "/render", data=body)
+  with urllib.request.urlopen(req, timeout=120) as resp:
+    out = json.load(resp)
+  img = np.frombuffer(base64.b64decode(out["image_b64"]),
+                      out["dtype"]).reshape(out["shape"])
+  np.testing.assert_array_equal(img, svc.render("scene_000", pose))
+
+
+def test_http_stats(http_base):
+  stats = _get_json(http_base + "/stats")
+  assert "latency_ms" in stats and "batch_size_hist" in stats
+  assert "hit_rate" in stats["cache"]
+
+
+def test_http_errors(http_base):
+  pose = _pose().tolist()
+  cases = [
+      ("/render", {"scene_id": "nope", "pose": pose}, 404),
+      ("/render", {"scene_id": "scene_000"}, 400),
+      ("/render", {"scene_id": "scene_000", "pose": [[1.0]]}, 400),
+      ("/wrong", {"scene_id": "scene_000", "pose": pose}, 404),
+  ]
+  for path, payload, want in cases:
+    req = urllib.request.Request(http_base + path,
+                                 data=json.dumps(payload).encode())
+    with pytest.raises(urllib.error.HTTPError) as err:
+      urllib.request.urlopen(req, timeout=60)
+    assert err.value.code == want, (path, payload)
+
+
+def test_http_rejects_nondict_body(http_base):
+  req = urllib.request.Request(http_base + "/render", data=b"[1, 2, 3]")
+  with pytest.raises(urllib.error.HTTPError) as err:
+    urllib.request.urlopen(req, timeout=60)
+  assert err.value.code == 400
+
+
+def test_http_rejects_oversized_body(http_base):
+  # The server 400s from the Content-Length header alone and closes; a
+  # client mid-upload may see the reset (EPIPE) instead of the response —
+  # both are the rejection, never an OOM-sized buffer.
+  body = b'{"pad": "' + b" " * (1 << 20) + b'"}'
+  req = urllib.request.Request(http_base + "/render", data=body)
+  with pytest.raises((urllib.error.HTTPError, urllib.error.URLError)) as err:
+    urllib.request.urlopen(req, timeout=60)
+  if isinstance(err.value, urllib.error.HTTPError):
+    assert err.value.code == 400

@@ -1,0 +1,37 @@
+"""bench/serve_load.py dry mode on CPU (subprocess) — tier-1 smoke.
+
+Mirrors tests/test_bench_dry.py: the load generator's decision path
+(service wiring, warm-up, closed-loop workers, JSON contract) is all
+host+CPU-sized work, so guard rot in it is caught here rather than in a
+TPU window. Asserts the single JSON line carries the serving headline
+fields: renders_per_sec, p50_ms, p99_ms, cache_hit_rate.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+
+def test_serve_load_dry_emits_headline_json():
+  repo = os.path.dirname(os.path.dirname(os.path.dirname(
+      os.path.abspath(__file__))))
+  sys.path.insert(0, repo)
+  from _cpu_mesh import hardened_env
+
+  env = hardened_env(1)
+  env["SERVE_LOAD_DRY"] = "1"
+  # Share the suite's persistent XLA cache so reruns skip the compiles.
+  env["JAX_COMPILATION_CACHE_DIR"] = os.path.join(repo, ".jax_cache")
+  proc = subprocess.run(
+      [sys.executable, os.path.join(repo, "bench", "serve_load.py")],
+      capture_output=True, text=True, timeout=1200, env=env, cwd=repo)
+  assert proc.returncode == 0, (
+      f"serve_load dry run failed:\n{proc.stderr[-3000:]}")
+  out = json.loads(proc.stdout.strip().splitlines()[-1])
+  assert out["metric"] == "serve_load" and out["dry"] is True
+  assert out["device"] == "cpu"
+  assert out["renders_per_sec"] > 0
+  assert out["p50_ms"] > 0 and out["p99_ms"] >= out["p50_ms"]
+  assert 0 <= out["cache_hit_rate"] <= 1
+  assert out["requests"] >= out["batches"] >= 1

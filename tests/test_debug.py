@@ -63,7 +63,7 @@ class TestScopesAndTrace:
 
   def test_named_scopes_in_lowered_hlo(self, rng):
     args = _args(rng)
-    txt = jax.jit(render.render_mpi).lower(*args).as_text(debug_info=True)
+    txt = debug.lowered_text(jax.jit(render.render_mpi).lower(*args))
     assert "render/homographies" in txt
     assert "render/warp_composite_scan" in txt
 

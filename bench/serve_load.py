@@ -20,14 +20,23 @@ serving numbers::
 whole loop runs in seconds on CPU — the tier-1 smoke mode
 (tests/test_serve_load_dry.py), mirroring bench.py's BENCH_DRY.
 
+``--chaos`` wraps the engine in ``serve.FaultyEngine`` with a seeded,
+deterministic fault schedule (transient errors + slow dispatches) and
+lets workers ride the resilience layer instead of aborting — the JSON
+line then carries the chaos accounting (injected faults, retries,
+breaker opens, error counts) next to the usual serving numbers.
+``--chaos --dry`` is the tier-1-safe chaos smoke.
+
 Usage: python bench/serve_load.py [--duration 10] [--concurrency 8] ...
 """
 
 from __future__ import annotations
 
 import argparse
+import collections
 import json
 import os
+import random
 import sys
 import threading
 import time
@@ -59,7 +68,36 @@ def build_parser() -> argparse.ArgumentParser:
   ap.add_argument("--dry", action="store_true",
                   help="tier-1 smoke mode: tiny scenes, ~2 s of load "
                        "(also env SERVE_LOAD_DRY=1)")
+  ap.add_argument("--chaos", action="store_true",
+                  help="inject scheduled faults (FaultyEngine) and report "
+                       "the resilience layer's accounting")
+  ap.add_argument("--chaos-error-rate", type=float, default=0.12,
+                  help="per-dispatch transient-error probability")
+  ap.add_argument("--chaos-slow-rate", type=float, default=0.04,
+                  help="per-dispatch slow-dispatch probability")
   return ap
+
+
+def chaos_schedule(seed: int, error_rate: float, slow_rate: float,
+                   slow_s: float = 0.02):
+  """A deterministic ``dispatch_index -> Fault | None`` schedule.
+
+  Each dispatch index draws from its own ``random.Random(f"{seed}:{idx}")``
+  stream, so the schedule is a pure function of (seed, index) — two runs
+  at one seed inject byte-identical fault sequences regardless of thread
+  timing. (String seeds: tuple seeding is gone in Python 3.11+.)
+  """
+  from mpi_vision_tpu.serve import Fault
+
+  def schedule(idx: int):
+    x = random.Random(f"{seed}:{idx}").random()
+    if x < error_rate:
+      return Fault("error")
+    if x < error_rate + slow_rate:
+      return Fault("slow", seconds=slow_s)
+    return None
+
+  return schedule
 
 
 def random_pose(rng: np.random.Generator) -> np.ndarray:
@@ -80,12 +118,31 @@ def main(argv=None) -> int:
     args.img_size = min(args.img_size, 32)
     args.num_planes = min(args.num_planes, 4)
 
-  from mpi_vision_tpu.serve import RenderService
+  from mpi_vision_tpu.serve import (
+      FaultyEngine,
+      RenderEngine,
+      RenderService,
+      ResilienceConfig,
+  )
 
   use_mesh = {"auto": None, "on": True, "off": False}[args.sharded]
+  engine = None
+  resilience = ResilienceConfig()
+  if args.chaos:
+    # Schedule armed AFTER warm-up: warm-up dispatches bypass the
+    # resilience layer, so an injected fault there would abort the run
+    # before measurement starts.
+    engine = FaultyEngine(RenderEngine(method=args.method, use_mesh=use_mesh))
+    # Chaos wants the loop lively: short backoffs and a quick half-open
+    # probe so the run exercises open AND re-close inside the window.
+    resilience = ResilienceConfig(
+        max_retries=3, backoff_base_s=0.01, backoff_max_s=0.1,
+        breaker_threshold=5, breaker_reset_s=0.25, watchdog_s=30.0,
+        seed=args.seed)
   svc = RenderService(
       cache_bytes=args.cache_mb << 20, max_batch=args.max_batch,
-      max_wait_ms=args.max_wait_ms, method=args.method, use_mesh=use_mesh)
+      max_wait_ms=args.max_wait_ms, method=args.method, use_mesh=use_mesh,
+      engine=engine, resilience=resilience)
   ids = svc.add_synthetic_scenes(
       args.scenes, height=args.img_size, width=args.img_size,
       planes=args.num_planes, seed=args.seed)
@@ -98,11 +155,18 @@ def main(argv=None) -> int:
   # compiles.
   svc.warmup()
   svc.metrics.reset()  # measured window starts clean
-  _log("serve_load: warm-up done")
+  if args.chaos:
+    engine.schedule = chaos_schedule(args.seed, args.chaos_error_rate,
+                                     args.chaos_slow_rate)
+    _log("serve_load: warm-up done; chaos schedule armed")
+  else:
+    _log("serve_load: warm-up done")
 
   stop = threading.Event()
   errors: list[Exception] = []
   counts = [0] * args.concurrency
+  failure_counts: collections.Counter = collections.Counter()
+  failure_lock = threading.Lock()
 
   def worker(idx: int) -> None:
     rng = np.random.default_rng(args.seed + 1 + idx)
@@ -113,9 +177,16 @@ def main(argv=None) -> int:
           else ids[int(rng.integers(1, len(ids)))]
       try:
         svc.render(sid, random_pose(rng), timeout=600)
-      except Exception as e:  # noqa: BLE001 - recorded, loop exits
-        errors.append(e)
-        return
+      except Exception as e:  # noqa: BLE001 - chaos rides through, else exit
+        if not args.chaos:
+          errors.append(e)
+          return
+        # Under chaos, failures ARE the workload: classify-and-continue,
+        # like a real client retrying against a flapping service.
+        with failure_lock:
+          failure_counts[type(e).__name__] += 1
+        time.sleep(0.005)  # don't spin against an open breaker
+        continue
       counts[idx] += 1
 
   threads = [threading.Thread(target=worker, args=(i,), daemon=True)
@@ -139,7 +210,7 @@ def main(argv=None) -> int:
   stats = svc.stats()
   lat = stats["latency_ms"] or {}
   rps = total / elapsed
-  print(json.dumps({
+  record = {
       "metric": "serve_load",
       "value": round(rps, 3),
       "unit": "renders/s",
@@ -154,7 +225,15 @@ def main(argv=None) -> int:
       "device": stats["engine"]["platform"],
       "sharded": stats["engine"]["sharded"],
       "dry": bool(args.dry),
-  }))
+      "chaos": bool(args.chaos),
+  }
+  if args.chaos:
+    record["chaos_injected"] = stats["engine"]["fault_injection"]
+    record["chaos_failed_requests"] = dict(sorted(failure_counts.items()))
+    record["errors"] = stats["errors"]
+    record["resilience"] = stats["resilience"]
+    record["breaker_state"] = stats["breaker"]["state"]
+  print(json.dumps(record))
   return 0
 
 

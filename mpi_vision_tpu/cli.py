@@ -199,15 +199,32 @@ def cmd_export_viewer(args: argparse.Namespace) -> dict:
 
 
 def cmd_serve(args: argparse.Namespace) -> dict:
+  import signal
+  import threading
+
   import numpy as np
 
-  from mpi_vision_tpu.serve import RenderService, make_http_server
+  from mpi_vision_tpu.serve import (
+      RenderService,
+      ResilienceConfig,
+      make_http_server,
+  )
 
   use_mesh = {"auto": None, "on": True, "off": False}[args.sharded]
+  resilience = None
+  if args.resilience:
+    resilience = ResilienceConfig(
+        max_retries=args.retries,
+        backoff_base_s=args.backoff_ms / 1e3,
+        backoff_max_s=args.backoff_max_ms / 1e3,
+        breaker_threshold=args.breaker_threshold,
+        breaker_reset_s=args.breaker_reset_s,
+        watchdog_s=args.watchdog_s if args.watchdog_s > 0 else None)
   svc = RenderService(
       cache_bytes=args.cache_mb << 20, max_batch=args.max_batch,
       max_wait_ms=args.max_wait_ms, method=args.method, use_mesh=use_mesh,
-      max_queue=args.max_queue)
+      max_queue=args.max_queue, resilience=resilience,
+      cpu_fallback=args.cpu_fallback)
   if args.mpi_dir:
     from mpi_vision_tpu.core.camera import intrinsics_matrix, inv_depths
     from mpi_vision_tpu.viewer import export
@@ -234,25 +251,45 @@ def cmd_serve(args: argparse.Namespace) -> dict:
 
   httpd = make_http_server(svc, host=args.host, port=args.port)
   port = httpd.server_address[1]
-  import threading
+
+  # Graceful shutdown: containers send SIGTERM and expect in-flight
+  # requests to drain, not a hard kill mid-render. The handlers only set
+  # an event; teardown runs on the main thread below (signal handlers
+  # must not join threads or talk to the device). Installed BEFORE the
+  # "listening" announcement: once a supervisor sees the address it may
+  # signal at any moment.
+  stop_event = threading.Event()
+
+  def _on_signal(signum, frame):  # noqa: ARG001 - stdlib signature
+    stop_event.set()  # FIRST: shutdown must not hinge on the log line
+    try:
+      _log(f"serve: received {signal.Signals(signum).name}; shutting down")
+    except Exception:  # noqa: BLE001 - e.g. reentrant stderr write
+      pass
+
+  previous_handlers = {}
+  for sig in (signal.SIGTERM, signal.SIGINT):
+    try:
+      previous_handlers[sig] = signal.signal(sig, _on_signal)
+    except (ValueError, OSError):  # non-main thread / unsupported platform
+      pass
 
   thread = threading.Thread(target=httpd.serve_forever, daemon=True)
   thread.start()
   _log(f"serve: listening on http://{args.host}:{port} "
        f"(/render, /healthz, /stats); engine {svc.engine.describe()}")
+
   t0 = time.time()
   try:
-    if args.duration > 0:
-      time.sleep(args.duration)
-    else:
-      while True:
-        time.sleep(3600)
-  except KeyboardInterrupt:
-    _log("serve: interrupted")
+    stop_event.wait(args.duration if args.duration > 0 else None)
   finally:
-    httpd.shutdown()
+    httpd.shutdown()  # stop accepting; in-flight handler threads finish
     stats = svc.stats()
-    svc.close()
+    health = svc.healthz()
+    svc.close()  # drain the scheduler, fail leftovers with a clear message
+    for sig, handler in previous_handlers.items():
+      signal.signal(sig, handler)
+    _log("serve: drained and closed")
   return {
       "command": "serve",
       "host": args.host,
@@ -266,6 +303,10 @@ def cmd_serve(args: argparse.Namespace) -> dict:
       "cache_hit_rate": stats["cache"]["hit_rate"],
       "devices": stats["engine"]["devices"],
       "sharded": stats["engine"]["sharded"],
+      "health": health["status"],
+      "errors": stats["errors"],
+      "rejected": stats["rejected"],
+      "resilience": stats["resilience"],
   }
 
 
@@ -359,6 +400,26 @@ def build_parser() -> argparse.ArgumentParser:
   s.add_argument("--warmup", action=argparse.BooleanOptionalAction,
                  default=True,
                  help="compile with one request before serving traffic")
+  s.add_argument("--resilience", action=argparse.BooleanOptionalAction,
+                 default=True,
+                 help="retry/breaker/watchdog layer (serve/resilience.py)")
+  s.add_argument("--retries", type=int, default=2,
+                 help="transient-failure retries per batch (beyond the "
+                      "first attempt)")
+  s.add_argument("--backoff-ms", type=float, default=50.0,
+                 help="base retry backoff; doubles per retry, jittered")
+  s.add_argument("--backoff-max-ms", type=float, default=2000.0,
+                 help="retry backoff cap")
+  s.add_argument("--breaker-threshold", type=int, default=5,
+                 help="consecutive device failures that open the circuit")
+  s.add_argument("--breaker-reset-s", type=float, default=30.0,
+                 help="open-circuit cooldown before a half-open probe")
+  s.add_argument("--watchdog-s", type=float, default=30.0,
+                 help="per-dispatch hang guard; <= 0 disables")
+  s.add_argument("--cpu-fallback", default="auto",
+                 choices=("auto", "on", "off"),
+                 help="degraded-mode CPU engine while the breaker is open "
+                      "(auto: only when the primary is not CPU)")
   s.set_defaults(fn=cmd_serve)
   return ap
 

@@ -7,12 +7,29 @@ dispatch (``scheduler`` -> ``engine``, sharded across visible devices),
 export latency/throughput/batch/cache metrics (``metrics``), and front it
 all with an in-process API plus a stdlib HTTP server (``server``).
 ``python -m mpi_vision_tpu serve`` runs it; ``bench/serve_load.py`` is the
-closed-loop load generator.
+closed-loop load generator (``--chaos`` injects scheduled faults).
+
+The resilience layer (``resilience``, ``faultinject``) keeps the service
+up through transient device loss: error classification, per-batch retry
+with deadline-bounded backoff, a circuit breaker with half-open probes,
+a dispatcher watchdog, and degraded-mode CPU fallback — all surfaced in
+``/healthz`` (ok / degraded / unhealthy) and the metrics snapshot.
 """
 
 from mpi_vision_tpu.serve.cache import BakedScene, SceneCache, bake_scene
 from mpi_vision_tpu.serve.engine import RenderEngine
+from mpi_vision_tpu.serve.faultinject import Fault, FaultyEngine
 from mpi_vision_tpu.serve.metrics import ServeMetrics
+from mpi_vision_tpu.serve.resilience import (
+    CircuitBreaker,
+    CircuitOpenError,
+    DispatchTimeoutError,
+    ResilienceConfig,
+    ResilientExecutor,
+    RetryPolicy,
+    TransientDeviceError,
+    classify_error,
+)
 from mpi_vision_tpu.serve.scheduler import MicroBatcher, QueueFullError
 from mpi_vision_tpu.serve.server import (
     RenderService,

@@ -32,15 +32,19 @@ class BakedScene:
   nbytes: int
 
 
-def bake_scene(scene_id, rgba_layers, depths, intrinsics) -> BakedScene:
+def bake_scene(scene_id, rgba_layers, depths, intrinsics,
+               device=None) -> BakedScene:
   """Place host arrays on device as one servable scene (f32).
 
   Blocks until the transfer lands so the bake cost is paid here, inside
   the cache-miss accounting, not silently inside the first render.
+  ``device`` pins the placement (the degraded-mode CPU fallback bakes
+  onto its own devices, not the defaulted primary); None keeps JAX's
+  default placement.
   """
-  rgba = jnp.asarray(rgba_layers, jnp.float32)
-  d = jnp.asarray(depths, jnp.float32)
-  k = jnp.asarray(intrinsics, jnp.float32)
+  rgba = np.asarray(rgba_layers, np.float32)
+  d = np.asarray(depths, np.float32)
+  k = np.asarray(intrinsics, np.float32)
   if rgba.ndim != 4 or rgba.shape[-1] != 4:
     raise ValueError(f"rgba_layers must be [H, W, P, 4], got {rgba.shape}")
   if d.shape != (rgba.shape[2],):
@@ -48,6 +52,13 @@ def bake_scene(scene_id, rgba_layers, depths, intrinsics) -> BakedScene:
         f"depths {d.shape} must be [P] matching rgba planes {rgba.shape[2]}")
   if k.shape != (3, 3):
     raise ValueError(f"intrinsics must be [3, 3], got {k.shape}")
+  if device is not None:
+    # Straight host -> target transfer. Routing through jnp.asarray first
+    # would stage the arrays on the DEFAULT backend — the device whose
+    # outage is the very reason a fallback bake is happening.
+    rgba, d, k = (jax.device_put(a, device) for a in (rgba, d, k))
+  else:
+    rgba, d, k = jnp.asarray(rgba), jnp.asarray(d), jnp.asarray(k)
   jax.block_until_ready(rgba)
   nbytes = sum(int(np.prod(a.shape)) * a.dtype.itemsize
                for a in (rgba, d, k))

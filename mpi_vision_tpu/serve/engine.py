@@ -94,7 +94,15 @@ class RenderEngine:
       poses = np.concatenate(
           [poses, np.repeat(poses[-1:], bucket - v, axis=0)])
     t0 = time.perf_counter()
-    out = self._render_jit(scene.rgba_layers, jnp.asarray(poses),
+    if self.use_mesh:
+      poses_dev = jnp.asarray(poses)
+    else:
+      # Commit poses to THIS engine's device rather than the process
+      # default: for the degraded-mode CPU fallback the default backend
+      # is the dead device the fallback exists to route around, and an
+      # uncommitted jnp.asarray would stage the transfer there.
+      poses_dev = jax.device_put(poses, self.devices[0])
+    out = self._render_jit(scene.rgba_layers, poses_dev,
                            scene.depths, scene.intrinsics)
     out = np.asarray(jax.block_until_ready(out))
     self.last_render_s = time.perf_counter() - t0
@@ -104,6 +112,17 @@ class RenderEngine:
   def render_one(self, scene: BakedScene, pose) -> np.ndarray:
     """Single-pose convenience entry: ``[4, 4]`` -> ``[H, W, 3]``."""
     return self.render_batch(scene, np.asarray(pose, np.float32)[None])[0]
+
+  @property
+  def platform(self) -> str:
+    return self.devices[0].platform
+
+  def cpu_fallback(self) -> "RenderEngine":
+    """A single-chip CPU engine with this engine's render settings — the
+    degraded-mode route when the circuit breaker gives up on the primary
+    device (the serving analogue of ``bench.py --allow-cpu``)."""
+    return RenderEngine(method=self.method, convention=self.convention,
+                        use_mesh=False, devices=jax.devices("cpu"))
 
   def describe(self) -> dict:
     return {

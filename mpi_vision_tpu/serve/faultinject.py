@@ -1,0 +1,157 @@
+"""Deterministic fault injection for the serving engine.
+
+``FaultyEngine`` wraps any engine exposing ``render_batch`` and injects
+scheduled failures *between* the scheduler and the device, which is
+exactly where the real outages land (``BENCH_r05.json``: TPU tunnel
+dropped mid-run). Three fault kinds cover the outage classes the
+resilience layer must survive:
+
+  * ``error`` — raise immediately (``TransientDeviceError`` by default,
+    ``ValueError`` with ``transient=False`` for bad-input testing).
+  * ``hang`` — block up to ``seconds`` (or until ``release`` is set),
+    then raise transient; the watchdog must abandon it first.
+  * ``slow`` — sleep ``seconds`` then dispatch normally (deadline and
+    backoff-budget pressure without failing).
+
+Faults come from an explicit queue (``inject``: next-N-dispatches, the
+unit-test mode) and/or a ``schedule`` callable ``dispatch_index ->
+Fault | None`` (the chaos-mode generator in ``bench/serve_load.py``).
+Both are deterministic: dispatch indices are assigned under a lock in
+dispatch order, and a seeded schedule replays exactly. Everything runs
+on CPU, so every resilience behavior is testable in tier-1.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+
+from mpi_vision_tpu.serve.resilience import TransientDeviceError
+
+_KINDS = ("error", "hang", "slow")
+
+
+@dataclasses.dataclass(frozen=True)
+class Fault:
+  """One scheduled failure. ``seconds`` bounds hangs and slow sleeps."""
+
+  kind: str = "error"
+  seconds: float = 60.0
+  transient: bool = True
+  message: str = ""
+
+  def __post_init__(self):
+    if self.kind not in _KINDS:
+      raise ValueError(f"fault kind must be one of {_KINDS}, got {self.kind}")
+
+
+class FaultyEngine:
+  """An engine wrapper that fails on schedule instead of by accident.
+
+  Args:
+    inner: the wrapped engine (``RenderEngine`` or compatible).
+    schedule: optional ``dispatch_index -> Fault | None`` callable
+      consulted when the explicit queue is empty.
+
+  ``release`` frees any in-flight hang early (tests set it in teardown
+  so abandoned watchdog threads exit instead of idling out their full
+  hold time).
+  """
+
+  def __init__(self, inner, schedule=None):
+    self.inner = inner
+    self.schedule = schedule
+    self.release = threading.Event()
+    self._lock = threading.Lock()
+    self._queue: list[Fault] = []
+    self._index = 0
+    self.injected = {"error": 0, "hang": 0, "slow": 0}
+
+  # -- scheduling ---------------------------------------------------------
+
+  def inject(self, *faults: Fault) -> None:
+    """Queue faults for the next dispatches (one fault per dispatch)."""
+    with self._lock:
+      self._queue.extend(faults)
+
+  def fail_next(self, n: int = 1, transient: bool = True) -> None:
+    """Shorthand: the next ``n`` dispatches raise an error fault."""
+    self.inject(*(Fault("error", transient=transient) for _ in range(n)))
+
+  def clear(self) -> None:
+    with self._lock:
+      self._queue.clear()
+
+  def _next_fault(self) -> Fault | None:
+    with self._lock:
+      idx, self._index = self._index, self._index + 1
+      if self._queue:
+        return self._queue.pop(0)
+    return self.schedule(idx) if self.schedule is not None else None
+
+  # -- engine surface -----------------------------------------------------
+
+  def render_batch(self, scene, poses):
+    fault = self._next_fault()
+    if fault is not None:
+      with self._lock:
+        self.injected[fault.kind] += 1
+      if fault.kind == "error":
+        self._raise(fault, "injected fault")
+      elif fault.kind == "hang":
+        # Simulates a dispatch that never returns (tunnel gone mid-call):
+        # hold until released or the bounded hold elapses, then raise —
+        # by then the watchdog abandoned this thread and the result is
+        # discarded either way.
+        self.release.wait(fault.seconds)
+        self._raise(fault, "injected hang released")
+      else:  # slow
+        time.sleep(fault.seconds)
+    return self.inner.render_batch(scene, poses)
+
+  def _raise(self, fault: Fault, default_msg: str):
+    msg = fault.message or f"{default_msg} (UNAVAILABLE: device injected)"
+    if fault.transient:
+      raise TransientDeviceError(msg)
+    raise ValueError(fault.message or "injected permanent fault (bad input)")
+
+  def render_one(self, scene, pose):
+    import numpy as np
+
+    return self.render_batch(scene, np.asarray(pose, np.float32)[None])[0]
+
+  def batch_bucket(self, v: int) -> int:
+    return self.inner.batch_bucket(v)
+
+  @property
+  def devices(self):
+    return self.inner.devices
+
+  @property
+  def method(self):
+    return self.inner.method
+
+  @property
+  def convention(self):
+    return self.inner.convention
+
+  @property
+  def use_mesh(self):
+    return self.inner.use_mesh
+
+  @property
+  def dispatches(self):
+    return self.inner.dispatches
+
+  @property
+  def platform(self):
+    return self.inner.devices[0].platform
+
+  def cpu_fallback(self):
+    return self.inner.cpu_fallback()
+
+  def describe(self) -> dict:
+    out = dict(self.inner.describe())
+    out["fault_injection"] = dict(self.injected)
+    return out

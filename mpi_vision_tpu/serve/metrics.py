@@ -41,12 +41,73 @@ class ServeMetrics:
       self.requests = 0
       self.batches = 0
       self.render_seconds = 0.0
+      # Failure accounting: without these, failed renders vanish from the
+      # snapshot entirely (record_request fires only on success) and
+      # /stats reads "healthy" straight through an outage.
+      self.errors_transient = 0
+      self.errors_permanent = 0
+      self.errors_deadline = 0
+      self.rejected = 0
+      self.retries = 0
+      self.watchdog_trips = 0
+      self.fallback_renders = 0
+      self.breaker_opens = 0
+      self.breaker_fastfails = 0
+      self.client_disconnects = 0
 
   def record_request(self, latency_s: float) -> None:
     """One request completed, queue-to-response latency."""
     with self._lock:
       self.requests += 1
       self._latencies.append(latency_s)
+
+  def record_error(self, kind: str, count: int = 1) -> None:
+    """``count`` requests failed with a ``kind``-class error.
+
+    Kinds: "transient" / "permanent" (``resilience.classify_error``) plus
+    "deadline" for requests that expired in the queue before dispatch —
+    kept apart so ``errors.transient`` keeps meaning *device* trouble and
+    pure overload doesn't read as a flapping tunnel in ``/stats``.
+    """
+    with self._lock:
+      if kind == "transient":
+        self.errors_transient += count
+      elif kind == "deadline":
+        self.errors_deadline += count
+      else:
+        self.errors_permanent += count
+
+  def record_rejected(self) -> None:
+    """One submission shed at the door (queue full)."""
+    with self._lock:
+      self.rejected += 1
+
+  def record_retry(self) -> None:
+    with self._lock:
+      self.retries += 1
+
+  def record_watchdog_trip(self) -> None:
+    with self._lock:
+      self.watchdog_trips += 1
+
+  def record_fallback(self) -> None:
+    """One batch served by the degraded-mode fallback engine."""
+    with self._lock:
+      self.fallback_renders += 1
+
+  def record_breaker_open(self) -> None:
+    with self._lock:
+      self.breaker_opens += 1
+
+  def record_breaker_fastfail(self) -> None:
+    """One request fast-failed against an open circuit (HTTP 503)."""
+    with self._lock:
+      self.breaker_fastfails += 1
+
+  def record_client_disconnect(self) -> None:
+    """The client hung up mid-response (BrokenPipe/ConnectionReset)."""
+    with self._lock:
+      self.client_disconnects += 1
 
   def record_batch(self, size: int, render_s: float) -> None:
     """One device dispatch of ``size`` coalesced requests."""
@@ -76,6 +137,20 @@ class ServeMetrics:
                               if self.batches else None),
           "device_render_seconds": round(self.render_seconds, 3),
           "queue_depth": self._queue_depth,
+          "errors": {
+              "transient": self.errors_transient,
+              "permanent": self.errors_permanent,
+              "deadline": self.errors_deadline,
+          },
+          "rejected": self.rejected,
+          "resilience": {
+              "retries": self.retries,
+              "watchdog_trips": self.watchdog_trips,
+              "fallback_renders": self.fallback_renders,
+              "breaker_opens": self.breaker_opens,
+              "breaker_fastfails": self.breaker_fastfails,
+              "client_disconnects": self.client_disconnects,
+          },
       }
       if lat:
         out["latency_ms"] = {

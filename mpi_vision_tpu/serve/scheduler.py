@@ -29,6 +29,11 @@ import numpy as np
 
 from mpi_vision_tpu.serve.engine import RenderEngine
 from mpi_vision_tpu.serve.metrics import ServeMetrics
+from mpi_vision_tpu.serve.resilience import (
+    DispatchTimeoutError,
+    ResilientExecutor,
+    classify_error,
+)
 
 
 class QueueFullError(RuntimeError):
@@ -46,6 +51,7 @@ class _Pending:
   pose: np.ndarray
   future: Future
   t_enqueue: float
+  deadline: float | None = None  # absolute monotonic; None = no deadline
 
 
 class MicroBatcher:
@@ -64,27 +70,45 @@ class MicroBatcher:
     max_queue: pending-request cap; submissions beyond it raise
       ``QueueFullError`` (shed load instead of queueing past the point
       where callers' timeouts make the work dead anyway).
+    resilient: optional ``resilience.ResilientExecutor``; when set, every
+      dispatch runs through its retry/breaker/watchdog machinery and an
+      open breaker fast-fails submissions (``CircuitOpenError``) unless a
+      fallback engine can degrade instead.
+    fallback_engine / fallback_scene_provider: the degraded-mode route —
+      a CPU engine plus a provider baking scenes onto *its* devices; used
+      only while the breaker refuses the primary.
   """
 
   def __init__(self, engine: RenderEngine, scene_provider,
                metrics: ServeMetrics | None = None,
                max_batch: int = 8, max_wait_ms: float = 2.0,
-               max_queue: int = 1024):
+               max_queue: int = 1024,
+               resilient: ResilientExecutor | None = None,
+               fallback_engine=None, fallback_scene_provider=None):
     if max_batch < 1:
       raise ValueError(f"max_batch must be >= 1, got {max_batch}")
     if max_queue < 1:
       raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+    if fallback_engine is not None and fallback_scene_provider is None:
+      raise ValueError("fallback_engine requires fallback_scene_provider")
     self.engine = engine
     self.scene_provider = scene_provider
     self.metrics = ServeMetrics() if metrics is None else metrics
     self.max_batch = max_batch
     self.max_wait_s = max(max_wait_ms, 0.0) / 1e3
     self.max_queue = max_queue
-    self.rejected = 0
+    self.resilient = resilient
+    self.fallback_engine = fallback_engine
+    self.fallback_scene_provider = fallback_scene_provider
     self._queue: deque[_Pending] = deque()
     self._cond = threading.Condition()
     self._stop = False
     self._thread: threading.Thread | None = None
+
+  @property
+  def rejected(self) -> int:
+    """Queue-full sheds (lives in metrics so /stats reflects it)."""
+    return self.metrics.rejected
 
   # -- lifecycle ----------------------------------------------------------
 
@@ -107,23 +131,43 @@ class MicroBatcher:
       while self._queue:  # drain: fail leftovers instead of hanging callers
         req = self._queue.popleft()
         if req.future.set_running_or_notify_cancel():
-          req.future.set_exception(RuntimeError("scheduler stopped"))
+          req.future.set_exception(RuntimeError(
+              "scheduler stopped: request dropped at shutdown "
+              "before it reached the device"))
       self.metrics.set_queue_depth(0)
+
+  def dispatcher_alive(self) -> bool:
+    """Is the dispatcher thread running? (healthz's liveness signal —
+    a wedged/ dead dispatcher with a growing queue must not report ok.)"""
+    return self._thread is not None and self._thread.is_alive()
 
   # -- request path -------------------------------------------------------
 
-  def submit(self, scene_id: str, pose) -> Future:
-    """Enqueue one pose render; the future resolves to ``[H, W, 3]``."""
+  def submit(self, scene_id: str, pose,
+             timeout: float | None = None) -> Future:
+    """Enqueue one pose render; the future resolves to ``[H, W, 3]``.
+
+    ``timeout`` (seconds) sets the request's deadline: retries/backoff
+    stop at it, the dispatch watchdog tightens to it, and a request still
+    queued past it fails instead of burning a dispatch.
+    """
     pose = np.asarray(pose, np.float32)
     if pose.shape != (4, 4):
       raise ValueError(f"pose must be [4, 4], got {pose.shape}")
+    if self.resilient is not None:
+      # Fast-fail 503 at the door while the breaker is open and there is
+      # no fallback to degrade to: queueing the request would only make
+      # the caller wait to learn what is already known.
+      self.resilient.check_fastfail(self.fallback_engine is not None)
+    now = time.monotonic()
     fut: Future = Future()
-    req = _Pending(str(scene_id), pose, fut, time.monotonic())
+    req = _Pending(str(scene_id), pose, fut, now,
+                   deadline=None if timeout is None else now + timeout)
     with self._cond:
       if self._stop or self._thread is None:
         raise RuntimeError("scheduler is not running")
       if len(self._queue) >= self.max_queue:
-        self.rejected += 1
+        self.metrics.record_rejected()
         raise QueueFullError(
             f"request queue full ({self.max_queue} pending)")
       self._queue.append(req)
@@ -136,8 +180,10 @@ class MicroBatcher:
 
     On timeout the request is cancelled (best-effort) so an overloaded
     queue is not burning device dispatches on results nobody will read.
+    Never blocks past ``timeout``: the future resolves or times out even
+    when the dispatch behind it hangs (the watchdog abandons it).
     """
-    fut = self.submit(scene_id, pose)
+    fut = self.submit(scene_id, pose, timeout=timeout)
     try:
       return fut.result(timeout)
     except FuturesTimeoutError:
@@ -194,21 +240,77 @@ class MicroBatcher:
     # no longer be cancelled under us (set_result would InvalidStateError,
     # killing the only dispatcher thread).
     batch = [r for r in batch if r.future.set_running_or_notify_cancel()]
+    # A request whose deadline already passed has a caller that gave up
+    # (or will, before the result lands): fail it now rather than let it
+    # drag the live batch's watchdog budget down to zero.
+    now = time.monotonic()
+    live: list[_Pending] = []
+    for req in batch:
+      if req.deadline is not None and req.deadline <= now:
+        self.metrics.record_error("deadline")  # overload, not device trouble
+        exc = DispatchTimeoutError("request deadline expired before dispatch")
+        exc.deadline_capped = True  # HTTP layer: 504, not a device 503
+        req.future.set_exception(exc)
+      else:
+        live.append(req)
+    batch = live
     if not batch:
       return
+    # The batch's dispatch budget follows its MOST patient member: a
+    # short-timeout request must not drag its batchmates' watchdog down
+    # to its own deadline (the impatient caller's future times out on its
+    # own clock either way). A single deadline-free member lifts the cap
+    # entirely, leaving the plain watchdog_s hang guard in charge.
+    deadlines = [r.deadline for r in batch if r.deadline is not None]
+    deadline = max(deadlines) if len(deadlines) == len(batch) else None
+    poses = np.stack([r.pose for r in batch])
+    # device_render_seconds must stay DEVICE time: the timer runs inside
+    # the attempt closures, around the engine call only — never around
+    # retry backoffs, abandoned watchdog waits, or scene bakes.
+    render_box = {"s": 0.0}
     try:
-      # Scene lookup BEFORE the render timer: a cache-miss bake (blocking
-      # host->device transfer) must show up in cache stats, not inflate
-      # device_render_seconds/batch latency as a phantom slow kernel.
-      scene = self.scene_provider(batch[0].scene_id)
-      t0 = time.perf_counter()
-      out = self.engine.render_batch(
-          scene, np.stack([r.pose for r in batch]))
+      if self.resilient is not None:
+
+        def primary_fn(scene_id=batch[0].scene_id):
+          # Scene lookup INSIDE the resilient call: a cache-miss bake
+          # onto a dead device must retry / count toward the breaker /
+          # degrade to the fallback exactly like a failed render — a
+          # cold scene during an outage is the worst time to fail raw.
+          scene = self.scene_provider(scene_id)
+          t0 = time.perf_counter()
+          out = self.engine.render_batch(scene, poses)
+          render_box["s"] = time.perf_counter() - t0
+          return out
+
+        fallback_fn = None
+        if self.fallback_engine is not None:
+          def fallback_fn(scene_id=batch[0].scene_id):
+            # Bake onto the FALLBACK's devices at call time: baking every
+            # scene to CPU up front would double host->device traffic for
+            # an outage that may never happen.
+            fb_scene = self.fallback_scene_provider(scene_id)
+            t0 = time.perf_counter()
+            out = self.fallback_engine.render_batch(fb_scene, poses)
+            render_box["s"] = time.perf_counter() - t0
+            return out
+        out = self.resilient.run(
+            primary_fn, fallback_fn=fallback_fn, deadline=deadline)
+      else:
+        # Scene lookup BEFORE the render timer: a cache-miss bake
+        # (blocking host->device transfer) must show up in cache stats,
+        # not inflate device_render_seconds as a phantom slow kernel.
+        scene = self.scene_provider(batch[0].scene_id)
+        t0 = time.perf_counter()
+        out = self.engine.render_batch(scene, poses)
+        render_box["s"] = time.perf_counter() - t0
     except Exception as e:  # noqa: BLE001 - forwarded to every caller
+      kind = ("deadline" if getattr(e, "deadline_capped", False)
+              else classify_error(e))
+      self.metrics.record_error(kind, count=len(batch))
       for req in batch:
         req.future.set_exception(e)
       return
-    render_s = time.perf_counter() - t0
+    render_s = render_box["s"]
     done = time.monotonic()
     self.metrics.record_batch(len(batch), render_s)
     for i, req in enumerate(batch):

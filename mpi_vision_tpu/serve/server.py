@@ -15,9 +15,18 @@ stdlib ``http.server`` front end:
 Scenes register host-side (``add_scene``) and bake lazily through the
 LRU cache on first request, so cache hit/miss accounting reflects real
 traffic. 404 for unknown scenes, 400 for malformed requests, 503 when
-the scheduler sheds load (queue at ``max_queue``); handler threads block
-on the scheduler future, so HTTP concurrency turns into micro-batch
-coalescing on the device.
+the scheduler sheds load (queue at ``max_queue``) or the circuit breaker
+is open (with a Retry-After header); handler threads block on the
+scheduler future, so HTTP concurrency turns into micro-batch coalescing
+on the device. ``Accept: application/octet-stream`` on ``/render``
+returns the raw little-endian f32 pixels with shape/dtype response
+headers (half the payload of the default base64 JSON).
+
+``/healthz`` is a three-state health machine, not a liveness ping:
+``ok`` (breaker closed, dispatcher running), ``degraded`` (breaker
+open/half-open — requests fast-fail or ride the CPU fallback; the
+``reason`` field says which), ``unhealthy`` (service closed or the
+dispatcher thread died).
 """
 
 from __future__ import annotations
@@ -25,6 +34,7 @@ from __future__ import annotations
 import base64
 import functools
 import json
+import math
 import threading
 import zlib
 from concurrent.futures import TimeoutError as FuturesTimeoutError
@@ -37,6 +47,13 @@ from mpi_vision_tpu.core.camera import inv_depths
 from mpi_vision_tpu.serve import cache as cache_mod
 from mpi_vision_tpu.serve.engine import RenderEngine
 from mpi_vision_tpu.serve.metrics import ServeMetrics
+from mpi_vision_tpu.serve.resilience import (
+    CircuitBreaker,
+    CircuitOpenError,
+    ResilienceConfig,
+    ResilientExecutor,
+    TransientDeviceError,
+)
 from mpi_vision_tpu.serve.scheduler import MicroBatcher, QueueFullError
 
 
@@ -76,22 +93,53 @@ class RenderService:
     cache_bytes: scene-cache byte budget.
     max_batch / max_wait_ms: micro-batching knobs (scheduler.py).
     method / use_mesh: renderer routing knobs (engine.py).
+    resilience: retry/breaker/watchdog knobs (resilience.py); None turns
+      the whole resilience layer off (raw PR-1 behavior).
+    cpu_fallback: degraded-mode routing while the breaker is open —
+      "auto" builds a CPU fallback engine exactly when the primary is
+      not already CPU (the serving analogue of ``bench.py --allow-cpu``),
+      "on" forces one, "off" fast-fails instead.
+    fallback_engine: explicit fallback engine override (tests).
   """
 
   def __init__(self, cache_bytes: int = 2 << 30, max_batch: int = 8,
                max_wait_ms: float = 2.0, method: str = "fused",
                use_mesh: bool | None = None, max_queue: int = 1024,
-               engine: RenderEngine | None = None):
+               engine: RenderEngine | None = None,
+               resilience: ResilienceConfig | None = ResilienceConfig(),
+               cpu_fallback: str = "auto", fallback_engine=None):
+    if cpu_fallback not in ("auto", "on", "off"):
+      raise ValueError(
+          f"cpu_fallback must be auto/on/off, got {cpu_fallback!r}")
+    if cpu_fallback == "on" and resilience is None and fallback_engine is None:
+      # The fallback only engages through the resilience layer's breaker;
+      # accepting the combination silently would drop an explicit knob.
+      raise ValueError("cpu_fallback='on' requires resilience enabled")
     self.engine = engine if engine is not None else RenderEngine(
         method=method, use_mesh=use_mesh)
     self.cache = cache_mod.SceneCache(byte_budget=cache_bytes)
     self.metrics = ServeMetrics()
+    self.resilient = None if resilience is None else ResilientExecutor(
+        resilience, metrics=self.metrics)
+    self.fallback_engine = fallback_engine
+    if (self.fallback_engine is None and self.resilient is not None
+        and (cpu_fallback == "on"
+             or (cpu_fallback == "auto"
+                 and self.engine.platform != "cpu"))):
+      self.fallback_engine = self.engine.cpu_fallback()
+    self._fallback_cache = (
+        cache_mod.SceneCache(byte_budget=cache_bytes)
+        if self.fallback_engine is not None else None)
     self._scene_data: dict[str, tuple] = {}
     self._scene_lock = threading.Lock()
     self.scheduler = MicroBatcher(
         self.engine, self._get_scene, metrics=self.metrics,
         max_batch=max_batch, max_wait_ms=max_wait_ms,
-        max_queue=max_queue).start()
+        max_queue=max_queue, resilient=self.resilient,
+        fallback_engine=self.fallback_engine,
+        fallback_scene_provider=(
+            self._get_scene_fallback
+            if self.fallback_engine is not None else None)).start()
     self._closed = False
 
   # -- scenes -------------------------------------------------------------
@@ -129,6 +177,20 @@ class RenderService:
 
     return self.cache.get_or_bake(scene_id, bake)
 
+  def _get_scene_fallback(self, scene_id: str) -> cache_mod.BakedScene:
+    """Scene provider for the degraded-mode engine: same host arrays,
+    baked onto the fallback's (CPU) devices, cached separately so an
+    outage does not evict the primary's residency."""
+    def bake():
+      with self._scene_lock:
+        entry = self._scene_data.get(scene_id)
+      if entry is None:
+        raise KeyError(f"unknown scene {scene_id!r}")
+      return cache_mod.bake_scene(
+          scene_id, *entry, device=self.fallback_engine.devices[0])
+
+    return self._fallback_cache.get_or_bake(scene_id, bake)
+
   def warmup(self, scene_ids=None) -> None:
     """Bake scenes (default: all registered) and compile every batch
     bucket up to the scheduler's ``max_batch`` for the first scene's
@@ -157,17 +219,50 @@ class RenderService:
 
   def stats(self) -> dict:
     out = self.metrics.snapshot(cache_stats=self.cache.stats())
-    out["rejected"] = self.scheduler.rejected
     out["engine"] = self.engine.describe()
+    if self.resilient is not None:
+      out["breaker"] = self.resilient.breaker.snapshot()
     return out
 
   def healthz(self) -> dict:
-    return {
-        "status": "closed" if self._closed else "ok",
+    """The health state machine: ok / degraded / unhealthy + reason.
+
+    ``degraded`` means the service still answers but not at full
+    fidelity: the breaker has given up on the primary device and
+    requests either ride the CPU fallback or fast-fail 503. A wedged or
+    dead dispatcher is ``unhealthy`` — before the watchdog existed,
+    exactly that state kept reporting ``ok`` forever.
+    """
+    out = {
         "devices": len(self.engine.devices),
-        "platform": self.engine.devices[0].platform,
+        "platform": self.engine.platform,
         "scenes": len(self.scene_ids()),
     }
+    breaker = self.resilient.breaker if self.resilient is not None else None
+    snap = breaker.snapshot() if breaker is not None else None
+    if self._closed:
+      status, reason = "unhealthy", "service closed"
+    elif not self.scheduler.dispatcher_alive():
+      status, reason = "unhealthy", "dispatcher thread is not running"
+    elif snap is not None and snap["state"] != CircuitBreaker.CLOSED:
+      status = "degraded"
+      reason = (f"circuit {snap['state']} after "
+                f"{snap['consecutive_failures']} consecutive device "
+                f"failures; ")
+      reason += ("rendering on CPU fallback"
+                 if self.fallback_engine is not None
+                 else "fast-failing renders (503)")
+    else:
+      status, reason = "ok", None
+    out["status"] = status
+    if reason is not None:
+      out["reason"] = reason
+    if snap is not None:
+      out["breaker"] = snap
+      out["fallback_active"] = (
+          self.fallback_engine is not None
+          and snap["state"] != CircuitBreaker.CLOSED)
+    return out
 
   def close(self) -> None:
     if not self._closed:
@@ -199,17 +294,37 @@ class _Handler(BaseHTTPRequestHandler):
   def log_message(self, fmt, *args):  # noqa: A003 - stdlib signature
     pass  # request logging is the metrics layer's job, not stderr's
 
-  def _send_json(self, payload: dict, status: int = 200) -> None:
-    body = json.dumps(payload).encode()
-    self.send_response(status)
-    self.send_header("Content-Type", "application/json")
-    self.send_header("Content-Length", str(len(body)))
-    self.end_headers()
-    self.wfile.write(body)
+  def _send_bytes(self, body: bytes, status: int = 200,
+                  content_type: str = "application/json",
+                  extra_headers: dict | None = None) -> None:
+    # A client that hangs up mid-response (routine under load-shed: it
+    # timed out first) must cost a counter, not a stderr traceback from
+    # the handler thread.
+    try:
+      self.send_response(status)
+      self.send_header("Content-Type", content_type)
+      self.send_header("Content-Length", str(len(body)))
+      for key, value in (extra_headers or {}).items():
+        self.send_header(key, value)
+      self.end_headers()
+      self.wfile.write(body)
+    except (BrokenPipeError, ConnectionResetError):
+      self.service.metrics.record_client_disconnect()
+      self.close_connection = True
+
+  def _send_json(self, payload: dict, status: int = 200,
+                 extra_headers: dict | None = None) -> None:
+    self._send_bytes(json.dumps(payload).encode(), status=status,
+                     extra_headers=extra_headers)
 
   def do_GET(self):  # noqa: N802 - stdlib name
     if self.path == "/healthz":
-      self._send_json(self.service.healthz())
+      health = self.service.healthz()
+      # Status-code probes (k8s httpGet, LB health checks) never read the
+      # body: unhealthy must be non-2xx. Degraded stays 200 — the service
+      # is still answering (fallback or fast-fail), don't get it killed.
+      self._send_json(health,
+                      status=503 if health["status"] == "unhealthy" else 200)
     elif self.path == "/stats":
       self._send_json(self.service.stats())
     else:
@@ -235,6 +350,13 @@ class _Handler(BaseHTTPRequestHandler):
     except (KeyError, TypeError, ValueError, json.JSONDecodeError) as e:
       self._send_json({"error": f"bad request: {e}"}, status=400)
       return
+    except (BrokenPipeError, ConnectionResetError):
+      # Client hung up mid-upload: nothing to respond to — count it like
+      # a mid-response disconnect instead of letting socketserver dump a
+      # traceback.
+      self.service.metrics.record_client_disconnect()
+      self.close_connection = True
+      return
     try:
       img = self.service.render(scene_id, pose)
     except KeyError as e:
@@ -243,6 +365,24 @@ class _Handler(BaseHTTPRequestHandler):
     except QueueFullError as e:
       self._send_json({"error": str(e)}, status=503)
       return
+    except CircuitOpenError as e:
+      # Fast-fail while the device is known-bad: tell the client exactly
+      # when the next half-open probe could let it back in.
+      retry_after = max(1, math.ceil(e.retry_after_s))
+      self._send_json({"error": str(e), "retry_after_s": e.retry_after_s},
+                      status=503,
+                      extra_headers={"Retry-After": str(retry_after)})
+      return
+    except TransientDeviceError as e:
+      if getattr(e, "deadline_capped", False):
+        # The DEADLINE bounded this failure, not the device: overload is
+        # a 504, telling the client the device is flaky would misdirect.
+        self._send_json({"error": f"request deadline exceeded: {e}"},
+                        status=504)
+      else:
+        self._send_json({"error": f"transient device failure: {e}"},
+                        status=503, extra_headers={"Retry-After": "1"})
+      return
     except FuturesTimeoutError:
       self._send_json({"error": "render timed out in queue"}, status=504)
       return
@@ -250,6 +390,17 @@ class _Handler(BaseHTTPRequestHandler):
       self._send_json({"error": f"render failed: {e}"}, status=500)
       return
     img = np.ascontiguousarray(img, np.dtype("<f4"))
+    if "application/octet-stream" in self.headers.get("Accept", ""):
+      # Binary response: raw little-endian f32 pixels, shape/dtype in
+      # headers — half the bytes of base64-in-JSON at 1080p (ROADMAP).
+      self._send_bytes(
+          img.tobytes(), content_type="application/octet-stream",
+          extra_headers={
+              "X-Image-Shape": ",".join(str(d) for d in img.shape),
+              "X-Image-Dtype": "<f4",
+              "X-Scene-Id": str(scene_id),
+          })
+      return
     self._send_json({
         "scene_id": scene_id,
         "shape": list(img.shape),

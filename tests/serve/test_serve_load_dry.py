@@ -5,6 +5,11 @@ Mirrors tests/test_bench_dry.py: the load generator's decision path
 host+CPU-sized work, so guard rot in it is caught here rather than in a
 TPU window. Asserts the single JSON line carries the serving headline
 fields: renders_per_sec, p50_ms, p99_ms, cache_hit_rate.
+
+The ``--chaos`` variant is the resilience layer's end-to-end smoke: a
+seeded fault schedule injects transient errors and slow dispatches into
+real closed-loop traffic, and the run must still complete with the
+chaos accounting (injected counts, retries, breaker state) in the JSON.
 """
 
 import json
@@ -13,7 +18,7 @@ import subprocess
 import sys
 
 
-def test_serve_load_dry_emits_headline_json():
+def _run_dry(extra_args=()):
   repo = os.path.dirname(os.path.dirname(os.path.dirname(
       os.path.abspath(__file__))))
   sys.path.insert(0, repo)
@@ -24,14 +29,37 @@ def test_serve_load_dry_emits_headline_json():
   # Share the suite's persistent XLA cache so reruns skip the compiles.
   env["JAX_COMPILATION_CACHE_DIR"] = os.path.join(repo, ".jax_cache")
   proc = subprocess.run(
-      [sys.executable, os.path.join(repo, "bench", "serve_load.py")],
+      [sys.executable, os.path.join(repo, "bench", "serve_load.py"),
+       *extra_args],
       capture_output=True, text=True, timeout=1200, env=env, cwd=repo)
   assert proc.returncode == 0, (
       f"serve_load dry run failed:\n{proc.stderr[-3000:]}")
-  out = json.loads(proc.stdout.strip().splitlines()[-1])
+  return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def test_serve_load_dry_emits_headline_json():
+  out = _run_dry()
   assert out["metric"] == "serve_load" and out["dry"] is True
   assert out["device"] == "cpu"
   assert out["renders_per_sec"] > 0
   assert out["p50_ms"] > 0 and out["p99_ms"] >= out["p50_ms"]
   assert 0 <= out["cache_hit_rate"] <= 1
   assert out["requests"] >= out["batches"] >= 1
+  assert out["chaos"] is False
+
+
+def test_serve_load_chaos_dry_smoke():
+  """Chaos mode must inject faults AND finish healthy: the workload rides
+  retries/fallback instead of aborting, and the JSON carries the
+  resilience accounting."""
+  out = _run_dry(["--chaos"])
+  assert out["metric"] == "serve_load" and out["dry"] is True
+  assert out["chaos"] is True
+  assert out["renders_per_sec"] > 0 and out["requests"] > 0
+  injected = out["chaos_injected"]
+  assert injected["error"] > 0  # the schedule really fired
+  # Injected transient faults surface as retries (and possibly breaker
+  # opens), not as aborted runs.
+  assert out["resilience"]["retries"] > 0
+  assert out["breaker_state"] in ("closed", "open", "half_open")
+  assert set(out["errors"]) == {"transient", "permanent", "deadline"}

@@ -23,9 +23,16 @@ whole loop runs in seconds on CPU — the tier-1 smoke mode
 ``--chaos`` wraps the engine in ``serve.FaultyEngine`` with a seeded,
 deterministic fault schedule (transient errors + slow dispatches) and
 lets workers ride the resilience layer instead of aborting — the JSON
-line then carries the chaos accounting (injected faults, retries,
-breaker opens, error counts) next to the usual serving numbers.
-``--chaos --dry`` is the tier-1-safe chaos smoke.
+line then carries the chaos injection accounting next to the usual
+serving numbers. ``--chaos --dry`` is the tier-1-safe chaos smoke.
+Error/resilience counters and the final breaker state are in the JSON
+on EVERY run (chaos or not), so outage behavior trends across BENCH
+rounds.
+
+``--trace`` turns on request tracing (``obs.Tracer``) and adds a
+``trace`` block — finished-trace count, slowest exemplar, and the span
+names covering the request path; ``--trace --dry`` is the tier-1 smoke
+pinning the span tree end to end.
 
 Usage: python bench/serve_load.py [--duration 10] [--concurrency 8] ...
 """
@@ -75,6 +82,10 @@ def build_parser() -> argparse.ArgumentParser:
                   help="per-dispatch transient-error probability")
   ap.add_argument("--chaos-slow-rate", type=float, default=0.04,
                   help="per-dispatch slow-dispatch probability")
+  ap.add_argument("--trace", action="store_true",
+                  help="trace every request (obs.Tracer) and report the "
+                       "trace accounting + slowest-exemplar span names "
+                       "in the JSON")
   return ap
 
 
@@ -123,10 +134,12 @@ def main(argv=None) -> int:
       RenderEngine,
       RenderService,
       ResilienceConfig,
+      Tracer,
   )
 
   use_mesh = {"auto": None, "on": True, "off": False}[args.sharded]
   engine = None
+  tracer = Tracer() if args.trace else None
   resilience = ResilienceConfig()
   if args.chaos:
     # Schedule armed AFTER warm-up: warm-up dispatches bypass the
@@ -142,7 +155,7 @@ def main(argv=None) -> int:
   svc = RenderService(
       cache_bytes=args.cache_mb << 20, max_batch=args.max_batch,
       max_wait_ms=args.max_wait_ms, method=args.method, use_mesh=use_mesh,
-      engine=engine, resilience=resilience)
+      engine=engine, resilience=resilience, tracer=tracer)
   ids = svc.add_synthetic_scenes(
       args.scenes, height=args.img_size, width=args.img_size,
       planes=args.num_planes, seed=args.seed)
@@ -155,6 +168,8 @@ def main(argv=None) -> int:
   # compiles.
   svc.warmup()
   svc.metrics.reset()  # measured window starts clean
+  if tracer is not None:
+    tracer.reset()  # warm-up bakes would hog the slowest-N exemplars
   if args.chaos:
     engine.schedule = chaos_schedule(args.seed, args.chaos_error_rate,
                                      args.chaos_slow_rate)
@@ -176,7 +191,10 @@ def main(argv=None) -> int:
       sid = ids[0] if (rng.random() < 0.5 or len(ids) == 1) \
           else ids[int(rng.integers(1, len(ids)))]
       try:
-        svc.render(sid, random_pose(rng), timeout=600)
+        if args.trace:
+          svc.render_traced(sid, random_pose(rng), timeout=600)
+        else:
+          svc.render(sid, random_pose(rng), timeout=600)
       except Exception as e:  # noqa: BLE001 - chaos rides through, else exit
         if not args.chaos:
           errors.append(e)
@@ -226,13 +244,29 @@ def main(argv=None) -> int:
       "sharded": stats["engine"]["sharded"],
       "dry": bool(args.dry),
       "chaos": bool(args.chaos),
+      # Error + resilience accounting rides EVERY run's JSON (not just
+      # chaos): outage behavior must trend across BENCH rounds, and a
+      # clean round proving zeros is itself the trend line (ROADMAP).
+      "errors": stats["errors"],
+      "rejected": stats["rejected"],
+      "resilience": stats["resilience"],
+      "breaker_state": (stats["breaker"]["state"]
+                        if "breaker" in stats else None),
   }
   if args.chaos:
     record["chaos_injected"] = stats["engine"]["fault_injection"]
     record["chaos_failed_requests"] = dict(sorted(failure_counts.items()))
-    record["errors"] = stats["errors"]
-    record["resilience"] = stats["resilience"]
-    record["breaker_state"] = stats["breaker"]["state"]
+  if tracer is not None:
+    snap = tracer.snapshot()
+    slowest = snap["slowest"]
+    record["trace"] = {
+        "finished": snap["finished"],
+        "slowest_ms": slowest[0]["duration_ms"] if slowest else None,
+        # Span-name coverage across the slowest exemplars: the smoke
+        # test pins that the tree really covers the whole request path.
+        "span_names": sorted({s["name"] for t in slowest
+                              for s in t["spans"]}),
+    }
   print(json.dumps(record))
   return 0
 

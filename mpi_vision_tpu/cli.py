@@ -11,7 +11,8 @@ export cell 18). These commands make the same flow scriptable:
     reference's ``test/rgba_*.png``) into the standalone HTML viewer.
   * ``serve`` — run the batched render-serving subsystem (serve/): scene
     cache + micro-batching scheduler + HTTP front end (``/render``,
-    ``/healthz``, ``/stats``) over synthetic scenes or a baked PNG MPI.
+    ``/healthz``, ``/stats``, ``/metrics``, ``/debug/traces``,
+    ``/debug/profile``) over synthetic scenes or a baked PNG MPI.
 
 All print a one-line JSON summary on stdout (diagnostics on stderr).
 """
@@ -207,6 +208,7 @@ def cmd_serve(args: argparse.Namespace) -> dict:
   from mpi_vision_tpu.serve import (
       RenderService,
       ResilienceConfig,
+      Tracer,
       make_http_server,
   )
 
@@ -220,11 +222,16 @@ def cmd_serve(args: argparse.Namespace) -> dict:
         breaker_threshold=args.breaker_threshold,
         breaker_reset_s=args.breaker_reset_s,
         watchdog_s=args.watchdog_s if args.watchdog_s > 0 else None)
+  tracer = None
+  if args.trace:
+    tracer = Tracer(ring=args.trace_ring,
+                    emit=_log if args.trace_log else None)
   svc = RenderService(
       cache_bytes=args.cache_mb << 20, max_batch=args.max_batch,
       max_wait_ms=args.max_wait_ms, method=args.method, use_mesh=use_mesh,
       max_queue=args.max_queue, resilience=resilience,
-      cpu_fallback=args.cpu_fallback)
+      cpu_fallback=args.cpu_fallback, tracer=tracer,
+      profile_dir=args.profile_dir or None)
   if args.mpi_dir:
     from mpi_vision_tpu.core.camera import intrinsics_matrix, inv_depths
     from mpi_vision_tpu.viewer import export
@@ -277,7 +284,9 @@ def cmd_serve(args: argparse.Namespace) -> dict:
   thread = threading.Thread(target=httpd.serve_forever, daemon=True)
   thread.start()
   _log(f"serve: listening on http://{args.host}:{port} "
-       f"(/render, /healthz, /stats); engine {svc.engine.describe()}")
+       f"(/render, /healthz, /stats, /metrics, /debug/traces"
+       f"{', /debug/profile' if svc.profiler is not None else ''}); "
+       f"engine {svc.engine.describe()}")
 
   t0 = time.time()
   try:
@@ -307,6 +316,7 @@ def cmd_serve(args: argparse.Namespace) -> dict:
       "errors": stats["errors"],
       "rejected": stats["rejected"],
       "resilience": stats["resilience"],
+      **({"traces": svc.tracer.finished} if args.trace else {}),
   }
 
 
@@ -420,6 +430,19 @@ def build_parser() -> argparse.ArgumentParser:
                  choices=("auto", "on", "off"),
                  help="degraded-mode CPU engine while the breaker is open "
                       "(auto: only when the primary is not CPU)")
+  s.add_argument("--trace", action=argparse.BooleanOptionalAction,
+                 default=True,
+                 help="record per-request span trees (X-Trace-Id header, "
+                      "/debug/traces); --no-trace is the zero-overhead "
+                      "off switch")
+  s.add_argument("--trace-ring", type=int, default=256,
+                 help="finished traces retained for /debug/traces")
+  s.add_argument("--trace-log", action="store_true",
+                 help="also emit each finished trace as a JSON line on "
+                      "stderr")
+  s.add_argument("--profile-dir", default="",
+                 help="enable /debug/profile?seconds=N device captures "
+                      "(jax.profiler) into this TensorBoard logdir")
   s.set_defaults(fn=cmd_serve)
   return ap
 

@@ -10,7 +10,9 @@ eps constants (utils.py:36-38, 391 — both preserved in ``core.geometry`` /
     instead of silently poisoning downstream pixels/gradients.
   * ``trace(logdir)`` — re-export of ``jax.profiler.trace``: a trace
     context capturing a device profile of a render/train region (view in
-    TensorBoard/XProf).
+    TensorBoard/XProf). The serving stack's on-demand profiler
+    (``obs.profile.DeviceProfiler``, ``/debug/profile``) wraps exactly
+    this entry point — one profiler surface for the whole repo.
   * ``named_scope`` — re-export of ``jax.named_scope``; the core pipelines
     annotate their stages with it so profiles and HLO dumps read as
     ``render/warp``, ``render/composite``, ``loss/vgg`` instead of a flat

@@ -1,0 +1,39 @@
+"""Observability layer for the serving stack: tracing, Prometheus, profiling.
+
+The instrumentation backbone the ROADMAP's perf work (async
+double-buffering, multi-host serving) will be measured with — per-stage
+visibility in the FastNeRF/Potamoi style, where every latency claim is a
+per-stage accounting, not a single end-to-end number:
+
+  * ``trace`` — request tracing: a lock-guarded, injectable-clock
+    ``Tracer`` hands each ``/render`` a trace id and records a span tree
+    (queue-wait, batch-assembly, dispatch with retry attempts, bake,
+    h2d/compute/readback), emitted as structured JSON log lines and kept
+    in a bounded ring served at ``/debug/traces``. Disabled tracing
+    routes every call through the ``NULL_TRACE``/``NULL_TRACER``
+    singletons — empty methods, no allocation, no locking.
+  * ``prom`` — Prometheus text exposition: a small metric registry
+    rendering the ``/stats`` snapshot (every ``ServeMetrics`` counter,
+    the latency histogram, breaker state, cache stats) in the standard
+    ``# TYPE``/``# HELP`` format for ``/metrics``.
+  * ``profile`` — on-demand device profiling: a concurrency-guarded
+    wrapper over ``jax.profiler`` (via ``debug.trace``) capturing live
+    traffic for N seconds (``/debug/profile``, ``serve --profile-dir``).
+"""
+
+from mpi_vision_tpu.obs.profile import DeviceProfiler, ProfileBusyError
+from mpi_vision_tpu.obs.prom import (
+    Metric,
+    Registry,
+    parse_metrics_text,
+    render_serve_metrics,
+    serve_registry,
+)
+from mpi_vision_tpu.obs.trace import (
+    NULL_TRACE,
+    NULL_TRACER,
+    SpanRecorder,
+    Trace,
+    Tracer,
+    new_trace_id,
+)

@@ -1,0 +1,283 @@
+"""Prometheus text exposition for the serving metrics.
+
+A small registry (``Metric`` families collected into a ``Registry``,
+rendered as ``# HELP``/``# TYPE`` + samples) so ``/metrics`` is built
+declaratively here instead of string-formatted through ``server.py``.
+``serve_registry`` maps the ``/stats`` snapshot — every ``ServeMetrics``
+counter, the cumulative latency histogram, breaker state, cache stats —
+onto stable metric names a stock Prometheus scraper ingests as-is.
+
+Conventions follow the exposition-format spec: counters end in
+``_total``, histograms emit cumulative ``_bucket{le=...}`` series plus
+``_sum``/``_count``, enum-style state (breaker) is one gauge per state
+with exactly one sample at 1. ``parse_metrics_text`` is the minimal
+inverse used by the tier-1 test that pins ``/metrics`` against
+``/stats``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+_TYPES = ("counter", "gauge", "histogram")
+
+# The shared metric-name prefix: one grep (or one Grafana variable) finds
+# every series this stack exports.
+PREFIX = "mpi_serve_"
+
+
+def _escape_help(text: str) -> str:
+  return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _escape_label(value: str) -> str:
+  return (value.replace("\\", "\\\\").replace("\n", "\\n")
+          .replace('"', '\\"'))
+
+
+def format_value(value) -> str:
+  """Prometheus sample value: integers bare, floats via repr, +Inf/NaN."""
+  if value is None:
+    return "NaN"
+  if isinstance(value, bool):
+    return "1" if value else "0"
+  if isinstance(value, int):
+    return str(value)
+  value = float(value)
+  if math.isinf(value):
+    return "+Inf" if value > 0 else "-Inf"
+  if math.isnan(value):
+    return "NaN"
+  if value == int(value) and abs(value) < 1e15:
+    return str(int(value))
+  return repr(value)
+
+
+@dataclasses.dataclass
+class Metric:
+  """One metric family: name, type, help, and its samples.
+
+  Samples are ``(suffix, labels, value)`` — suffix is appended to the
+  family name (histograms use ``_bucket``/``_sum``/``_count``).
+  """
+
+  name: str
+  mtype: str
+  help: str
+
+  def __post_init__(self):
+    if self.mtype not in _TYPES:
+      raise ValueError(f"metric type must be one of {_TYPES}, "
+                       f"got {self.mtype!r}")
+    self.samples: list[tuple[str, dict, object]] = []
+
+  def sample(self, value, labels: dict | None = None,
+             suffix: str = "") -> "Metric":
+    self.samples.append((suffix, dict(labels or {}), value))
+    return self
+
+  def render(self) -> str:
+    lines = [f"# HELP {self.name} {_escape_help(self.help)}",
+             f"# TYPE {self.name} {self.mtype}"]
+    for suffix, labels, value in self.samples:
+      label_str = ""
+      if labels:
+        inner = ",".join(f'{k}="{_escape_label(str(v))}"'
+                         for k, v in labels.items())
+        label_str = "{" + inner + "}"
+      lines.append(f"{self.name}{suffix}{label_str} {format_value(value)}")
+    return "\n".join(lines)
+
+
+class Registry:
+  """An ordered collection of metric families rendered as one exposition."""
+
+  def __init__(self):
+    self._metrics: list[Metric] = []
+
+  def counter(self, name: str, help: str, value=None,
+              labels: dict | None = None) -> Metric:
+    m = Metric(name, "counter", help)
+    if value is not None:
+      m.sample(value, labels)
+    self._metrics.append(m)
+    return m
+
+  def gauge(self, name: str, help: str, value=None,
+            labels: dict | None = None) -> Metric:
+    m = Metric(name, "gauge", help)
+    if value is not None:
+      m.sample(value, labels)
+    self._metrics.append(m)
+    return m
+
+  def histogram(self, name: str, help: str, buckets, sum_value,
+                count) -> Metric:
+    """``buckets``: iterable of ``(upper_bound_or_inf, cumulative_count)``
+    in ascending bound order; the ``+Inf`` bucket is added when absent."""
+    m = Metric(name, "histogram", help)
+    saw_inf = False
+    for le, cum in buckets:
+      saw_inf = saw_inf or math.isinf(float(le))
+      m.sample(cum, {"le": format_value(float(le))}, suffix="_bucket")
+    if not saw_inf:
+      m.sample(count, {"le": "+Inf"}, suffix="_bucket")
+    m.sample(sum_value, suffix="_sum")
+    m.sample(count, suffix="_count")
+    self._metrics.append(m)
+    return m
+
+  def enum(self, name: str, help: str, states, active: str) -> Metric:
+    """One gauge sample per state; the active one is 1, the rest 0."""
+    m = Metric(name, "gauge", help)
+    for state in states:
+      m.sample(1 if state == active else 0, {"state": state})
+    self._metrics.append(m)
+    return m
+
+  def render(self) -> str:
+    return "\n".join(m.render() for m in self._metrics) + "\n"
+
+
+def serve_registry(stats: dict,
+                   latency_hist: dict | None = None) -> Registry:
+  """Map a ``RenderService.stats()`` snapshot onto the metric families.
+
+  ``latency_hist`` is ``ServeMetrics.latency_histogram()`` (cumulative
+  bucket counts + sum + count); None omits the histogram family.
+  """
+  reg = Registry()
+  p = PREFIX
+  reg.gauge(p + "uptime_seconds",
+            "Seconds since the metrics window started.",
+            stats.get("uptime_s", 0.0))
+  reg.counter(p + "requests_total", "Completed render requests.",
+              stats.get("requests", 0))
+  reg.counter(p + "batches_total", "Device dispatches (micro-batches).",
+              stats.get("batches", 0))
+  reg.counter(p + "device_render_seconds_total",
+              "Cumulative device time inside engine render calls.",
+              stats.get("device_render_seconds", 0.0))
+  phases = stats.get("device_phase_seconds") or {}
+  phase_m = reg.counter(
+      p + "device_phase_seconds_total",
+      "Device render time split by phase (h2d / compute / readback).")
+  for phase in ("h2d", "compute", "readback"):
+    phase_m.sample(phases.get(phase, 0.0), {"phase": phase})
+  errors = stats.get("errors") or {}
+  err_m = reg.counter(
+      p + "errors_total",
+      "Failed requests by class (transient device / permanent bad-input "
+      "/ deadline-expired).")
+  for cls in ("transient", "permanent", "deadline"):
+    err_m.sample(errors.get(cls, 0), {"class": cls})
+  reg.counter(p + "rejected_total",
+              "Submissions shed at the door (queue full).",
+              stats.get("rejected", 0))
+  res = stats.get("resilience") or {}
+  for key, help_text in (
+      ("retries", "Retry attempts after transient dispatch failures."),
+      ("watchdog_trips", "Dispatches abandoned by the hang watchdog."),
+      ("fallback_renders", "Batches served by the degraded-mode "
+                           "fallback engine."),
+      ("breaker_opens", "Circuit breaker CLOSED->OPEN transitions."),
+      ("breaker_fastfails", "Requests fast-failed against an open "
+                            "circuit."),
+      ("client_disconnects", "Clients that hung up mid-response."),
+  ):
+    reg.counter(p + key + "_total", help_text, res.get(key, 0))
+  reg.gauge(p + "queue_depth", "Pending requests in the scheduler queue.",
+            stats.get("queue_depth", 0))
+  if latency_hist is not None:
+    reg.histogram(p + "request_latency_seconds",
+                  "End-to-end request latency (enqueue to response).",
+                  latency_hist["buckets"], latency_hist["sum"],
+                  latency_hist["count"])
+  hist = stats.get("batch_size_hist") or {}
+  sizes = sorted(int(k) for k in hist)
+  cum, total_reqs, buckets = 0, 0, []
+  for size in sizes:
+    cum += hist[str(size)]
+    total_reqs += size * hist[str(size)]
+    buckets.append((float(size), cum))
+  reg.histogram(p + "batch_size",
+                "Coalesced requests per device dispatch.",
+                buckets, total_reqs, stats.get("batches", 0))
+  cache = stats.get("cache") or {}
+  reg.counter(p + "cache_hits_total", "Scene-cache hits.",
+              cache.get("hits", 0))
+  reg.counter(p + "cache_misses_total", "Scene-cache misses (bakes).",
+              cache.get("misses", 0))
+  reg.counter(p + "cache_evictions_total", "Scene-cache LRU evictions.",
+              cache.get("evictions", 0))
+  reg.gauge(p + "cache_bytes", "Bytes of baked scenes resident.",
+            cache.get("bytes", 0))
+  reg.gauge(p + "cache_scenes", "Baked scenes resident.",
+            cache.get("scenes", 0))
+  breaker = stats.get("breaker")
+  if breaker is not None:
+    reg.enum(p + "breaker_state",
+             "Circuit breaker state (one-hot).",
+             ("closed", "open", "half_open"), breaker.get("state", ""))
+    reg.gauge(p + "breaker_consecutive_failures",
+              "Consecutive primary failures counted by the breaker.",
+              breaker.get("consecutive_failures", 0))
+  return reg
+
+
+def render_serve_metrics(stats: dict,
+                         latency_hist: dict | None = None) -> str:
+  """The ``/metrics`` response body for one stats snapshot."""
+  return serve_registry(stats, latency_hist).render()
+
+
+def parse_metrics_text(text: str) -> dict:
+  """Minimal exposition-format parser (the test-side inverse).
+
+  Returns ``{family: {"type": str, "help": str, "samples":
+  {(sample_name, (("label", "value"), ...)): float}}}``. Handles exactly
+  what ``Registry.render`` emits (no exemplars, no timestamps, no
+  escaped-quote labels with commas inside).
+  """
+  out: dict = {}
+
+  def family(name: str) -> dict:
+    return out.setdefault(name,
+                          {"type": None, "help": None, "samples": {}})
+
+  for line in text.splitlines():
+    line = line.strip()
+    if not line:
+      continue
+    if line.startswith("# HELP "):
+      _, _, rest = line.partition("# HELP ")
+      name, _, help_text = rest.partition(" ")
+      family(name)["help"] = help_text
+    elif line.startswith("# TYPE "):
+      _, _, rest = line.partition("# TYPE ")
+      name, _, mtype = rest.partition(" ")
+      family(name)["type"] = mtype
+    elif line.startswith("#"):
+      continue
+    else:
+      name_part, _, value_str = line.rpartition(" ")
+      labels: tuple = ()
+      if "{" in name_part:
+        sample_name, _, label_part = name_part.partition("{")
+        label_part = label_part.rstrip("}")
+        pairs = []
+        for item in filter(None, label_part.split(",")):
+          k, _, v = item.partition("=")
+          pairs.append((k, v.strip('"')))
+        labels = tuple(sorted(pairs))
+      else:
+        sample_name = name_part
+      base = sample_name
+      for suffix in ("_bucket", "_sum", "_count"):
+        if base.endswith(suffix) and base[:-len(suffix)] in out:
+          base = base[:-len(suffix)]
+          break
+      value = float(value_str) if value_str != "+Inf" else math.inf
+      family(base)["samples"][(sample_name, labels)] = value
+  return out

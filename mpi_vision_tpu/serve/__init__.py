@@ -14,7 +14,14 @@ up through transient device loss: error classification, per-batch retry
 with deadline-bounded backoff, a circuit breaker with half-open probes,
 a dispatcher watchdog, and degraded-mode CPU fallback — all surfaced in
 ``/healthz`` (ok / degraded / unhealthy) and the metrics snapshot.
+
+The observability layer (``mpi_vision_tpu.obs``) rides the same path:
+per-request span trees (X-Trace-Id, ``/debug/traces``), Prometheus text
+exposition (``/metrics``), and on-demand device profiling
+(``/debug/profile``) — see the README's Observability section.
 """
+
+from mpi_vision_tpu.obs import DeviceProfiler, ProfileBusyError, Tracer
 
 from mpi_vision_tpu.serve.cache import BakedScene, SceneCache, bake_scene
 from mpi_vision_tpu.serve.engine import RenderEngine

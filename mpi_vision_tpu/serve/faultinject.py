@@ -19,6 +19,12 @@ Fault | None`` (the chaos-mode generator in ``bench/serve_load.py``).
 Both are deterministic: dispatch indices are assigned under a lock in
 dispatch order, and a seeded schedule replays exactly. Everything runs
 on CPU, so every resilience behavior is testable in tier-1.
+
+Cache-bake faults (``inject_bake`` / ``bake_schedule``) cover the other
+half of the request path: the scene provider consults ``check_bake``
+before baking, so a cold scene can fail exactly where a dead device
+would fail it — inside the resilient dispatch, where it must retry,
+count toward the breaker, and land on the trace's bake span.
 """
 
 from __future__ import annotations
@@ -59,14 +65,17 @@ class FaultyEngine:
   hold time).
   """
 
-  def __init__(self, inner, schedule=None):
+  def __init__(self, inner, schedule=None, bake_schedule=None):
     self.inner = inner
     self.schedule = schedule
+    self.bake_schedule = bake_schedule
     self.release = threading.Event()
     self._lock = threading.Lock()
     self._queue: list[Fault] = []
+    self._bake_queue: list[Fault] = []
     self._index = 0
-    self.injected = {"error": 0, "hang": 0, "slow": 0}
+    self._bake_index = 0
+    self.injected = {"error": 0, "hang": 0, "slow": 0, "bake": 0}
 
   # -- scheduling ---------------------------------------------------------
 
@@ -79,9 +88,20 @@ class FaultyEngine:
     """Shorthand: the next ``n`` dispatches raise an error fault."""
     self.inject(*(Fault("error", transient=transient) for _ in range(n)))
 
+  def inject_bake(self, *faults: Fault) -> None:
+    """Queue faults for the next cache bakes (one fault per bake)."""
+    with self._lock:
+      self._bake_queue.extend(faults)
+
+  def fail_next_bake(self, n: int = 1, transient: bool = True) -> None:
+    """Shorthand: the next ``n`` scene bakes raise an error fault."""
+    self.inject_bake(*(Fault("error", transient=transient)
+                       for _ in range(n)))
+
   def clear(self) -> None:
     with self._lock:
       self._queue.clear()
+      self._bake_queue.clear()
 
   def _next_fault(self) -> Fault | None:
     with self._lock:
@@ -89,6 +109,34 @@ class FaultyEngine:
       if self._queue:
         return self._queue.pop(0)
     return self.schedule(idx) if self.schedule is not None else None
+
+  def _next_bake_fault(self) -> Fault | None:
+    with self._lock:
+      idx, self._bake_index = self._bake_index, self._bake_index + 1
+      if self._bake_queue:
+        return self._bake_queue.pop(0)
+    return (self.bake_schedule(idx)
+            if self.bake_schedule is not None else None)
+
+  def check_bake(self, scene_id: str) -> None:
+    """Scene-provider hook: fail this bake if a bake fault is scheduled.
+
+    ``RenderService`` consults this (when the engine exposes it) inside
+    the cache-miss bake path — so the fault fires only on real bakes
+    (cached scenes never reach it), rides the resilient dispatch like a
+    failed render, and is recorded on the trace's bake span.
+    """
+    fault = self._next_bake_fault()
+    if fault is None:
+      return
+    with self._lock:
+      self.injected["bake"] += 1
+    if fault.kind == "slow":
+      time.sleep(fault.seconds)
+      return
+    if fault.kind == "hang":
+      self.release.wait(fault.seconds)
+    self._raise(fault, f"injected bake fault for {scene_id!r}")
 
   # -- engine surface -----------------------------------------------------
 
@@ -143,6 +191,10 @@ class FaultyEngine:
   @property
   def dispatches(self):
     return self.inner.dispatches
+
+  @property
+  def last_timings(self):
+    return self.inner.last_timings
 
   @property
   def platform(self):

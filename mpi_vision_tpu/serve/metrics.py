@@ -21,6 +21,15 @@ def percentile(sorted_values, q: float) -> float:
   return float(sorted_values[idx])
 
 
+# Prometheus-histogram bucket bounds (seconds) for request latency.
+# Log-ish spacing from 1 ms to 10 s: serving latencies span XLA-compiled
+# sub-ms hits to cold-bake + retry-storm tails, and a scraper needs the
+# whole range. Cumulative lifetime counts (unlike the percentile window,
+# which is recent-only by design).
+LATENCY_BUCKETS_S = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                     0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+
 class ServeMetrics:
   """Aggregates the serving layer's observability counters."""
 
@@ -36,11 +45,17 @@ class ServeMetrics:
     with self._lock:
       self._t0 = self._clock()
       self._latencies = collections.deque(maxlen=self._window)
+      self._lat_bucket_counts = [0] * len(LATENCY_BUCKETS_S)
+      self._lat_overflow = 0  # latencies above the largest bound
+      self._lat_sum = 0.0
       self._batch_hist = collections.Counter()
       self._queue_depth = 0
       self.requests = 0
       self.batches = 0
       self.render_seconds = 0.0
+      # Device-phase split of render_seconds (engine.last_timings):
+      # host->device transfer / compute / device->host readback.
+      self.phase_seconds = {"h2d": 0.0, "compute": 0.0, "readback": 0.0}
       # Failure accounting: without these, failed renders vanish from the
       # snapshot entirely (record_request fires only on success) and
       # /stats reads "healthy" straight through an outage.
@@ -60,6 +75,13 @@ class ServeMetrics:
     with self._lock:
       self.requests += 1
       self._latencies.append(latency_s)
+      self._lat_sum += latency_s
+      for i, bound in enumerate(LATENCY_BUCKETS_S):
+        if latency_s <= bound:
+          self._lat_bucket_counts[i] += 1
+          break
+      else:
+        self._lat_overflow += 1
 
   def record_error(self, kind: str, count: int = 1) -> None:
     """``count`` requests failed with a ``kind``-class error.
@@ -109,12 +131,37 @@ class ServeMetrics:
     with self._lock:
       self.client_disconnects += 1
 
-  def record_batch(self, size: int, render_s: float) -> None:
-    """One device dispatch of ``size`` coalesced requests."""
+  def record_batch(self, size: int, render_s: float,
+                   phases: dict | None = None) -> None:
+    """One device dispatch of ``size`` coalesced requests.
+
+    ``phases`` is the engine's per-dispatch phase split (keys ``h2d_s``,
+    ``compute_s``, ``readback_s``), accumulated into lifetime totals so
+    ``/metrics`` can say where device time actually goes.
+    """
     with self._lock:
       self.batches += 1
       self._batch_hist[int(size)] += 1
       self.render_seconds += render_s
+      if phases:
+        for key in ("h2d", "compute", "readback"):
+          self.phase_seconds[key] += float(phases.get(key + "_s", 0.0))
+
+  def latency_histogram(self) -> dict:
+    """Cumulative Prometheus-style latency histogram.
+
+    ``buckets`` are ``(upper_bound_s, cumulative_count)`` ascending plus
+    the ``+Inf`` bucket; ``sum``/``count`` follow the exposition format.
+    """
+    with self._lock:
+      cum, buckets = 0, []
+      for bound, n in zip(LATENCY_BUCKETS_S, self._lat_bucket_counts):
+        cum += n
+        buckets.append((bound, cum))
+      total = cum + self._lat_overflow
+      buckets.append((float("inf"), total))
+      return {"buckets": buckets, "sum": round(self._lat_sum, 6),
+              "count": total}
 
   def set_queue_depth(self, depth: int) -> None:
     with self._lock:
@@ -136,6 +183,8 @@ class ServeMetrics:
           "mean_batch_size": (round(self.requests / self.batches, 3)
                               if self.batches else None),
           "device_render_seconds": round(self.render_seconds, 3),
+          "device_phase_seconds": {k: round(v, 3)
+                                   for k, v in self.phase_seconds.items()},
           "queue_depth": self._queue_depth,
           "errors": {
               "transient": self.errors_transient,

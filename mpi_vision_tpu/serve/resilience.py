@@ -357,8 +357,16 @@ class ResilientExecutor:
       return self.config.watchdog_s
     return min(self.config.watchdog_s, deadline - self._clock())
 
-  def run(self, primary_fn, fallback_fn=None, deadline: float | None = None):
-    """One resilient dispatch. ``deadline`` is absolute (clock units)."""
+  def run(self, primary_fn, fallback_fn=None, deadline: float | None = None,
+          recorder=None):
+    """One resilient dispatch. ``deadline`` is absolute (clock units).
+
+    ``recorder`` is an optional ``obs.trace.SpanRecorder``: every attempt
+    becomes an ``attempt`` span group (errors recorded on it, spans made
+    inside the attempt closure nest under it) and every retry backoff a
+    ``backoff`` span — the trace-tree view of the retry machinery. None
+    (the tracing-disabled default) records nothing.
+    """
     attempt = 0
     while True:
       use_fallback = False
@@ -375,9 +383,14 @@ class ResilientExecutor:
         # slot leaks and the breaker wedges in HALF_OPEN forever.
         holds_probe = self.breaker.state == CircuitBreaker.HALF_OPEN
       timeout = self._watchdog_timeout(deadline)
+      span = (recorder.begin("attempt", attempt=attempt,
+                             fallback=use_fallback)
+              if recorder is not None else None)
       try:
         fn = fallback_fn if use_fallback else primary_fn
         out = call_with_watchdog(fn, timeout)
+        if span is not None:
+          recorder.end(span)
         if use_fallback:
           if self.metrics is not None:
             self.metrics.record_fallback()
@@ -385,6 +398,8 @@ class ResilientExecutor:
           self.breaker.record_success()
         return out
       except Exception as e:  # noqa: BLE001 - classified below
+        if span is not None:
+          recorder.end(span, error=repr(e))
         if classify_error(e) == "permanent":
           if holds_probe:
             self.breaker.release_probe()  # outcome says nothing re: device
@@ -417,4 +432,9 @@ class ResilientExecutor:
         if self.metrics is not None:
           self.metrics.record_retry()
         if backoff > 0:
-          self._sleep(backoff)
+          if recorder is not None:
+            b = recorder.begin("backoff", attempt=attempt)
+            self._sleep(backoff)
+            recorder.end(b)
+          else:
+            self._sleep(backoff)

@@ -15,6 +15,13 @@ pads with repeated poses, never altering live views).
 One dispatch in flight at a time: the device is the serialized resource,
 and the queue is the backpressure signal (depth exported via metrics).
 Requests for other scenes keep FIFO order among themselves.
+
+Tracing rides the queue: each ``_Pending`` carries its request's
+``obs.trace.Trace`` (the no-op singleton when tracing is off), the
+dispatcher closes the queue-wait span, stamps the shared batch-assembly/
+dispatch/attempt/phase spans into every batch member, and finishes the
+trace when the future resolves. All time reads go through the injected
+``clock`` so spans, deadlines, and latencies share one base.
 """
 
 from __future__ import annotations
@@ -27,6 +34,7 @@ from concurrent.futures import Future, TimeoutError as FuturesTimeoutError
 
 import numpy as np
 
+from mpi_vision_tpu.obs.trace import NULL_TRACE, SpanRecorder
 from mpi_vision_tpu.serve.engine import RenderEngine
 from mpi_vision_tpu.serve.metrics import ServeMetrics
 from mpi_vision_tpu.serve.resilience import (
@@ -52,6 +60,8 @@ class _Pending:
   future: Future
   t_enqueue: float
   deadline: float | None = None  # absolute monotonic; None = no deadline
+  trace: object = NULL_TRACE     # obs.trace.Trace (or the no-op singleton)
+  qspan: int = 0                 # open queue_wait span handle
 
 
 class MicroBatcher:
@@ -77,6 +87,9 @@ class MicroBatcher:
     fallback_engine / fallback_scene_provider: the degraded-mode route —
       a CPU engine plus a provider baking scenes onto *its* devices; used
       only while the breaker refuses the primary.
+    clock: injectable monotonic clock (deadlines, latencies, span edges
+      all read it — share one instance with the tracer and the resilient
+      executor so every timestamp is on one base).
   """
 
   def __init__(self, engine: RenderEngine, scene_provider,
@@ -84,7 +97,8 @@ class MicroBatcher:
                max_batch: int = 8, max_wait_ms: float = 2.0,
                max_queue: int = 1024,
                resilient: ResilientExecutor | None = None,
-               fallback_engine=None, fallback_scene_provider=None):
+               fallback_engine=None, fallback_scene_provider=None,
+               clock=time.monotonic):
     if max_batch < 1:
       raise ValueError(f"max_batch must be >= 1, got {max_batch}")
     if max_queue < 1:
@@ -100,10 +114,12 @@ class MicroBatcher:
     self.resilient = resilient
     self.fallback_engine = fallback_engine
     self.fallback_scene_provider = fallback_scene_provider
+    self._clock = clock
     self._queue: deque[_Pending] = deque()
     self._cond = threading.Condition()
     self._stop = False
     self._thread: threading.Thread | None = None
+    self._last_assembly: tuple[float, float] | None = None
 
   @property
   def rejected(self) -> int:
@@ -131,9 +147,12 @@ class MicroBatcher:
       while self._queue:  # drain: fail leftovers instead of hanging callers
         req = self._queue.popleft()
         if req.future.set_running_or_notify_cancel():
-          req.future.set_exception(RuntimeError(
+          exc = RuntimeError(
               "scheduler stopped: request dropped at shutdown "
-              "before it reached the device"))
+              "before it reached the device")
+          req.trace.end_span(req.qspan, error="scheduler stopped")
+          req.future.set_exception(exc)
+          req.trace.finish(error=repr(exc))
       self.metrics.set_queue_depth(0)
 
   def dispatcher_alive(self) -> bool:
@@ -143,13 +162,17 @@ class MicroBatcher:
 
   # -- request path -------------------------------------------------------
 
-  def submit(self, scene_id: str, pose,
-             timeout: float | None = None) -> Future:
+  def submit(self, scene_id: str, pose, timeout: float | None = None,
+             trace=NULL_TRACE) -> Future:
     """Enqueue one pose render; the future resolves to ``[H, W, 3]``.
 
     ``timeout`` (seconds) sets the request's deadline: retries/backoff
     stop at it, the dispatch watchdog tightens to it, and a request still
     queued past it fails instead of burning a dispatch.
+
+    ``trace`` is this request's ``obs.trace.Trace``; the dispatcher
+    records its span tree (queue-wait onward) and finishes it when the
+    future resolves. The default no-op singleton costs nothing.
     """
     pose = np.asarray(pose, np.float32)
     if pose.shape != (4, 4):
@@ -159,10 +182,11 @@ class MicroBatcher:
       # no fallback to degrade to: queueing the request would only make
       # the caller wait to learn what is already known.
       self.resilient.check_fastfail(self.fallback_engine is not None)
-    now = time.monotonic()
+    now = self._clock()
     fut: Future = Future()
     req = _Pending(str(scene_id), pose, fut, now,
-                   deadline=None if timeout is None else now + timeout)
+                   deadline=None if timeout is None else now + timeout,
+                   trace=trace, qspan=trace.start_span("queue_wait"))
     with self._cond:
       if self._stop or self._thread is None:
         raise RuntimeError("scheduler is not running")
@@ -175,19 +199,33 @@ class MicroBatcher:
       self._cond.notify_all()
     return fut
 
-  def render(self, scene_id: str, pose, timeout: float = 60.0) -> np.ndarray:
+  def render(self, scene_id: str, pose, timeout: float = 60.0,
+             trace=NULL_TRACE) -> np.ndarray:
     """Synchronous render: submit + wait.
 
     On timeout the request is cancelled (best-effort) so an overloaded
     queue is not burning device dispatches on results nobody will read.
     Never blocks past ``timeout``: the future resolves or times out even
     when the dispatch behind it hangs (the watchdog abandons it).
+
+    Owns ``trace``'s error edge: submit-time rejections and caller
+    timeouts finish it here; everything past the queue the dispatcher
+    finishes (``Trace.finish`` is idempotent, so the race with a late
+    dispatcher resolution is safe).
     """
-    fut = self.submit(scene_id, pose, timeout=timeout)
+    try:
+      fut = self.submit(scene_id, pose, timeout=timeout, trace=trace)
+    except Exception as e:
+      trace.finish(error=repr(e))
+      raise
     try:
       return fut.result(timeout)
     except FuturesTimeoutError:
       fut.cancel()
+      trace.finish(error="caller timed out waiting on the future")
+      raise
+    except Exception as e:
+      trace.finish(error=repr(e))  # dispatcher usually beat us (no-op)
       raise
 
   # -- dispatcher ---------------------------------------------------------
@@ -208,6 +246,7 @@ class MicroBatcher:
           self._cond.wait()
           continue
         head = self._queue[0]
+        t_assembly = self._clock()  # head claimed; straggler window opens
         deadline = head.t_enqueue + self.max_wait_s
         # Straggler window: keep collecting same-scene requests until the
         # batch is full or the head request's wait budget is spent.
@@ -215,7 +254,7 @@ class MicroBatcher:
           same = sum(1 for r in self._queue
                      if r.scene_id == head.scene_id
                      and not r.future.cancelled())
-          remaining = deadline - time.monotonic()
+          remaining = deadline - self._clock()
           if same >= self.max_batch or remaining <= 0 or self._stop:
             break
           self._cond.wait(remaining)
@@ -230,9 +269,61 @@ class MicroBatcher:
         self._queue = rest
         self.metrics.set_queue_depth(len(self._queue))
         if batch:
+          self._last_assembly = (t_assembly, self._clock())
           return batch
         # Everything same-scene was cancelled during the wait; go around
         # (other-scene requests are back in the queue, NOT a stop).
+
+  def _span_render(self, engine, scene_provider, scene_id, poses,
+                   recorder):
+    """One attempt body: scene lookup/bake + engine render; returns
+    ``(images, render_s, phase_timings)``.
+
+    The bake span covers the scene-provider call — a cache hit is ~0 ms,
+    a miss is the real bake — and a failed bake carries its error on the
+    span before re-raising, so the trace tree stays complete through
+    retries/fallback.
+
+    Runs on the watchdog's attempt thread, which may be ABANDONED
+    mid-call and finish after a retry already won: all results travel in
+    the return value (discarded for abandoned attempts — never a shared
+    box a zombie could overwrite), and spans record under the parent
+    captured at entry, so a zombie's late spans land under its own dead
+    attempt instead of the live one.
+    """
+    parent = recorder.current_parent() if recorder is not None else None
+    tb0 = self._clock()
+    try:
+      scene = scene_provider(scene_id)
+    except Exception as e:
+      if recorder is not None:
+        recorder.record("bake", tb0, self._clock(), error=repr(e),
+                        parent=parent, scene_id=scene_id)
+      raise
+    if recorder is not None:
+      recorder.record("bake", tb0, self._clock(), parent=parent,
+                      scene_id=scene_id)
+    # device_render_seconds must stay DEVICE time: the timer runs inside
+    # the attempt closures, around the engine call only — never around
+    # retry backoffs, abandoned watchdog waits, or scene bakes.
+    t0 = self._clock()
+    out = engine.render_batch(scene, poses)
+    t1 = self._clock()
+    # last_timings is engine-shared state: a zombie attempt finishing in
+    # the read window could swap in ITS phase split — same dispatch
+    # magnitudes, never accumulated twice, so the race stays cosmetic
+    # (render_s above is thread-local and immune).
+    timings = getattr(engine, "last_timings", None)
+    if recorder is not None and timings:
+      # Engine timings are durations on its own clock; anchor them inside
+      # [t0, t1] back-to-front so the sub-spans tile the render span.
+      h2d_end = t0 + timings["h2d_s"]
+      compute_end = h2d_end + timings["compute_s"]
+      recorder.record("h2d", t0, h2d_end, parent=parent)
+      recorder.record("compute", h2d_end, compute_end, parent=parent)
+      recorder.record("readback", compute_end,
+                      compute_end + timings["readback_s"], parent=parent)
+    return out, t1 - t0, timings
 
   def _dispatch(self, batch: list[_Pending]) -> None:
     # Claim every future first (PENDING -> RUNNING): a future that was
@@ -243,19 +334,32 @@ class MicroBatcher:
     # A request whose deadline already passed has a caller that gave up
     # (or will, before the result lands): fail it now rather than let it
     # drag the live batch's watchdog budget down to zero.
-    now = time.monotonic()
+    now = self._clock()
     live: list[_Pending] = []
     for req in batch:
       if req.deadline is not None and req.deadline <= now:
         self.metrics.record_error("deadline")  # overload, not device trouble
         exc = DispatchTimeoutError("request deadline expired before dispatch")
         exc.deadline_capped = True  # HTTP layer: 504, not a device 503
+        req.trace.end_span(req.qspan, error="deadline expired in queue")
         req.future.set_exception(exc)
+        req.trace.finish(error=repr(exc))
       else:
         live.append(req)
     batch = live
     if not batch:
       return
+    assembly = self._last_assembly
+    for req in batch:
+      req.trace.end_span(req.qspan)
+      if assembly is not None:
+        req.trace.add_span("batch_assembly", assembly[0], assembly[1],
+                           size=len(batch))
+    # Shared span records (one dispatch, many traces) — only allocated
+    # when at least one batch member is actually traced, so the disabled
+    # path stays allocation-free.
+    recorder = (SpanRecorder(self._clock)
+                if any(r.trace is not NULL_TRACE for r in batch) else None)
     # The batch's dispatch budget follows its MOST patient member: a
     # short-timeout request must not drag its batchmates' watchdog down
     # to its own deadline (the impatient caller's future times out on its
@@ -264,11 +368,11 @@ class MicroBatcher:
     deadlines = [r.deadline for r in batch if r.deadline is not None]
     deadline = max(deadlines) if len(deadlines) == len(batch) else None
     poses = np.stack([r.pose for r in batch])
-    # device_render_seconds must stay DEVICE time: the timer runs inside
-    # the attempt closures, around the engine call only — never around
-    # retry backoffs, abandoned watchdog waits, or scene bakes.
-    render_box = {"s": 0.0}
+    d0 = self._clock()
     try:
+      # Each attempt returns (images, render_s, phases) — results travel
+      # by return value so an attempt thread the watchdog abandoned can
+      # never overwrite the winning attempt's accounting.
       if self.resilient is not None:
 
         def primary_fn(scene_id=batch[0].scene_id):
@@ -276,11 +380,8 @@ class MicroBatcher:
           # onto a dead device must retry / count toward the breaker /
           # degrade to the fallback exactly like a failed render — a
           # cold scene during an outage is the worst time to fail raw.
-          scene = self.scene_provider(scene_id)
-          t0 = time.perf_counter()
-          out = self.engine.render_batch(scene, poses)
-          render_box["s"] = time.perf_counter() - t0
-          return out
+          return self._span_render(self.engine, self.scene_provider,
+                                   scene_id, poses, recorder)
 
         fallback_fn = None
         if self.fallback_engine is not None:
@@ -288,36 +389,42 @@ class MicroBatcher:
             # Bake onto the FALLBACK's devices at call time: baking every
             # scene to CPU up front would double host->device traffic for
             # an outage that may never happen.
-            fb_scene = self.fallback_scene_provider(scene_id)
-            t0 = time.perf_counter()
-            out = self.fallback_engine.render_batch(fb_scene, poses)
-            render_box["s"] = time.perf_counter() - t0
-            return out
-        out = self.resilient.run(
-            primary_fn, fallback_fn=fallback_fn, deadline=deadline)
+            return self._span_render(
+                self.fallback_engine, self.fallback_scene_provider,
+                scene_id, poses, recorder)
+        out, render_s, phases = self.resilient.run(
+            primary_fn, fallback_fn=fallback_fn, deadline=deadline,
+            recorder=recorder)
       else:
-        # Scene lookup BEFORE the render timer: a cache-miss bake
-        # (blocking host->device transfer) must show up in cache stats,
-        # not inflate device_render_seconds as a phantom slow kernel.
-        scene = self.scene_provider(batch[0].scene_id)
-        t0 = time.perf_counter()
-        out = self.engine.render_batch(scene, poses)
-        render_box["s"] = time.perf_counter() - t0
+        out, render_s, phases = self._span_render(
+            self.engine, self.scene_provider, batch[0].scene_id, poses,
+            recorder)
     except Exception as e:  # noqa: BLE001 - forwarded to every caller
       kind = ("deadline" if getattr(e, "deadline_capped", False)
               else classify_error(e))
       self.metrics.record_error(kind, count=len(batch))
+      d1 = self._clock()
+      err = repr(e)
       for req in batch:
+        dspan = req.trace.add_span("dispatch", d0, d1, error=err,
+                                   size=len(batch))
+        if recorder is not None:
+          recorder.replay(req.trace, parent=dspan)
         req.future.set_exception(e)
+        req.trace.finish(error=err)
       return
-    render_s = render_box["s"]
-    done = time.monotonic()
-    self.metrics.record_batch(len(batch), render_s)
+    d1 = self._clock()
+    self.metrics.record_batch(len(batch), render_s, phases=phases)
+    done = self._clock()
     for i, req in enumerate(batch):
       self.metrics.record_request(done - req.t_enqueue)
+      dspan = req.trace.add_span("dispatch", d0, d1, size=len(batch))
+      if recorder is not None:
+        recorder.replay(req.trace, parent=dspan)
       # Copy: out[i] is a view into the whole padded batch buffer; a
       # caller holding one image must not pin bucket x image bytes.
       req.future.set_result(out[i].copy())
+      req.trace.finish()
 
   def _loop(self) -> None:
     while True:

@@ -8,9 +8,18 @@ stdlib ``http.server`` front end:
   GET  /healthz -> {"status": "ok", "devices", "scenes", ...}
   GET  /stats   -> the metrics snapshot (latency percentiles, throughput,
                    batch-size histogram, queue depth, cache hit rate)
+  GET  /metrics -> Prometheus text exposition of the same counters
+                   (obs/prom.py; scrape with a stock Prometheus)
+  GET  /debug/traces  -> recent + slowest-N finished request traces
+  GET  /debug/profile?seconds=N -> capture a device profile of live
+                   traffic (409 while one is in flight; 503 unless the
+                   service was built with a profile dir)
   POST /render  -> body {"scene_id": str, "pose": [[...4x4...]]} ->
                    {"scene_id", "shape", "dtype", "image_b64"} — raw
                    little-endian f32 pixels, base64 (shape [H, W, 3]).
+                   Every response (success or error) carries an
+                   ``X-Trace-Id`` header; with tracing enabled the id
+                   resolves to a span tree at ``/debug/traces``.
 
 Scenes register host-side (``add_scene``) and bake lazily through the
 LRU cache on first request, so cache hit/miss accounting reflects real
@@ -36,6 +45,7 @@ import functools
 import json
 import math
 import threading
+import urllib.parse
 import zlib
 from concurrent.futures import TimeoutError as FuturesTimeoutError
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -44,6 +54,14 @@ import numpy as np
 
 from mpi_vision_tpu.core import camera
 from mpi_vision_tpu.core.camera import inv_depths
+from mpi_vision_tpu.obs import prom
+from mpi_vision_tpu.obs.profile import DeviceProfiler, ProfileBusyError
+from mpi_vision_tpu.obs.trace import (
+    NULL_TRACE,
+    NULL_TRACER,
+    Tracer,
+    new_trace_id,
+)
 from mpi_vision_tpu.serve import cache as cache_mod
 from mpi_vision_tpu.serve.engine import RenderEngine
 from mpi_vision_tpu.serve.metrics import ServeMetrics
@@ -98,8 +116,15 @@ class RenderService:
     cpu_fallback: degraded-mode routing while the breaker is open —
       "auto" builds a CPU fallback engine exactly when the primary is
       not already CPU (the serving analogue of ``bench.py --allow-cpu``),
-      "on" forces one, "off" fast-fails instead.
+      "off" fast-fails instead; "on" forces one.
     fallback_engine: explicit fallback engine override (tests).
+    tracer: request tracing (obs/trace.py). None — the default — is the
+      no-op tracer: requests run untraced at zero overhead. Pass a
+      ``Tracer()`` to record span trees (``/debug/traces``, X-Trace-Id).
+    profile_dir: enables ``/debug/profile`` captures into this directory
+      (``obs.profile.DeviceProfiler`` over ``jax.profiler``).
+    profiler: explicit profiler override (tests inject fake trace
+      contexts); wins over ``profile_dir``.
   """
 
   def __init__(self, cache_bytes: int = 2 << 30, max_batch: int = 8,
@@ -107,7 +132,9 @@ class RenderService:
                use_mesh: bool | None = None, max_queue: int = 1024,
                engine: RenderEngine | None = None,
                resilience: ResilienceConfig | None = ResilienceConfig(),
-               cpu_fallback: str = "auto", fallback_engine=None):
+               cpu_fallback: str = "auto", fallback_engine=None,
+               tracer: Tracer | None = None, profile_dir: str | None = None,
+               profiler: DeviceProfiler | None = None):
     if cpu_fallback not in ("auto", "on", "off"):
       raise ValueError(
           f"cpu_fallback must be auto/on/off, got {cpu_fallback!r}")
@@ -119,6 +146,11 @@ class RenderService:
         method=method, use_mesh=use_mesh)
     self.cache = cache_mod.SceneCache(byte_budget=cache_bytes)
     self.metrics = ServeMetrics()
+    self.tracer = tracer if tracer is not None else NULL_TRACER
+    if profiler is not None:
+      self.profiler = profiler
+    else:
+      self.profiler = (DeviceProfiler(profile_dir) if profile_dir else None)
     self.resilient = None if resilience is None else ResilientExecutor(
         resilience, metrics=self.metrics)
     self.fallback_engine = fallback_engine
@@ -173,6 +205,12 @@ class RenderService:
         entry = self._scene_data.get(scene_id)
       if entry is None:
         raise KeyError(f"unknown scene {scene_id!r}")
+      # Bake-fault hook (FaultyEngine.check_bake): inside the cache-miss
+      # path so injected bake failures fire exactly where a dead device
+      # would fail a real bake — never on cache hits.
+      check_bake = getattr(self.engine, "check_bake", None)
+      if check_bake is not None:
+        check_bake(scene_id)
       return cache_mod.bake_scene(scene_id, *entry)
 
     return self.cache.get_or_bake(scene_id, bake)
@@ -207,15 +245,40 @@ class RenderService:
 
   # -- request path -------------------------------------------------------
 
-  def render(self, scene_id: str, pose, timeout: float = 60.0) -> np.ndarray:
+  def render(self, scene_id: str, pose, timeout: float = 60.0,
+             trace=NULL_TRACE) -> np.ndarray:
     """Blocking render of one ``[4, 4]`` pose -> ``[H, W, 3]`` f32."""
-    return self.scheduler.render(scene_id, pose, timeout=timeout)
+    return self.scheduler.render(scene_id, pose, timeout=timeout,
+                                 trace=trace)
+
+  def render_traced(self, scene_id: str, pose, timeout: float = 60.0):
+    """``render`` plus a trace: returns ``(image, trace_id)``.
+
+    The trace id is "" when tracing is disabled (the HTTP layer still
+    stamps ``X-Trace-Id`` by generating its own in that case).
+    """
+    tr = self.tracer.start_trace("render", scene_id=str(scene_id))
+    return (self.scheduler.render(scene_id, pose, timeout=timeout,
+                                  trace=tr), tr.trace_id)
 
   def render_async(self, scene_id: str, pose):
     """Non-blocking render; returns a ``concurrent.futures.Future``."""
     return self.scheduler.submit(scene_id, pose)
 
   # -- observability ------------------------------------------------------
+
+  def metrics_text(self) -> str:
+    """The ``/metrics`` body: Prometheus text exposition of ``stats()``."""
+    return prom.render_serve_metrics(self.stats(),
+                                     self.metrics.latency_histogram())
+
+  def profile(self, seconds: float) -> dict:
+    """Capture a device profile of live traffic (``/debug/profile``)."""
+    if self.profiler is None:
+      raise RuntimeError(
+          "profiling disabled: construct RenderService with profile_dir "
+          "(serve --profile-dir)")
+    return self.profiler.capture(seconds)
 
   def stats(self) -> dict:
     out = self.metrics.snapshot(cache_stats=self.cache.stats())
@@ -318,22 +381,56 @@ class _Handler(BaseHTTPRequestHandler):
                      extra_headers=extra_headers)
 
   def do_GET(self):  # noqa: N802 - stdlib name
-    if self.path == "/healthz":
+    parsed = urllib.parse.urlsplit(self.path)
+    if parsed.path == "/healthz":
       health = self.service.healthz()
       # Status-code probes (k8s httpGet, LB health checks) never read the
       # body: unhealthy must be non-2xx. Degraded stays 200 — the service
       # is still answering (fallback or fast-fail), don't get it killed.
       self._send_json(health,
                       status=503 if health["status"] == "unhealthy" else 200)
-    elif self.path == "/stats":
+    elif parsed.path == "/stats":
       self._send_json(self.service.stats())
+    elif parsed.path == "/metrics":
+      self._send_bytes(
+          self.service.metrics_text().encode(),
+          content_type="text/plain; version=0.0.4; charset=utf-8")
+    elif parsed.path == "/debug/traces":
+      self._send_json(self.service.tracer.snapshot())
+    elif parsed.path == "/debug/profile":
+      self._do_profile(parsed.query)
     else:
       self._send_json({"error": f"unknown path {self.path}"}, status=404)
+
+  def _do_profile(self, query: str) -> None:
+    try:
+      seconds = float(
+          urllib.parse.parse_qs(query).get("seconds", ["1.0"])[0])
+    except ValueError:
+      self._send_json({"error": "seconds must be a number"}, status=400)
+      return
+    try:
+      # Blocks this handler thread for the capture window — render
+      # traffic keeps flowing on the other threads, which is the point:
+      # the profile shows live serving, not an idle device.
+      self._send_json(self.service.profile(seconds))
+    except ValueError as e:
+      self._send_json({"error": str(e)}, status=400)
+    except ProfileBusyError as e:
+      self._send_json({"error": str(e)}, status=409,
+                      extra_headers={"Retry-After": "1"})
+    except RuntimeError as e:  # profiling not configured
+      self._send_json({"error": str(e)}, status=503)
 
   def do_POST(self):  # noqa: N802 - stdlib name
     if self.path != "/render":
       self._send_json({"error": f"unknown path {self.path}"}, status=404)
       return
+    # Every /render response — success, 4xx, 5xx — carries X-Trace-Id so
+    # a client-reported failure is greppable in logs and /debug/traces.
+    # Bad requests never reach the tracer (nothing to trace); they get a
+    # fresh id generated here.
+    tid_hdr = {"X-Trace-Id": new_trace_id()}
     try:
       length = int(self.headers.get("Content-Length", "0"))
       if not 0 <= length <= _MAX_BODY_BYTES:
@@ -348,7 +445,8 @@ class _Handler(BaseHTTPRequestHandler):
       if pose.shape != (4, 4):
         raise ValueError(f"pose must be 4x4, got {pose.shape}")
     except (KeyError, TypeError, ValueError, json.JSONDecodeError) as e:
-      self._send_json({"error": f"bad request: {e}"}, status=400)
+      self._send_json({"error": f"bad request: {e}"}, status=400,
+                      extra_headers=tid_hdr)
       return
     except (BrokenPipeError, ConnectionResetError):
       # Client hung up mid-upload: nothing to respond to — count it like
@@ -357,13 +455,21 @@ class _Handler(BaseHTTPRequestHandler):
       self.service.metrics.record_client_disconnect()
       self.close_connection = True
       return
+    # The handler owns the trace (not render_traced) so error responses
+    # carry the same id the recorded trace has in /debug/traces.
+    tr = self.service.tracer.start_trace("render", scene_id=str(scene_id),
+                                         http=True)
+    if tr.trace_id:
+      tid_hdr = {"X-Trace-Id": tr.trace_id}
     try:
-      img = self.service.render(scene_id, pose)
+      img = self.service.render(scene_id, pose, trace=tr)
     except KeyError as e:
-      self._send_json({"error": str(e)}, status=404)
+      self._send_json({"error": str(e)}, status=404,
+                      extra_headers=tid_hdr)
       return
     except QueueFullError as e:
-      self._send_json({"error": str(e)}, status=503)
+      self._send_json({"error": str(e)}, status=503,
+                      extra_headers=tid_hdr)
       return
     except CircuitOpenError as e:
       # Fast-fail while the device is known-bad: tell the client exactly
@@ -371,23 +477,27 @@ class _Handler(BaseHTTPRequestHandler):
       retry_after = max(1, math.ceil(e.retry_after_s))
       self._send_json({"error": str(e), "retry_after_s": e.retry_after_s},
                       status=503,
-                      extra_headers={"Retry-After": str(retry_after)})
+                      extra_headers={"Retry-After": str(retry_after),
+                                     **tid_hdr})
       return
     except TransientDeviceError as e:
       if getattr(e, "deadline_capped", False):
         # The DEADLINE bounded this failure, not the device: overload is
         # a 504, telling the client the device is flaky would misdirect.
         self._send_json({"error": f"request deadline exceeded: {e}"},
-                        status=504)
+                        status=504, extra_headers=tid_hdr)
       else:
         self._send_json({"error": f"transient device failure: {e}"},
-                        status=503, extra_headers={"Retry-After": "1"})
+                        status=503,
+                        extra_headers={"Retry-After": "1", **tid_hdr})
       return
     except FuturesTimeoutError:
-      self._send_json({"error": "render timed out in queue"}, status=504)
+      self._send_json({"error": "render timed out in queue"}, status=504,
+                      extra_headers=tid_hdr)
       return
     except Exception as e:  # noqa: BLE001 - surfaced to the client
-      self._send_json({"error": f"render failed: {e}"}, status=500)
+      self._send_json({"error": f"render failed: {e}"}, status=500,
+                      extra_headers=tid_hdr)
       return
     img = np.ascontiguousarray(img, np.dtype("<f4"))
     if "application/octet-stream" in self.headers.get("Accept", ""):
@@ -399,6 +509,7 @@ class _Handler(BaseHTTPRequestHandler):
               "X-Image-Shape": ",".join(str(d) for d in img.shape),
               "X-Image-Dtype": "<f4",
               "X-Scene-Id": str(scene_id),
+              **tid_hdr,
           })
       return
     self._send_json({
@@ -406,7 +517,7 @@ class _Handler(BaseHTTPRequestHandler):
         "shape": list(img.shape),
         "dtype": "<f4",
         "image_b64": base64.b64encode(img.tobytes()).decode(),
-    })
+    }, extra_headers=tid_hdr)
 
 
 def make_http_server(service: RenderService, host: str = "127.0.0.1",

@@ -1,0 +1,51 @@
+"""Lint: serve/ and obs/ read time only through injectable clocks.
+
+Every latency, deadline, and span edge in the serving stack must come
+from a clock the caller can inject — that is what makes the breaker,
+scheduler, tracer, and metrics deterministic in tier-1 (fake clocks)
+and keeps all timestamps on ONE base in production. A bare
+``time.time()`` / ``time.monotonic()`` / ``time.perf_counter()`` call
+creeping into a hot path silently breaks both, so this test greps the
+source.
+
+Designated defaults stay legal: ``clock=time.monotonic`` in a signature
+or ``clock if clock else time.monotonic`` pass the *function object* —
+only call sites (with parentheses) are flagged. ``time.sleep`` is a
+different contract (injected separately where determinism needs it) and
+is not a clock read.
+"""
+
+import pathlib
+import re
+
+import mpi_vision_tpu.obs
+import mpi_vision_tpu.serve
+
+_CLOCK_CALL = re.compile(r"\btime\.(time|monotonic|perf_counter)\s*\(")
+
+
+def _package_sources(pkg):
+  root = pathlib.Path(pkg.__file__).parent
+  return sorted(root.glob("*.py"))
+
+
+def test_no_bare_clock_calls_in_serve_and_obs():
+  offenders = []
+  for pkg in (mpi_vision_tpu.serve, mpi_vision_tpu.obs):
+    for path in _package_sources(pkg):
+      for lineno, line in enumerate(path.read_text().splitlines(), 1):
+        code = line.split("#", 1)[0]
+        if _CLOCK_CALL.search(code):
+          offenders.append(f"{path.name}:{lineno}: {line.strip()}")
+  assert not offenders, (
+      "bare clock calls in serve/obs hot paths (inject a clock instead; "
+      "attribute references like clock=time.monotonic are fine):\n"
+      + "\n".join(offenders))
+
+
+def test_lint_actually_catches_calls():
+  # The regex must flag real call sites, not just pass everything.
+  assert _CLOCK_CALL.search("t0 = time.monotonic()")
+  assert _CLOCK_CALL.search("x = time.perf_counter ()")
+  assert not _CLOCK_CALL.search("clock=time.monotonic")
+  assert not _CLOCK_CALL.search("sleep = time.sleep")

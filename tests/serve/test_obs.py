@@ -1,0 +1,583 @@
+"""Observability layer tests: tracer, Prometheus exposition, profiling.
+
+Three acceptance pins live here: (1) every ``/render`` response carries
+``X-Trace-Id`` and its span tree covers queue-wait, batch-assembly,
+dispatch (with retry attempts as children), and readback; (2)
+``/metrics`` parses with a minimal text-format parser, metric
+names/types are pinned, and counter values agree with the ``/stats``
+snapshot after a deterministic in-process load; (3) a failed cache bake
+still produces a complete span tree with the error on the bake span.
+"""
+
+import contextlib
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mpi_vision_tpu.obs import (
+    DeviceProfiler,
+    ProfileBusyError,
+    parse_metrics_text,
+    render_serve_metrics,
+)
+from mpi_vision_tpu.obs.trace import NULL_TRACE, SpanRecorder, Tracer
+from mpi_vision_tpu.serve import (
+    Fault,
+    FaultyEngine,
+    RenderService,
+    ResilienceConfig,
+    make_http_server,
+)
+from mpi_vision_tpu.serve.engine import RenderEngine
+from mpi_vision_tpu.serve.metrics import LATENCY_BUCKETS_S, ServeMetrics
+
+H = W = 16
+P = 4
+
+
+class FakeClock:
+  def __init__(self, t=0.0):
+    self.t = t
+
+  def __call__(self):
+    return self.t
+
+  def advance(self, dt):
+    self.t += dt
+    return self.t
+
+
+def _pose(tx=0.0):
+  pose = np.eye(4, dtype=np.float32)
+  pose[0, 3] = tx
+  return pose
+
+
+# --- tracer --------------------------------------------------------------
+
+
+def test_trace_span_tree_parents_and_relative_times():
+  clock = FakeClock()
+  tracer = Tracer(clock=clock)
+  tr = tracer.start_trace("render", scene_id="s0")
+  q = tr.start_span("queue_wait")
+  clock.advance(0.010)
+  tr.end_span(q)
+  d = tr.add_span("dispatch", 0.010, 0.030)
+  tr.add_span("attempt", 0.010, 0.030, parent=d, attempt=0)
+  clock.advance(0.020)
+  tr.finish()
+  assert len(tr.trace_id) == 16
+  out = tr.to_dict()
+  assert out["duration_ms"] == pytest.approx(30.0)
+  by_name = {s["name"]: s for s in out["spans"]}
+  assert by_name["queue_wait"]["t0_ms"] == pytest.approx(0.0)
+  assert by_name["queue_wait"]["duration_ms"] == pytest.approx(10.0)
+  assert by_name["attempt"]["parent"] == by_name["dispatch"]["id"]
+  assert by_name["attempt"]["attrs"]["attempt"] == 0
+  assert json.loads(json.dumps(out)) == out  # JSON-clean
+
+
+def test_tracer_disabled_is_the_noop_singleton():
+  tracer = Tracer(enabled=False)
+  tr = tracer.start_trace("render")
+  assert tr is NULL_TRACE and tr.trace_id == ""
+  assert tr.start_span("x") == 0
+  tr.end_span(0)
+  tr.finish()
+  snap = tracer.snapshot()
+  assert snap["started"] == 0 and snap["finished"] == 0
+  assert snap["recent"] == [] and snap["slowest"] == []
+
+
+def test_trace_finish_is_idempotent_first_wins():
+  clock = FakeClock()
+  tracer = Tracer(clock=clock)
+  tr = tracer.start_trace("render")
+  clock.advance(1.0)
+  tr.finish(error="first")
+  clock.advance(9.0)
+  tr.finish()  # the late dispatcher resolution must not re-open it
+  assert tracer.finished == 1
+  rec = tracer.snapshot()["recent"][0]
+  assert rec["error"] == "first"
+  assert rec["duration_ms"] == pytest.approx(1000.0)
+
+
+def test_tracer_ring_bounded_and_slowest_retained_past_eviction():
+  clock = FakeClock()
+  tracer = Tracer(clock=clock, ring=4, slow_keep=2)
+  durations = [0.01, 0.5, 0.02, 0.03, 0.9, 0.04, 0.05, 0.06]
+  for i, dur in enumerate(durations):
+    tr = tracer.start_trace("render", idx=i)
+    clock.advance(dur)
+    tr.finish()
+  snap = tracer.snapshot()
+  assert len(snap["recent"]) == 4  # ring bound
+  recent_ids = {t["attrs"]["idx"] for t in snap["recent"]}
+  assert recent_ids == {4, 5, 6, 7}
+  # The two slowest (0.9s and 0.5s) survive; 0.5s was evicted from the
+  # ring long ago — exemplar retention is the point.
+  slow_ms = [t["duration_ms"] for t in snap["slowest"]]
+  assert slow_ms == [pytest.approx(900.0), pytest.approx(500.0)]
+
+
+def test_tracer_emit_structured_json_lines():
+  lines = []
+  clock = FakeClock()
+  tracer = Tracer(clock=clock, emit=lines.append)
+  tr = tracer.start_trace("render", scene_id="s0")
+  s = tr.start_span("queue_wait")
+  clock.advance(0.25)
+  tr.end_span(s)
+  tr.finish()
+  assert len(lines) == 1
+  rec = json.loads(lines[0])
+  assert rec["event"] == "trace" and rec["trace_id"] == tr.trace_id
+  assert rec["spans"][0]["name"] == "queue_wait"
+
+
+def test_tracer_emit_failure_never_propagates_to_finish():
+  """finish() runs on the scheduler's only dispatcher thread: a dying
+  emit sink (closed stderr pipe) must drop lines, not kill the thread."""
+  def bad_emit(line):
+    raise BrokenPipeError("log consumer went away")
+
+  clock = FakeClock()
+  tracer = Tracer(clock=clock, emit=bad_emit)
+  tr = tracer.start_trace("render")
+  clock.advance(0.01)
+  tr.finish()  # must not raise
+  snap = tracer.snapshot()
+  assert snap["finished"] == 1 and snap["emit_errors"] == 1
+  assert len(snap["recent"]) == 1  # the trace itself is still recorded
+
+
+def test_tracer_snapshot_recent_zero_returns_none():
+  clock = FakeClock()
+  tracer = Tracer(clock=clock)
+  for _ in range(3):
+    tracer.start_trace("render").finish()
+  snap = tracer.snapshot(recent=0)
+  assert snap["recent"] == [] and snap["finished"] == 3
+  assert len(tracer.snapshot(recent=2)["recent"]) == 2
+
+
+def test_span_recorder_zombie_attempt_parents_to_its_own_group():
+  """An attempt thread abandoned by the watchdog records with the parent
+  captured at ITS entry — late spans land under the dead attempt, never
+  under whichever attempt is live when they arrive."""
+  clock = FakeClock()
+  rec = SpanRecorder(clock)
+  a0 = rec.begin("attempt", attempt=0)
+  zombie_parent = rec.current_parent()  # what _span_render captures
+  rec.end(a0, error="watchdog abandoned")
+  a1 = rec.begin("attempt", attempt=1)
+  # The zombie finishes now, while attempt 1 is the open group:
+  rec.record("bake", 0.0, 0.01, parent=zombie_parent, scene_id="s0")
+  rec.end(a1)
+  assert rec.records[2]["parent"] == a0  # dead attempt, not a1
+  tracer = Tracer(clock=clock)
+  tr = tracer.start_trace("render")
+  root = tr.add_span("dispatch", 0.0, 0.02)
+  rec.replay(tr, parent=root)
+  tr.finish()
+  spans = tr.to_dict()["spans"]
+  by_id = {s["id"]: s for s in spans}
+  bake = next(s for s in spans if s["name"] == "bake")
+  assert by_id[bake["parent"]]["attrs"]["attempt"] == 0
+
+
+def test_span_recorder_groups_and_replay():
+  clock = FakeClock()
+  rec = SpanRecorder(clock)
+  a = rec.begin("attempt", attempt=0)
+  clock.advance(0.01)
+  rec.record("bake", 0.0, 0.01, scene_id="s0")
+  rec.end(a, error="boom")
+  b = rec.begin("attempt", attempt=1)
+  clock.advance(0.01)
+  rec.end(b)
+  tracer = Tracer(clock=clock)
+  tr = tracer.start_trace("render")
+  root = tr.add_span("dispatch", 0.0, 0.02)
+  rec.replay(tr, parent=root)
+  tr.finish()
+  spans = tr.to_dict()["spans"]
+  by_id = {s["id"]: s for s in spans}
+  attempts = [s for s in spans if s["name"] == "attempt"]
+  assert [a["attrs"]["attempt"] for a in attempts] == [0, 1]
+  assert all(by_id[a["parent"]]["name"] == "dispatch" for a in attempts)
+  bake = next(s for s in spans if s["name"] == "bake")
+  assert by_id[bake["parent"]]["attrs"]["attempt"] == 0
+  assert attempts[0]["error"] == "boom" and "error" not in attempts[1]
+
+
+# --- Prometheus exposition ----------------------------------------------
+
+
+def _prom_families(svc):
+  text = svc.metrics_text()
+  return text, parse_metrics_text(text)
+
+
+PINNED_TYPES = {
+    "mpi_serve_uptime_seconds": "gauge",
+    "mpi_serve_requests_total": "counter",
+    "mpi_serve_batches_total": "counter",
+    "mpi_serve_device_render_seconds_total": "counter",
+    "mpi_serve_device_phase_seconds_total": "counter",
+    "mpi_serve_errors_total": "counter",
+    "mpi_serve_rejected_total": "counter",
+    "mpi_serve_retries_total": "counter",
+    "mpi_serve_watchdog_trips_total": "counter",
+    "mpi_serve_fallback_renders_total": "counter",
+    "mpi_serve_breaker_opens_total": "counter",
+    "mpi_serve_breaker_fastfails_total": "counter",
+    "mpi_serve_client_disconnects_total": "counter",
+    "mpi_serve_queue_depth": "gauge",
+    "mpi_serve_request_latency_seconds": "histogram",
+    "mpi_serve_batch_size": "histogram",
+    "mpi_serve_cache_hits_total": "counter",
+    "mpi_serve_cache_misses_total": "counter",
+    "mpi_serve_cache_evictions_total": "counter",
+    "mpi_serve_cache_bytes": "gauge",
+    "mpi_serve_cache_scenes": "gauge",
+    "mpi_serve_breaker_state": "gauge",
+    "mpi_serve_breaker_consecutive_failures": "gauge",
+}
+
+
+@pytest.fixture(scope="module")
+def loaded_svc():
+  """A service that has served a deterministic in-process load."""
+  svc = RenderService(max_batch=4, max_wait_ms=50.0, use_mesh=False)
+  svc.add_synthetic_scenes(2, height=H, width=W, planes=P)
+  futs = [svc.render_async("scene_000", _pose(0.01 * i)) for i in range(3)]
+  for f in futs:
+    f.result(120)
+  svc.render("scene_001", _pose())
+  with pytest.raises(KeyError):
+    svc.render("nope", _pose())
+  yield svc
+  svc.close()
+
+
+def test_metrics_names_types_pinned_and_agree_with_stats(loaded_svc):
+  text, families = _prom_families(loaded_svc)
+  stats = loaded_svc.stats()
+  for name, mtype in PINNED_TYPES.items():
+    assert name in families, f"missing {name}\n{text}"
+    assert families[name]["type"] == mtype, name
+    assert families[name]["help"], name
+  def val(family, sample=None, labels=()):
+    return families[family]["samples"][(sample or family, tuple(labels))]
+  assert val("mpi_serve_requests_total") == stats["requests"]
+  assert val("mpi_serve_batches_total") == stats["batches"]
+  assert val("mpi_serve_rejected_total") == stats["rejected"]
+  assert val("mpi_serve_queue_depth") == stats["queue_depth"]
+  for cls in ("transient", "permanent", "deadline"):
+    assert val("mpi_serve_errors_total", labels=[("class", cls)]) \
+        == stats["errors"][cls]
+  for key in ("retries", "watchdog_trips", "fallback_renders",
+              "breaker_opens", "breaker_fastfails", "client_disconnects"):
+    assert val(f"mpi_serve_{key}_total") == stats["resilience"][key]
+  for stat_key, fam in (("hits", "mpi_serve_cache_hits_total"),
+                        ("misses", "mpi_serve_cache_misses_total"),
+                        ("evictions", "mpi_serve_cache_evictions_total"),
+                        ("bytes", "mpi_serve_cache_bytes"),
+                        ("scenes", "mpi_serve_cache_scenes")):
+    assert val(fam) == stats["cache"][stat_key]
+  assert val("mpi_serve_breaker_state",
+             labels=[("state", stats["breaker"]["state"])]) == 1
+  assert sum(v for (n, _), v in
+             families["mpi_serve_breaker_state"]["samples"].items()) == 1
+
+
+def test_metrics_latency_histogram_cumulative(loaded_svc):
+  _, families = _prom_families(loaded_svc)
+  stats = loaded_svc.stats()
+  hist = families["mpi_serve_request_latency_seconds"]["samples"]
+  buckets = sorted(
+      ((float(dict(labels)["le"]), v)
+       for (name, labels) in hist
+       if name.endswith("_bucket")
+       for v in [hist[(name, labels)]]),
+      key=lambda x: x[0])
+  bounds = [b for b, _ in buckets]
+  assert bounds == sorted([*LATENCY_BUCKETS_S, float("inf")])
+  counts = [c for _, c in buckets]
+  assert counts == sorted(counts)  # cumulative: monotone non-decreasing
+  count = hist[("mpi_serve_request_latency_seconds_count", ())]
+  assert counts[-1] == count == stats["requests"]
+  total_s = hist[("mpi_serve_request_latency_seconds_sum", ())]
+  assert total_s >= 0
+
+
+def test_metrics_batch_size_histogram_agrees(loaded_svc):
+  _, families = _prom_families(loaded_svc)
+  stats = loaded_svc.stats()
+  hist = families["mpi_serve_batch_size"]["samples"]
+  assert hist[("mpi_serve_batch_size_count", ())] == stats["batches"]
+  assert hist[("mpi_serve_batch_size_sum", ())] == stats["requests"]
+
+
+def test_metrics_device_phases_sum_close_to_render_seconds(loaded_svc):
+  stats = loaded_svc.stats()
+  phases = stats["device_phase_seconds"]
+  assert set(phases) == {"h2d", "compute", "readback"}
+  total = sum(phases.values())
+  assert total == pytest.approx(stats["device_render_seconds"], abs=0.05)
+  assert phases["compute"] > 0
+
+
+def test_prom_text_renders_without_breaker():
+  # resilience=None services have no breaker family — the exposition
+  # must degrade, not KeyError.
+  m = ServeMetrics()
+  text = render_serve_metrics(m.snapshot(cache_stats=None),
+                              m.latency_histogram())
+  families = parse_metrics_text(text)
+  assert "mpi_serve_breaker_state" not in families
+  assert "mpi_serve_requests_total" in families
+
+
+# --- HTTP: X-Trace-Id, /metrics, /debug/traces, /debug/profile ----------
+
+
+class _FakeProfilerCtx:
+  """Stands in for jax.profiler.trace: records entry, optionally blocks."""
+
+  def __init__(self):
+    self.dirs = []
+    self.entered = threading.Event()
+    self.release = threading.Event()
+    self.block = False
+
+  @contextlib.contextmanager
+  def __call__(self, logdir):
+    self.dirs.append(logdir)
+    self.entered.set()
+    if self.block:
+      self.release.wait(30)
+    yield
+
+
+@pytest.fixture(scope="module")
+def traced_svc(tmp_path_factory):
+  profiler_ctx = _FakeProfilerCtx()
+  profiler = DeviceProfiler(
+      str(tmp_path_factory.mktemp("prof")), trace_ctx=profiler_ctx,
+      sleep=lambda s: None)
+  svc = RenderService(max_batch=4, max_wait_ms=20.0, use_mesh=False,
+                      tracer=Tracer(), profiler=profiler)
+  svc._profiler_ctx = profiler_ctx  # test-side handle
+  svc.add_synthetic_scenes(1, height=H, width=W, planes=P)
+  httpd = make_http_server(svc, port=0)
+  thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+  thread.start()
+  yield svc, f"http://127.0.0.1:{httpd.server_address[1]}"
+  httpd.shutdown()
+  svc.close()
+
+
+def test_http_render_carries_trace_id_and_debug_traces(traced_svc):
+  svc, base = traced_svc
+  body = json.dumps({"scene_id": "scene_000",
+                     "pose": _pose(0.01).tolist()}).encode()
+  req = urllib.request.Request(base + "/render", data=body)
+  with urllib.request.urlopen(req, timeout=120) as resp:
+    tid = resp.headers["X-Trace-Id"]
+  assert tid and len(tid) == 16
+  traces = json.loads(urllib.request.urlopen(
+      base + "/debug/traces", timeout=60).read())
+  assert traces["enabled"] is True and traces["finished"] >= 1
+  mine = [t for t in traces["recent"] if t["trace_id"] == tid]
+  assert len(mine) == 1
+  names = {s["name"] for s in mine[0]["spans"]}
+  # The acceptance span set: queue-wait, batch-assembly, dispatch with
+  # attempt children, readback (+ the bake and device sub-phases).
+  assert {"queue_wait", "batch_assembly", "dispatch", "attempt",
+          "bake", "h2d", "compute", "readback"} <= names
+  by_id = {s["id"]: s for s in mine[0]["spans"]}
+  attempt = next(s for s in mine[0]["spans"] if s["name"] == "attempt")
+  assert by_id[attempt["parent"]]["name"] == "dispatch"
+
+
+def test_http_error_response_still_carries_trace_id(traced_svc):
+  svc, base = traced_svc
+  cases = [
+      ({"scene_id": "no_such", "pose": _pose().tolist()}, 404),
+      ({"scene_id": "scene_000"}, 400),
+  ]
+  for payload, want in cases:
+    req = urllib.request.Request(base + "/render",
+                                 data=json.dumps(payload).encode())
+    with pytest.raises(urllib.error.HTTPError) as err:
+      urllib.request.urlopen(req, timeout=60)
+    assert err.value.code == want
+    assert err.value.headers["X-Trace-Id"], payload
+  # The 404's trace is recorded with its error.
+  snap = svc.tracer.snapshot()
+  errored = [t for t in snap["recent"] if t["error"]]
+  assert any("no_such" in (t["error"] or "") for t in errored)
+
+
+def test_http_metrics_endpoint(traced_svc):
+  svc, base = traced_svc
+  with urllib.request.urlopen(base + "/metrics", timeout=60) as resp:
+    assert resp.headers["Content-Type"].startswith("text/plain")
+    text = resp.read().decode()
+  families = parse_metrics_text(text)
+  assert families["mpi_serve_requests_total"]["type"] == "counter"
+  stats = svc.stats()
+  assert (families["mpi_serve_requests_total"]["samples"][
+      ("mpi_serve_requests_total", ())] == stats["requests"])
+
+
+def test_http_profile_capture_busy_and_validation(traced_svc):
+  svc, base = traced_svc
+  ctx = svc._profiler_ctx
+  out = json.loads(urllib.request.urlopen(
+      base + "/debug/profile?seconds=0.05", timeout=60).read())
+  assert out["seconds"] == 0.05 and out["logdir"] in ctx.dirs
+  # Concurrent capture -> 409 for the second caller.
+  ctx.block = True
+  ctx.entered.clear()
+  errs = {}
+
+  def first():
+    try:
+      urllib.request.urlopen(base + "/debug/profile?seconds=0.05",
+                             timeout=60).read()
+    except urllib.error.HTTPError as e:  # pragma: no cover - shouldn't
+      errs["first"] = e.code
+
+  t = threading.Thread(target=first, daemon=True)
+  t.start()
+  assert ctx.entered.wait(30)
+  with pytest.raises(urllib.error.HTTPError) as err:
+    urllib.request.urlopen(base + "/debug/profile?seconds=0.05",
+                           timeout=60)
+  assert err.value.code == 409
+  ctx.release.set()
+  t.join(30)
+  ctx.block = False
+  assert "first" not in errs
+  # Validation: non-numeric and out-of-range seconds are 400s.
+  for query in ("seconds=nope", "seconds=-1", "seconds=1e9"):
+    with pytest.raises(urllib.error.HTTPError) as err:
+      urllib.request.urlopen(base + f"/debug/profile?{query}", timeout=60)
+    assert err.value.code == 400, query
+
+
+def test_http_profile_disabled_is_503():
+  svc = RenderService(max_batch=2, max_wait_ms=1.0, use_mesh=False)
+  svc.add_synthetic_scenes(1, height=H, width=W, planes=P)
+  httpd = make_http_server(svc, port=0)
+  thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+  thread.start()
+  base = f"http://127.0.0.1:{httpd.server_address[1]}"
+  try:
+    with pytest.raises(urllib.error.HTTPError) as err:
+      urllib.request.urlopen(base + "/debug/profile?seconds=1", timeout=60)
+    assert err.value.code == 503
+    # Tracing disabled: /debug/traces still answers (empty), and renders
+    # still get a generated X-Trace-Id.
+    traces = json.loads(urllib.request.urlopen(
+        base + "/debug/traces", timeout=60).read())
+    assert traces["enabled"] is False and traces["recent"] == []
+    body = json.dumps({"scene_id": "scene_000",
+                       "pose": _pose().tolist()}).encode()
+    req = urllib.request.Request(base + "/render", data=body)
+    with urllib.request.urlopen(req, timeout=120) as resp:
+      assert resp.headers["X-Trace-Id"]
+  finally:
+    httpd.shutdown()
+    svc.close()
+
+
+def test_profiler_serializes_captures_directly(tmp_path):
+  ctx = _FakeProfilerCtx()
+  prof = DeviceProfiler(str(tmp_path), trace_ctx=ctx,
+                        sleep=lambda s: None)
+  with pytest.raises(ValueError):
+    prof.capture(0)
+  with pytest.raises(ValueError):
+    prof.capture(301)
+  prof._lock.acquire()
+  try:
+    assert prof.busy
+    with pytest.raises(ProfileBusyError):
+      prof.capture(0.01)
+  finally:
+    prof._lock.release()
+  out = prof.capture(0.01)
+  assert out["capture"] == 1 and not prof.busy
+
+
+# --- bake faults produce complete span trees -----------------------------
+
+
+def test_transient_bake_fault_retries_and_records_bake_error():
+  engine = FaultyEngine(RenderEngine(use_mesh=False))
+  tracer = Tracer()
+  svc = RenderService(
+      max_batch=2, max_wait_ms=1.0, engine=engine, tracer=tracer,
+      resilience=ResilienceConfig(max_retries=2, backoff_base_s=0.001,
+                                  backoff_max_s=0.002),
+      cpu_fallback="off")
+  svc.add_synthetic_scenes(1, height=H, width=W, planes=P)
+  try:
+    engine.fail_next_bake(1)  # cold cache: first bake attempt dies
+    img, tid = svc.render_traced("scene_000", _pose(), timeout=120)
+    assert img.shape == (H, W, 3)
+    assert engine.injected["bake"] == 1
+    assert svc.stats()["resilience"]["retries"] >= 1
+    rec = next(t for t in tracer.snapshot()["recent"]
+               if t["trace_id"] == tid)
+    assert rec["error"] is None  # the request itself succeeded
+    bakes = [s for s in rec["spans"] if s["name"] == "bake"]
+    assert len(bakes) == 2  # failed bake + the retry's clean bake
+    assert "injected bake fault" in bakes[0]["error"]
+    assert "error" not in bakes[1]
+    attempts = [s for s in rec["spans"] if s["name"] == "attempt"]
+    assert len(attempts) == 2 and attempts[0]["error"]
+    by_id = {s["id"]: s for s in rec["spans"]}
+    # Each bake nests under its own attempt; the tree stays complete.
+    assert [by_id[b["parent"]]["name"] for b in bakes] == \
+        ["attempt", "attempt"]
+    names = {s["name"] for s in rec["spans"]}
+    assert {"queue_wait", "batch_assembly", "dispatch", "readback"} <= names
+  finally:
+    svc.close()
+
+
+def test_permanent_bake_fault_fails_request_with_bake_span_error():
+  engine = FaultyEngine(RenderEngine(use_mesh=False))
+  tracer = Tracer()
+  svc = RenderService(
+      max_batch=2, max_wait_ms=1.0, engine=engine, tracer=tracer,
+      resilience=ResilienceConfig(max_retries=2, backoff_base_s=0.001),
+      cpu_fallback="off")
+  svc.add_synthetic_scenes(1, height=H, width=W, planes=P)
+  try:
+    engine.inject_bake(Fault("error", transient=False,
+                             message="corrupt MPI payload"))
+    with pytest.raises(ValueError, match="corrupt MPI payload"):
+      svc.render_traced("scene_000", _pose(), timeout=120)
+    assert svc.stats()["resilience"]["retries"] == 0  # permanent: no retry
+    rec = tracer.snapshot()["recent"][-1]
+    assert "corrupt MPI payload" in rec["error"]
+    bake = next(s for s in rec["spans"] if s["name"] == "bake")
+    assert "corrupt MPI payload" in bake["error"]
+    # A permanent bake failure must not poison the cache: the next
+    # request bakes cleanly.
+    img = svc.render("scene_000", _pose(), timeout=120)
+    assert img.shape == (H, W, 3)
+  finally:
+    svc.close()

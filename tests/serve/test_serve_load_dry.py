@@ -46,6 +46,27 @@ def test_serve_load_dry_emits_headline_json():
   assert 0 <= out["cache_hit_rate"] <= 1
   assert out["requests"] >= out["batches"] >= 1
   assert out["chaos"] is False
+  # Outage accounting rides EVERY run (trend across BENCH rounds): the
+  # error/resilience counters and breaker state, zeros and all.
+  assert set(out["errors"]) == {"transient", "permanent", "deadline"}
+  assert out["rejected"] == 0
+  assert set(out["resilience"]) >= {"retries", "watchdog_trips",
+                                    "fallback_renders", "breaker_opens"}
+  assert out["breaker_state"] == "closed"
+
+
+def test_serve_load_trace_dry_smoke():
+  """The trace-enabled smoke: closed-loop traffic under a live Tracer
+  must finish, and the slowest-exemplar span trees must cover the whole
+  request path (the acceptance span set + attempt children)."""
+  out = _run_dry(["--trace"])
+  assert out["metric"] == "serve_load" and out["dry"] is True
+  assert out["renders_per_sec"] > 0
+  trace = out["trace"]
+  assert trace["finished"] >= out["requests"]
+  assert trace["slowest_ms"] and trace["slowest_ms"] > 0
+  assert {"queue_wait", "batch_assembly", "dispatch", "attempt", "bake",
+          "h2d", "compute", "readback"} <= set(trace["span_names"])
 
 
 def test_serve_load_chaos_dry_smoke():
@@ -63,3 +84,4 @@ def test_serve_load_chaos_dry_smoke():
   assert out["resilience"]["retries"] > 0
   assert out["breaker_state"] in ("closed", "open", "half_open")
   assert set(out["errors"]) == {"transient", "permanent", "deadline"}
+  assert out["chaos_failed_requests"] is not None

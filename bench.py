@@ -3,7 +3,11 @@
 Prints ONE JSON line on stdout (diagnostics go to stderr) with fields
 {"metric", "value", "unit", "vs_baseline", "separable_fps", "rotation_fps",
 "rot10_fps", "banded_fps", "banded_deg", "xla_fps", "eager_separable_fps",
-"eager_rotation_fps"}. ``value`` is the WORST of the two real novel-view
+"eager_rotation_fps"}. When no TPU is reachable the run still emits its
+one JSON line (planning-only, device-tagged "cpu", null FPS) — the CPU
+fallback is the DEFAULT since a tunnel outage cost round 5 its record;
+``--require-tpu`` (env BENCH_REQUIRE_TPU=1) opts back into the hard rc=1
+failure. ``value`` is the WORST of the two real novel-view
 cases — separable (truck + dolly) and rotation (1-degree pan, the tiled
 general kernel) — because the renderer must treat arbitrary poses
 uniformly, as the reference does (utils.py:267-294). ``vs_baseline`` is
@@ -150,7 +154,6 @@ def _acquire_device(allow_cpu: bool):
 
     env = hardened_env(1)
     env["_BENCH_CPU_REEXEC"] = "1"
-    env["BENCH_ALLOW_CPU"] = "1"
     os.execve(sys.executable,
               [sys.executable, os.path.abspath(__file__)] + sys.argv[1:],
               env)
@@ -158,14 +161,30 @@ def _acquire_device(allow_cpu: bool):
 
 def main(argv=None) -> None:
   ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+  ap.add_argument("--require-tpu", action="store_true",
+                  help="hard-fail (rc=1, no JSON) when no TPU is "
+                       "reachable instead of the default planning-only "
+                       "CPU fallback line (also env BENCH_REQUIRE_TPU=1)")
   ap.add_argument("--allow-cpu", action="store_true",
-                  help="when no TPU is reachable, still emit the single "
-                       "JSON line (device-tagged 'cpu', planning-only, "
-                       "null FPS) instead of exiting 1 with no JSON "
-                       "(also env BENCH_ALLOW_CPU=1)")
+                  help="deprecated: the CPU fallback is now the default "
+                       "(BENCH_r05 lost a round to rc=1 with no JSON "
+                       "when the tunnel dropped); kept for old harnesses")
   args = ap.parse_args(argv)
-  allow_cpu = args.allow_cpu or (
+  # CPU fallback is the DEFAULT: a tunnel outage must still produce the
+  # round's one JSON line (device-tagged 'cpu', null FPS). --require-tpu
+  # opts back into the old hard failure for runs where a silent CPU
+  # fallback would waste a reserved TPU window.
+  # An explicit --allow-cpu — or its PR-1 env spelling BENCH_ALLOW_CPU=1,
+  # which old harnesses still export — beats an inherited
+  # BENCH_REQUIRE_TPU env var (a reserved-window wrapper's export must
+  # not turn an operator's explicit fallback request into the
+  # rc=1-no-JSON lost round).
+  allow_cpu_req = args.allow_cpu or (
       os.environ.get("BENCH_ALLOW_CPU", "") not in ("", "0", "false"))
+  require_tpu = args.require_tpu or (
+      not allow_cpu_req
+      and os.environ.get("BENCH_REQUIRE_TPU", "") not in ("", "0", "false"))
+  allow_cpu = not require_tpu
   dry = os.environ.get("BENCH_DRY", "") not in ("", "0", "false")
   dev = _acquire_device(allow_cpu)
   print(f"bench: backend={jax.default_backend()} device={dev.device_kind}",
@@ -176,8 +195,8 @@ def main(argv=None) -> None:
   cpu_fallback = jax.default_backend() == "cpu" and not dry
   if cpu_fallback and not allow_cpu:
     raise SystemExit(
-        "bench: CPU backend and no --allow-cpu/BENCH_ALLOW_CPU=1 — "
-        "refusing to time 1080p kernels in interpret mode (pass the flag "
+        "bench: --require-tpu set but only the CPU backend is available — "
+        "refusing to time 1080p kernels in interpret mode (drop the flag "
         "for the planning-only fallback JSON line)")
   planes, homs, homs_rot, homs_rot10, pose, depths, intrinsics = (
       _make_inputs())

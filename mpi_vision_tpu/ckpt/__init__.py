@@ -1,0 +1,57 @@
+"""Checkpoint lifecycle: atomic manifest'd stores, guards, fault injection.
+
+The training side of the resilience story (``serve/`` owns the serving
+side): the reference trains open-loop (``Learner.fit(20, lr=2e-4)``, no
+checkpointing at all), yet the bench history shows the device vanishing
+mid-run (BENCH_r05: "TPU tunnel down"). This package treats a trained
+artifact the way ``serve/`` treats a request — something that must
+survive crashes, corruption, and preemption:
+
+  * ``store``       — ``CheckpointStore``: write-tmp -> fsync -> rename
+    atomic saves, per-array content hashes in a JSON manifest,
+    keep-last-K GC, corrupted/truncated checkpoints quarantined with
+    automatic rollback to the last good one.
+  * ``guards``      — ``NanGuard`` (non-finite loss -> rollback + LR
+    cut), ``StallWatchdog`` (injectable-clock hang detector, the
+    ``serve/resilience.py`` pattern), ``PreemptionGuard`` (SIGTERM ->
+    save-and-exit).
+  * ``faultinject`` — ``TrainFaultSource``: scheduled crash /
+    corrupt-write / NaN-batch / preempt / hang faults so every behavior
+    above is testable on CPU in tier-1 (mirrors ``serve/faultinject``).
+  * ``export``      — checkpoint -> baked MPI scenes for the ``serve``
+    CLI (``serve --ckpt``), closing the train -> serve loop.
+"""
+
+from mpi_vision_tpu.ckpt.faultinject import (
+    SimulatedCrash,
+    TrainFault,
+    TrainFaultSource,
+)
+from mpi_vision_tpu.ckpt.guards import (
+    NanGuard,
+    NonFiniteLossError,
+    PreemptionGuard,
+    StallWatchdog,
+)
+from mpi_vision_tpu.ckpt.store import (
+    CheckpointStore,
+    CorruptCheckpointError,
+    Restored,
+    flatten_arrays,
+    unflatten_arrays,
+)
+
+__all__ = [
+    "CheckpointStore",
+    "CorruptCheckpointError",
+    "NanGuard",
+    "NonFiniteLossError",
+    "PreemptionGuard",
+    "Restored",
+    "SimulatedCrash",
+    "StallWatchdog",
+    "TrainFault",
+    "TrainFaultSource",
+    "flatten_arrays",
+    "unflatten_arrays",
+]

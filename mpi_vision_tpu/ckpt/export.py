@@ -1,0 +1,185 @@
+"""Checkpoint -> servable MPI scenes (the train->serve bridge).
+
+``serve --ckpt <dir>`` closes the loop ROADMAP named open since PR 1:
+restore a trained checkpoint, run the stereo-magnification forward pass
+over dataset examples, assemble each prediction into an RGBA MPI
+(``mpi_from_net_output``), and hand the results to ``RenderService`` as
+scenes — exactly what ``--mpi-dir`` does for baked PNG stacks, but fed
+by training output instead of files.
+
+The model is rebuilt from the manifest's ``model`` metadata (written by
+``cli train --ckpt``: num_planes / img_size / norm / compute_dtype), so
+the serving side needs no out-of-band config. Only params are restored
+— optimizer state stays on disk (``restore(template=...)`` loads and
+hash-verifies only the template's arrays, so the Adam moments — ~2/3
+of the payload — are never read). Scene ids embed the checkpoint step
+and
+a params digest prefix, so a cache shared across model versions never
+serves a stale bake.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from typing import Any, Mapping
+
+import numpy as np
+
+from mpi_vision_tpu.ckpt.store import CheckpointStore
+
+
+def _manifest_params_digest(manifest: Mapping) -> str:
+  """A stable content digest of the checkpoint's params (scene-id
+  versioning) from the manifest's per-array sha256 entries — the bytes
+  were already hashed and verified on restore; no second pass."""
+  h = hashlib.sha256()
+  for key, entry in sorted(manifest["arrays"].items()):
+    if key.startswith("['params']"):
+      h.update(key.encode())
+      h.update(entry["sha256"].encode())
+  return h.hexdigest()
+
+
+def restore_params(ckpt_dir: str, log=None):
+  """Restore the newest good checkpoint's network.
+
+  Returns ``(net, model_meta, step)`` where ``net`` has ``params`` (the
+  restored pytree) and ``apply_fn`` (the rebuilt module's apply) — all
+  the serving side needs. ``model_meta`` is the manifest's ``model``
+  dict plus a ``params_digest`` (from the manifest's per-array hashes;
+  scene-id versioning at no extra hashing cost); missing keys fall
+  back to the reference defaults (``config.TrainConfig``). The params TEMPLATE comes from
+  ``jax.eval_shape`` over the module init — structure and shapes with
+  zero device compute (a real init of the 480px/33-plane net just to
+  throw it away would be a visible serve-startup cost).
+  """
+  import types
+
+  import jax
+  import jax.numpy as jnp
+
+  from mpi_vision_tpu.ckpt.store import CorruptCheckpointError
+  from mpi_vision_tpu.models.stereo_mag import StereoMagnificationModel
+
+  if not os.path.isdir(ckpt_dir):
+    # CheckpointStore.__init__ mkdirs its root (fine for a writer); on
+    # this read-only path that would turn a typo'd --ckpt into an empty
+    # store plus a confusing "no restorable checkpoint" — point at the
+    # actual problem instead.
+    raise FileNotFoundError(f"checkpoint directory does not exist: {ckpt_dir}")
+  store = CheckpointStore(ckpt_dir)
+  say = log if log is not None else (lambda _m: None)
+  on_q = lambda s, r: say(f"ckpt: quarantined step {s} ({r}); falling back")
+  while True:
+    # Two passes so the restore stays params-only: the model meta needed
+    # to BUILD the params template lives in the manifest, so peek it via
+    # a step-counter-only restore (one scalar read+hash), then restore
+    # exactly the params. A checkpoint whose params turn out corrupt is
+    # quarantined and the peek repeats on the next-newest one.
+    peek = store.restore(template={"step": np.zeros((), np.int32)},
+                         on_quarantine=on_q)
+    if peek is None:
+      raise FileNotFoundError(
+          f"no restorable checkpoint under {ckpt_dir}")
+    model = dict(peek.meta.get("model", {}))
+    num_planes = int(model.get("num_planes", 10))
+    img_size = int(model.get("img_size", 224))
+    norm = model.get("norm", "instance")
+    dtype = jnp.dtype(model["compute_dtype"]) if model.get(
+        "compute_dtype") else None
+    module = StereoMagnificationModel(num_planes=num_planes, norm=norm,
+                                      dtype=dtype)
+    sample = jnp.zeros((1, img_size, img_size, 3 + 3 * num_planes),
+                       jnp.float32)
+    abstract = jax.eval_shape(module.init, jax.random.PRNGKey(0),
+                              sample)["params"]
+    try:
+      restored = store.restore(step=peek.step,
+                               template={"params": abstract})
+    except CorruptCheckpointError as e:
+      on_q(peek.step, e.reason)
+      continue
+    break
+  params = restored.tree({"params": abstract})["params"]
+  meta = {"num_planes": num_planes, "img_size": img_size, "norm": norm,
+          "compute_dtype": model.get("compute_dtype"),
+          "depth_near": float(model.get("depth_near", 1.0)),
+          "depth_far": float(model.get("depth_far", 100.0)),
+          "params_digest": _manifest_params_digest(restored.manifest)}
+  net = types.SimpleNamespace(params=params, apply_fn=module.apply)
+  return net, meta, restored.step
+
+
+def scenes_from_checkpoint(ckpt_dir: str, dataset_path: str | None = None,
+                           scenes: int = 2, prefix: str = "ckpt",
+                           log=None) -> tuple[list[tuple], dict]:
+  """Render-ready scenes from a checkpoint's forward pass.
+
+  Args:
+    ckpt_dir: a ``CheckpointStore`` root (as written by ``train --ckpt``).
+    dataset_path: RealEstate10K-layout root providing the reference
+      images + PSVs the network consumes; None synthesizes a small
+      procedural dataset at the checkpoint's image size (hermetic mode).
+    scenes: examples (= scenes) to bake, drawn from the test split's
+      fixed triplets (deterministic: same checkpoint -> same scenes).
+    prefix: scene-id prefix.
+    log: optional diagnostics sink.
+
+  Returns:
+    ``(scene_list, info)`` where each scene entry is
+    ``(scene_id, rgba_layers [H, W, P, 4], depths [P], intrinsics [3, 3])``
+    ready for ``RenderService.add_scene``, and ``info`` describes the
+    checkpoint (step, digest, model meta).
+  """
+  import jax.numpy as jnp
+
+  from mpi_vision_tpu.core.camera import inv_depths
+  from mpi_vision_tpu.data import realestate
+  from mpi_vision_tpu.models.stereo_mag import mpi_from_net_output
+
+  say = log if log is not None else (lambda _m: None)
+  state, meta, ckpt_step = restore_params(ckpt_dir, log=log)
+  digest = meta["params_digest"]
+
+  tmp_holder = None
+  try:
+    if dataset_path is None:
+      import tempfile
+
+      tmp_holder = tempfile.TemporaryDirectory(prefix="mpi_ckpt_scenes_")
+      realestate.synthesize_dataset(
+          tmp_holder.name, num_scenes=max(scenes, 1), frames=4,
+          img_size=meta["img_size"], seed=0)
+      dataset_path = tmp_holder.name
+      say(f"serve: synthesized {scenes} ckpt scene source(s) at "
+          f"{dataset_path}")
+    dataset = realestate.RealEstateDataset(
+        dataset_path, is_valid=True, img_size=meta["img_size"],
+        num_planes=meta["num_planes"])
+    if not len(dataset):
+      raise ValueError(
+          f"dataset at {dataset_path} has an empty test split; nothing to "
+          "bake from the checkpoint")
+
+    depths = np.asarray(
+        inv_depths(meta["depth_near"], meta["depth_far"],
+                   meta["num_planes"]), np.float32)
+    out = []
+    for i in range(min(scenes, len(dataset))):
+      example = dataset[i]
+      pred = state.apply_fn({"params": state.params},
+                            jnp.asarray(example["net_input"])[None])
+      rgba = mpi_from_net_output(pred, jnp.asarray(example["ref_img"])[None])
+      scene_id = f"{prefix}_{ckpt_step}_{digest[:8]}_{i:03d}"
+      out.append((scene_id, np.asarray(rgba[0], np.float32), depths,
+                  np.asarray(example["intrinsics"], np.float32)))
+      say(f"serve: baked {scene_id} from checkpoint step {ckpt_step}")
+  finally:
+    if tmp_holder is not None:
+      # The scene arrays are materialized above; the synthesized PNG
+      # dataset has no further readers — don't leak a /tmp tree per
+      # serve start.
+      tmp_holder.cleanup()
+  info = {"step": ckpt_step, "params_digest": digest, **meta}
+  return out, info

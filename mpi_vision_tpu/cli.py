@@ -5,14 +5,18 @@ export cell 18). These commands make the same flow scriptable:
 
   * ``train`` — train the stereo-magnification model on a RealEstate10K-
     layout dataset (or ``--synthetic`` for the hermetic procedural scenes)
-    with the reference hyperparameters (``config.TrainConfig``), optionally
-    checkpointing (orbax) and exporting a viewer HTML of a validation MPI.
+    with the reference hyperparameters (``config.TrainConfig``). With
+    ``--ckpt`` the run is crash-safe (``ckpt/``): atomic manifest'd
+    checkpoints, SIGTERM preemption saves, NaN rollback + LR cut, and
+    bit-exact ``--resume``.
   * ``export-viewer`` — render a baked PNG MPI directory (e.g. the
     reference's ``test/rgba_*.png``) into the standalone HTML viewer.
   * ``serve`` — run the batched render-serving subsystem (serve/): scene
     cache + micro-batching scheduler + HTTP front end (``/render``,
     ``/healthz``, ``/stats``, ``/metrics``, ``/debug/traces``,
-    ``/debug/profile``) over synthetic scenes or a baked PNG MPI.
+    ``/debug/profile``) over synthetic scenes, a baked PNG MPI
+    (``--mpi-dir``), or MPIs predicted by a trained checkpoint
+    (``--ckpt``, the train -> serve bridge).
 
 All print a one-line JSON summary on stdout (diagnostics on stderr).
 """
@@ -39,6 +43,24 @@ def cmd_train(args: argparse.Namespace) -> dict:
   from mpi_vision_tpu import config
   from mpi_vision_tpu.data import realestate
   from mpi_vision_tpu.train import loop as train_loop
+
+  if args.save_every < 0:
+    raise SystemExit(f"--save-every must be >= 0, got {args.save_every}")
+  if args.keep is not None and args.keep < 1:
+    raise SystemExit(f"--keep must be >= 1, got {args.keep}")
+  if not args.ckpt:
+    # These flags only act through the checkpoint path; silently taking
+    # the open-loop branch would drop the crash safety the user asked
+    # for (no checkpoints would ever be written).
+    wants_ckpt = [flag for flag, on in (
+        ("--resume", args.resume),
+        ("--save-every", args.save_every > 0),
+        ("--keep", args.keep is not None),
+        ("--nan-guard/--no-nan-guard", args.nan_guard is not None),
+        ("--stall-timeout-s", args.stall_timeout_s > 0)) if on]
+    if wants_ckpt:
+      raise SystemExit(
+          f"{', '.join(wants_ckpt)} require(s) --ckpt <dir>")
 
   root = args.dataset
   if args.synthetic:
@@ -69,8 +91,22 @@ def cmd_train(args: argparse.Namespace) -> dict:
       learning_rate=args.lr, epochs=args.epochs,
       vgg_resize=args.vgg_resize if args.vgg_resize > 0 else None,
       compute_dtype="bfloat16" if args.bf16 else None)
-  dataset = cfg.data.make_dataset(rng=np.random.default_rng(args.seed))
-  state = cfg.make_train_state(jax.random.PRNGKey(args.seed))
+  dataset = None
+
+  def the_dataset():
+    # Lazy: the --ckpt path never reads this object (make_batches builds
+    # a fresh per-epoch dataset), so a crash-safe run over a real
+    # dataset skips the full scene walk at startup.
+    nonlocal dataset
+    if dataset is None:
+      dataset = cfg.data.make_dataset(rng=np.random.default_rng(args.seed))
+    return dataset
+
+  # With --ckpt the learning rate rides inside the optimizer state
+  # (inject_hyperparams): the NaN guard can cut it and checkpoints carry
+  # it, so interrupted-then-resumed runs replay bit-exactly.
+  state = cfg.make_train_state(jax.random.PRNGKey(args.seed),
+                               mutable_lr=bool(args.ckpt))
 
   lr_found = None
   if args.lr_find:
@@ -85,7 +121,7 @@ def cmd_train(args: argparse.Namespace) -> dict:
       sweep_vgg = vgg_lib.default_params()
     sweep_batches = list(itertools.islice(
         realestate.iterate_batches(
-            dataset, batch_size=cfg.data.batch_size,
+            the_dataset(), batch_size=cfg.data.batch_size,
             rng=np.random.default_rng(args.seed + 2)),
         args.lr_find_steps))
     found = train_loop.lr_find(
@@ -99,7 +135,8 @@ def cmd_train(args: argparse.Namespace) -> dict:
     import dataclasses
 
     cfg = dataclasses.replace(cfg, learning_rate=lr_found)
-    state = cfg.make_train_state(jax.random.PRNGKey(args.seed))
+    state = cfg.make_train_state(jax.random.PRNGKey(args.seed),
+                                 mutable_lr=bool(args.ckpt))
 
   # Resolve VGG params ONCE and share them between the train and eval
   # steps (default_params() can load an orbax checkpoint from disk).
@@ -131,31 +168,97 @@ def cmd_train(args: argparse.Namespace) -> dict:
     else:
       _log("valid: test split empty; skipping per-epoch validation")
 
-  order = np.random.default_rng(args.seed + 1)
   t0 = time.time()
   all_losses, valid_losses = [], []
-  for epoch in range(cfg.epochs):
-    state, losses = train_loop.fit(
-        state, realestate.prefetch_batches(realestate.iterate_batches(
-            dataset, batch_size=cfg.data.batch_size, rng=order)),
-        step=step)
-    all_losses.extend(losses)
-    if losses:
-      msg = (f"epoch {epoch}: train loss {np.mean(losses):.4f}")
-      if valid_batches:
-        valid_losses.append(train_loop.evaluate(
-            state, valid_batches, eval_step))
-        msg += f" valid loss {valid_losses[-1]:.4f}"
-      _log(msg + f" ({time.time() - t0:.0f}s elapsed)")
-  if not all_losses:
+  ckpt_report = None
+
+  def log_epoch(epoch_state, epoch, losses):
+    if not losses:
+      return
+    msg = f"epoch {epoch}: train loss {np.mean(losses):.4f}"
+    if valid_batches:
+      valid_losses.append(train_loop.evaluate(
+          epoch_state, valid_batches, eval_step))
+      msg += f" valid loss {valid_losses[-1]:.4f}"
+    _log(msg + f" ({time.time() - t0:.0f}s elapsed)")
+
+  if args.ckpt:
+    # Crash-safe path: atomic manifest'd checkpoints, SIGTERM preemption
+    # saves, NaN rollback + LR cut, bit-exact resume (ckpt/ + the
+    # fit_resumable contract: the batch stream is a pure function of the
+    # epoch index, so the data cursor in each manifest replays exactly).
+    from mpi_vision_tpu.ckpt import (
+        CheckpointStore,
+        NanGuard,
+        PreemptionGuard,
+        StallWatchdog,
+    )
+
+    scene_list = None  # the load_scenes walk, shared across epochs
+
+    def make_batches(epoch: int):
+      # A FRESH dataset object per call (not a reseed of the shared
+      # one): a prefetch worker from an abandoned iterator (NaN
+      # rollback) may still be drawing triplets, and sharing one RNG
+      # with it would make the replayed stream nondeterministic —
+      # breaking the bit-exact-resume contract. The scene list is a
+      # deterministic function of the path, though, so the directory
+      # walk happens once — only the RNGs must be per-epoch fresh.
+      nonlocal scene_list
+      epoch_ds = cfg.data.make_dataset(
+          rng=np.random.default_rng([args.seed, 101, epoch]),
+          scenes=scene_list)
+      scene_list = epoch_ds.scenes
+      return realestate.prefetch_batches(realestate.iterate_batches(
+          epoch_ds, batch_size=cfg.data.batch_size,
+          rng=np.random.default_rng([args.seed, 202, epoch])))
+
+    store = CheckpointStore(
+        os.path.abspath(args.ckpt),
+        keep=args.keep if args.keep is not None else 3)
+    watchdog = (StallWatchdog(args.stall_timeout_s,
+                              on_stall=lambda idle: _log(
+                                  f"train: WATCHDOG no step completed in "
+                                  f"{idle:.0f}s (device hang?)"))
+                if args.stall_timeout_s > 0 else None)
+    with PreemptionGuard() as preemption:
+      state, ckpt_report = train_loop.fit_resumable(
+          state, cfg.epochs, make_batches, store, step=step,
+          save_every=args.save_every,
+          meta={"model": cfg.model_meta(), "seed": args.seed},
+          resume="auto" if args.resume else "never",
+          nan_guard=None if args.nan_guard is False else NanGuard(),
+          watchdog=watchdog, preemption=preemption,
+          on_epoch=log_epoch, log=_log)
+    if args.resume and ckpt_report["resumed_from"] is not None:
+      # Bit-exact resume restored the WHOLE optimizer state, including
+      # the checkpointed learning rate — an explicit --lr only seeds
+      # fresh runs; say so instead of silently discarding it. Emitted
+      # only after an ACTUAL restore: over an empty (or all-corrupt)
+      # store --resume starts fresh and --lr IS used.
+      _log("train: --resume keeps the checkpointed optimizer state "
+           "(including its learning rate); --lr applies to fresh runs "
+           "only")
+    all_losses = ckpt_report["losses"]
+    _log(f"checkpoint store at {args.ckpt} "
+         f"(final step {ckpt_report['final_step']}, "
+         f"{ckpt_report['saves']} saves"
+         + (", PREEMPTED" if ckpt_report["preempted"] else "") + ")")
+  else:
+    order = np.random.default_rng(args.seed + 1)
+    for epoch in range(cfg.epochs):
+      state, losses = train_loop.fit(
+          state, realestate.prefetch_batches(realestate.iterate_batches(
+              the_dataset(), batch_size=cfg.data.batch_size, rng=order)),
+          step=step)
+      all_losses.extend(losses)
+      log_epoch(state, epoch, losses)
+  if not all_losses and not (ckpt_report is not None
+                             and (ckpt_report["resumed_from"] is not None
+                                  or ckpt_report["preempted"])):
     raise SystemExit(
         "no training steps ran: check --epochs and that the dataset has at "
         "least batch_size scenes")
-
-  if args.ckpt:
-    train_loop.save_checkpoint(os.path.abspath(args.ckpt), state,
-                               overwrite=True)
-    _log(f"checkpoint saved to {args.ckpt}")
 
   if args.export_html:
     from mpi_vision_tpu.models.stereo_mag import mpi_from_net_output
@@ -176,11 +279,19 @@ def cmd_train(args: argparse.Namespace) -> dict:
       **({"lr_found": lr_found} if lr_found is not None else {}),
       "epochs": cfg.epochs,
       "steps": len(all_losses),
-      "first_loss": round(all_losses[0], 5),
-      "final_loss": round(all_losses[-1], 5),
+      **({"first_loss": round(all_losses[0], 5),
+          "final_loss": round(all_losses[-1], 5)} if all_losses else {}),
       **({"first_valid_loss": round(valid_losses[0], 5),
           "final_valid_loss": round(valid_losses[-1], 5)}
          if valid_losses else {}),
+      **({"ckpt": {
+          "final_step": ckpt_report["final_step"],
+          "resumed_from": ckpt_report["resumed_from"],
+          "preempted": ckpt_report["preempted"],
+          "saves": ckpt_report["saves"],
+          "nan_rollbacks": ckpt_report["nan_rollbacks"],
+          "quarantined": ckpt_report["quarantined"],
+      }} if ckpt_report is not None else {}),
       "seconds": round(time.time() - t0, 1),
   }
 
@@ -211,6 +322,20 @@ def cmd_serve(args: argparse.Namespace) -> dict:
       Tracer,
       make_http_server,
   )
+
+  if not args.ckpt:
+    # Mirror cmd_train's guard: these flags only act through the
+    # checkpoint bridge, and silently serving the default synthetic
+    # scenes instead would drop the trained MPIs the user asked for.
+    wants_ckpt = [flag for flag, on in (
+        ("--ckpt-scenes", args.ckpt_scenes is not None),
+        ("--ckpt-dataset", bool(args.ckpt_dataset))) if on]
+    if wants_ckpt:
+      raise SystemExit(f"{', '.join(wants_ckpt)} require(s) --ckpt <dir>")
+  if args.ckpt_scenes is not None and args.ckpt_scenes < 1:
+    # 0 would come up "healthy" serving no checkpoint scenes at all
+    # (every /render 404s unless --mpi-dir supplied others).
+    raise SystemExit(f"--ckpt-scenes must be >= 1, got {args.ckpt_scenes}")
 
   use_mesh = {"auto": None, "on": True, "off": False}[args.sharded]
   resilience = None
@@ -244,7 +369,21 @@ def cmd_serve(args: argparse.Namespace) -> dict:
     svc.add_scene(scene_id, mpi,
                   np.asarray(inv_depths(args.near, args.far, p)), k)
     _log(f"serve: loaded MPI scene {scene_id!r} [{h}x{w}x{p}]")
-  else:
+  if args.ckpt:
+    # The train -> serve bridge (ROADMAP): restore the checkpoint, run
+    # the forward pass, bake the predicted MPIs as scenes.
+    from mpi_vision_tpu.ckpt.export import scenes_from_checkpoint
+
+    ckpt_scenes, ckpt_info = scenes_from_checkpoint(
+        os.path.abspath(args.ckpt),
+        dataset_path=args.ckpt_dataset or None,
+        scenes=args.ckpt_scenes if args.ckpt_scenes is not None else 2,
+        log=_log)
+    for sid, rgba, depths, k in ckpt_scenes:
+      svc.add_scene(sid, rgba, depths, k)
+    _log(f"serve: {len(ckpt_scenes)} scene(s) from checkpoint step "
+         f"{ckpt_info['step']} (params {ckpt_info['params_digest'][:8]})")
+  if not args.mpi_dir and not args.ckpt:
     ids = svc.add_synthetic_scenes(
         args.scenes, height=args.img_size, width=args.img_size,
         planes=args.num_planes)
@@ -317,6 +456,9 @@ def cmd_serve(args: argparse.Namespace) -> dict:
       "rejected": stats["rejected"],
       "resilience": stats["resilience"],
       **({"traces": svc.tracer.finished} if args.trace else {}),
+      **({"ckpt_step": ckpt_info["step"],
+          "ckpt_params_digest": ckpt_info["params_digest"][:16]}
+         if args.ckpt else {}),
   }
 
 
@@ -360,7 +502,28 @@ def build_parser() -> argparse.ArgumentParser:
                  help="evaluate the test split's fixed triplets each epoch "
                       "(the reference's per-epoch valid loss, cell 16)")
   t.add_argument("--seed", type=int, default=0)
-  t.add_argument("--ckpt", default="", help="orbax checkpoint directory")
+  t.add_argument("--ckpt", default="",
+                 help="checkpoint store directory (ckpt/: atomic "
+                      "manifest'd saves, NaN rollback, SIGTERM "
+                      "preemption saves, bit-exact --resume)")
+  t.add_argument("--save-every", type=int, default=0,
+                 help="extra checkpoint cadence in steps (0 = epoch "
+                      "boundaries only); requires --ckpt")
+  t.add_argument("--keep", type=int, default=None,
+                 help="checkpoints retained by GC (default 3; quarantine "
+                      "excluded); requires --ckpt")
+  t.add_argument("--resume", action="store_true",
+                 help="resume from the newest good checkpoint in --ckpt "
+                      "(bit-exact: params, optimizer state, step, data "
+                      "cursor); default starts fresh")
+  t.add_argument("--nan-guard", action=argparse.BooleanOptionalAction,
+                 default=None,
+                 help="on a non-finite loss, roll back to the last good "
+                      "checkpoint and halve the learning rate (default on; "
+                      "requires --ckpt; --no-nan-guard fails fast instead)")
+  t.add_argument("--stall-timeout-s", type=float, default=0.0,
+                 help="warn when no step completes for this long "
+                      "(<= 0 disables the stall watchdog)")
   t.add_argument("--export-html", default="",
                  help="write a viewer HTML of a validation MPI here")
   t.set_defaults(fn=cmd_train)
@@ -388,6 +551,18 @@ def build_parser() -> argparse.ArgumentParser:
   s.add_argument("--num-planes", type=int, default=16)
   s.add_argument("--mpi-dir", default="",
                  help="serve a baked PNG MPI directory instead")
+  s.add_argument("--ckpt", default="",
+                 help="serve MPIs predicted by a trained checkpoint "
+                      "(a train --ckpt store): restores params, runs "
+                      "the forward pass, bakes the predictions as "
+                      "scenes (combinable with --mpi-dir)")
+  s.add_argument("--ckpt-scenes", type=int, default=None,
+                 help="examples to bake from the --ckpt forward pass "
+                      "(default 2); requires --ckpt")
+  s.add_argument("--ckpt-dataset", default="",
+                 help="RealEstate10K-layout root feeding the --ckpt "
+                      "forward pass (default: procedural synthetic); "
+                      "requires --ckpt")
   s.add_argument("--prefix", default="rgba_")
   s.add_argument("--near", type=float, default=1.0)
   s.add_argument("--far", type=float, default=100.0)

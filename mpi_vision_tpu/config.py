@@ -28,7 +28,9 @@ class DataConfig:
   max_dist: float = 500e3        # cell 8:13
   batch_size: int = 1            # cell 8:97 (paper/InstanceNorm choice)
 
-  def make_dataset(self, is_valid: bool = False, rng=None):
+  def make_dataset(self, is_valid: bool = False, rng=None, scenes=None):
+    """``scenes``: a previously walked scene list to reuse (skips the
+    ``load_scenes`` directory walk; see ``RealEstateDataset.scenes``)."""
     import numpy as np
 
     from mpi_vision_tpu.data.realestate import RealEstateDataset
@@ -37,7 +39,8 @@ class DataConfig:
         self.dataset_path, is_valid=is_valid, min_dist=self.min_dist,
         max_dist=self.max_dist, img_size=self.img_size,
         num_planes=self.num_planes,
-        rng=rng if rng is not None else np.random.default_rng())
+        rng=rng if rng is not None else np.random.default_rng(),
+        scenes=scenes)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -59,7 +62,10 @@ class TrainConfig:
     on the reference's Colab GPU)."""
     return cls(data=DataConfig(img_size=480, num_planes=33))
 
-  def make_train_state(self, rng_key):
+  def make_train_state(self, rng_key, mutable_lr: bool = False):
+    """``mutable_lr=True`` makes the learning rate an optimizer-state
+    leaf (``optax.inject_hyperparams``) — required by the NaN guard's
+    LR cut and carried bit-exactly inside checkpoints (``ckpt/``)."""
     from mpi_vision_tpu.train.loop import create_train_state
 
     dtype = None
@@ -70,7 +76,19 @@ class TrainConfig:
     return create_train_state(
         rng_key, num_planes=self.data.num_planes,
         image_size=(self.data.img_size, self.data.img_size),
-        learning_rate=self.learning_rate, norm=self.norm, dtype=dtype)
+        learning_rate=self.learning_rate, norm=self.norm, dtype=dtype,
+        mutable_lr=mutable_lr)
+
+  def model_meta(self) -> dict:
+    """The manifest ``model`` block ``serve --ckpt`` rebuilds from."""
+    return {
+        "num_planes": self.data.num_planes,
+        "img_size": self.data.img_size,
+        "norm": self.norm,
+        "compute_dtype": self.compute_dtype,
+        "depth_near": self.data.depth_near,
+        "depth_far": self.data.depth_far,
+    }
 
   def _resolve_loss_params(self, vgg_params):
     """Shared train/eval loss-surface resolution: ``'default'`` ->

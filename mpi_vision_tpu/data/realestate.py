@@ -187,11 +187,15 @@ class RealEstateDataset:
   img_size: int = 224
   num_planes: int = 10
   rng: np.random.Generator = field(default_factory=np.random.default_rng)
-  scenes: list[Scene] = field(init=False)
+  # Pass a pre-walked scene list to skip the ``load_scenes`` directory
+  # walk (it is a deterministic function of the path, so callers building
+  # one dataset per epoch can walk once and share the list).
+  scenes: list[Scene] | None = None
 
   def __post_init__(self):
-    self.scenes = load_scenes(self.dataset_path,
-                              "test" if self.is_valid else "train")
+    if self.scenes is None:
+      self.scenes = load_scenes(self.dataset_path,
+                                "test" if self.is_valid else "train")
 
   def __len__(self) -> int:
     return len(self.scenes)

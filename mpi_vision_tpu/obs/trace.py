@@ -79,8 +79,9 @@ class Trace:
   __slots__ = ("trace_id", "name", "attrs", "t_start", "t_end", "error",
                "_spans", "_tracer", "_lock", "_finished")
 
-  def __init__(self, tracer: "Tracer", name: str, attrs: dict):
-    self.trace_id = new_trace_id()
+  def __init__(self, tracer: "Tracer", name: str, attrs: dict,
+               trace_id: str | None = None):
+    self.trace_id = trace_id or new_trace_id()
     self.name = name
     self.attrs = attrs
     self._tracer = tracer
@@ -199,13 +200,17 @@ class Tracer:
     self.finished = 0
     self.emit_errors = 0
 
-  def start_trace(self, name: str, **attrs):
-    """A new ``Trace`` — or ``NULL_TRACE`` when tracing is disabled."""
+  def start_trace(self, name: str, trace_id: str | None = None, **attrs):
+    """A new ``Trace`` — or ``NULL_TRACE`` when tracing is disabled.
+
+    ``trace_id`` overrides the generated id (the HTTP layer passes an
+    inbound W3C ``traceparent`` trace-id through so a fronting proxy
+    can stitch its trace to the recorded one)."""
     if not self.enabled:
       return NULL_TRACE
     with self._lock:
       self.started += 1
-    return Trace(self, name, attrs)
+    return Trace(self, name, attrs, trace_id=trace_id)
 
   def _record_finished(self, trace: Trace) -> None:
     record = trace.to_dict()

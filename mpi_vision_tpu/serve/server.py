@@ -19,7 +19,10 @@ stdlib ``http.server`` front end:
                    little-endian f32 pixels, base64 (shape [H, W, 3]).
                    Every response (success or error) carries an
                    ``X-Trace-Id`` header; with tracing enabled the id
-                   resolves to a span tree at ``/debug/traces``.
+                   resolves to a span tree at ``/debug/traces``. A valid
+                   inbound W3C ``traceparent`` header's trace-id is
+                   honored as the id, so a fronting proxy can stitch
+                   distributed traces.
 
 Scenes register host-side (``add_scene``) and bake lazily through the
 LRU cache on first request, so cache hit/miss accounting reflects real
@@ -44,6 +47,7 @@ import base64
 import functools
 import json
 import math
+import re
 import threading
 import urllib.parse
 import zlib
@@ -343,6 +347,37 @@ class RenderService:
 # is malformed or hostile, and the handler must not buffer it.
 _MAX_BODY_BYTES = 1 << 20
 
+# W3C traceparent: version, 32-hex trace-id, 16-hex parent span id,
+# 2-hex flags (https://www.w3.org/TR/trace-context/). Spec requires
+# lowercase hex; all-zero trace-id / parent-id are invalid. Versions above
+# "00" may append dash-separated fields after the flags — receivers must
+# still parse the version-00 prefix — while version "00" itself is exactly
+# four fields.
+_TRACEPARENT_RE = re.compile(
+    r"^([0-9a-f]{2})-([0-9a-f]{32})-([0-9a-f]{16})-([0-9a-f]{2})(-.+)?$")
+
+
+def _inbound_trace_id(headers) -> str | None:
+  """The trace-id of a valid inbound ``traceparent`` header, else None.
+
+  Honoring it means a fronting proxy/mesh sees ITS trace-id echoed in
+  ``X-Trace-Id`` and recorded at ``/debug/traces`` — distributed traces
+  stitch without translation (ROADMAP observability follow-on). Invalid
+  headers are ignored (fresh id), never rejected: tracing must not be
+  able to fail a render."""
+  value = headers.get("traceparent")
+  if value is None:
+    return None
+  m = _TRACEPARENT_RE.match(value.strip())
+  if m is None or m.group(1) == "ff":
+    return None
+  if m.group(5) is not None and m.group(1) == "00":
+    return None  # version 00 forbids trailing fields
+  trace_id, parent_id = m.group(2), m.group(3)
+  if trace_id == "0" * 32 or parent_id == "0" * 16:
+    return None
+  return trace_id
+
 
 class _Handler(BaseHTTPRequestHandler):
   """One request per thread (ThreadingHTTPServer); blocking on the
@@ -428,9 +463,11 @@ class _Handler(BaseHTTPRequestHandler):
       return
     # Every /render response — success, 4xx, 5xx — carries X-Trace-Id so
     # a client-reported failure is greppable in logs and /debug/traces.
-    # Bad requests never reach the tracer (nothing to trace); they get a
-    # fresh id generated here.
-    tid_hdr = {"X-Trace-Id": new_trace_id()}
+    # An inbound W3C traceparent wins (proxy trace stitching); bad
+    # requests never reach the tracer (nothing to trace) and reuse the
+    # same id for their error response.
+    inbound_tid = _inbound_trace_id(self.headers)
+    tid_hdr = {"X-Trace-Id": inbound_tid or new_trace_id()}
     try:
       length = int(self.headers.get("Content-Length", "0"))
       if not 0 <= length <= _MAX_BODY_BYTES:
@@ -441,9 +478,16 @@ class _Handler(BaseHTTPRequestHandler):
       if not isinstance(req, dict):
         raise ValueError(f"body must be a JSON object, got {type(req).__name__}")
       scene_id = req["scene_id"]
+      if not isinstance(scene_id, str):
+        # A dict/list scene id would detonate as an unhashable key deep
+        # inside the dispatcher — reject it at the door (fuzz pin).
+        raise ValueError(
+            f"scene_id must be a string, got {type(scene_id).__name__}")
       pose = np.asarray(req["pose"], np.float32)
       if pose.shape != (4, 4):
         raise ValueError(f"pose must be 4x4, got {pose.shape}")
+      if not np.isfinite(pose).all():
+        raise ValueError("pose contains non-finite values")
     except (KeyError, TypeError, ValueError, json.JSONDecodeError) as e:
       self._send_json({"error": f"bad request: {e}"}, status=400,
                       extra_headers=tid_hdr)
@@ -457,8 +501,8 @@ class _Handler(BaseHTTPRequestHandler):
       return
     # The handler owns the trace (not render_traced) so error responses
     # carry the same id the recorded trace has in /debug/traces.
-    tr = self.service.tracer.start_trace("render", scene_id=str(scene_id),
-                                         http=True)
+    tr = self.service.tracer.start_trace("render", trace_id=inbound_tid,
+                                         scene_id=str(scene_id), http=True)
     if tr.trace_id:
       tid_hdr = {"X-Trace-Id": tr.trace_id}
     try:

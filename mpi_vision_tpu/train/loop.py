@@ -11,12 +11,19 @@ metrics)`` — plus:
     inserts the gradient all-reduce over ICI (the DP layout the reference
     never had, SURVEY.md §5.8);
   * orbax checkpoint save/restore of the full train state (SURVEY.md §5.4:
-    absent upstream, supplied here idiomatically).
+    absent upstream, supplied here idiomatically);
+  * ``fit_resumable`` — the crash-safe epoch driver over a
+    ``ckpt.CheckpointStore``: atomic periodic saves, SIGTERM preemption
+    saves, NaN rollback with LR cut (``mutable_lr`` states carry the LR
+    inside the optimizer state), stall watchdog, and bit-exact resume
+    (params + optimizer state + step + data cursor).
 """
 
 from __future__ import annotations
 
+import contextlib
 import functools
+import math
 from typing import Any, Callable, Mapping
 
 import jax
@@ -42,18 +49,67 @@ def create_train_state(
     learning_rate: float = 2e-4,
     norm: str | None = "instance",
     dtype: Any = None,
+    mutable_lr: bool = False,
 ) -> TrainState:
   """Init model params and Adam (reference lr 2e-4, cells 15-16).
 
   ``dtype=jnp.bfloat16`` runs the U-Net's convs in bf16 on the MXU while
-  params, optimizer state, and outputs stay f32 (mixed precision)."""
+  params, optimizer state, and outputs stay f32 (mixed precision).
+
+  ``mutable_lr=True`` builds Adam through ``optax.inject_hyperparams``:
+  the learning rate becomes a LEAF of the optimizer state — adjustable
+  at runtime (``set_learning_rate``, the NaN guard's LR cut) and carried
+  inside every checkpoint, so a resumed run reproduces post-cut training
+  bit-exactly without side-channel bookkeeping."""
   model = StereoMagnificationModel(num_planes=num_planes, norm=norm,
                                    dtype=dtype)
   h, w = image_size
   sample = jnp.zeros((1, h, w, 3 + 3 * num_planes), jnp.float32)
   params = model.init(rng, sample)["params"]
-  return TrainState.create(
-      apply_fn=model.apply, params=params, tx=optax.adam(learning_rate))
+  tx = (optax.inject_hyperparams(optax.adam)(learning_rate=learning_rate)
+        if mutable_lr else optax.adam(learning_rate))
+  return TrainState.create(apply_fn=model.apply, params=params, tx=tx)
+
+
+def _find_hyperparams(opt_state):
+  """The injected-hyperparams node holding ``learning_rate`` (or None).
+
+  Looks at the state itself and one level of chain tuple — the shapes
+  ``create_train_state(mutable_lr=True)`` and ``optax.chain`` produce."""
+  nodes = [opt_state]
+  if isinstance(opt_state, tuple) and not hasattr(opt_state, "hyperparams"):
+    nodes.extend(opt_state)
+  for node in nodes:
+    hp = getattr(node, "hyperparams", None)
+    if isinstance(hp, dict) and "learning_rate" in hp:
+      return node
+  return None
+
+
+def current_learning_rate(state: TrainState) -> float | None:
+  """The injected learning rate, or None when the LR is baked into
+  ``tx`` (``mutable_lr=False``)."""
+  node = _find_hyperparams(state.opt_state)
+  return None if node is None else float(node.hyperparams["learning_rate"])
+
+
+def set_learning_rate(state: TrainState, learning_rate: float) -> TrainState:
+  """A state whose NEXT update uses ``learning_rate``.
+
+  Pure optimizer-state surgery (no recompile: the LR is a traced leaf).
+  Requires ``create_train_state(mutable_lr=True)``."""
+  node = _find_hyperparams(state.opt_state)
+  if node is None:
+    raise ValueError(
+        "learning rate is baked into the optimizer; build the state with "
+        "create_train_state(mutable_lr=True) to adjust it at runtime")
+  new_node = node._replace(hyperparams={
+      **node.hyperparams,
+      "learning_rate": jnp.asarray(learning_rate, jnp.float32)})
+  if node is state.opt_state:
+    return state.replace(opt_state=new_node)
+  return state.replace(opt_state=tuple(
+      new_node if n is node else n for n in state.opt_state))
 
 
 def make_loss_fn(vgg_params: Any | None,
@@ -394,6 +450,331 @@ def fit(state: TrainState, batches, step=None, log_every: int = 0):
     if log_every and i % log_every == 0:
       print(f"step {i}: loss {float(losses[-1]):.4f}")
   return state, [float(l) for l in jax.device_get(losses)]
+
+
+# --- Crash-safe training (ckpt/ lifecycle) ---------------------------------
+
+
+def _ckpt_tree(state: TrainState):
+  return {"params": state.params, "opt_state": state.opt_state,
+          "step": state.step}
+
+
+def _close_iter(it) -> None:
+  """Close an abandoned batch iterator (generators stop their prefetch
+  workers in their ``finally``); plain iterables are left alone."""
+  close = getattr(it, "close", None)
+  if close is not None:
+    close()
+
+
+def fit_resumable(state: TrainState, epochs: int, make_batches, store, *,
+                  step=None, save_every: int = 0, meta: Mapping | None = None,
+                  resume: str = "auto", nan_guard=None, watchdog=None,
+                  preemption=None, fault_source=None, on_epoch=None,
+                  log=None):
+  """Crash-safe epoch driver: periodic atomic checkpoints, bit-exact
+  resume, NaN rollback, stall watchdog, preemption saves.
+
+  The contract that makes resume BIT-EXACT: ``make_batches(epoch)``
+  must be a pure function of its epoch index (seed per-epoch RNGs with
+  the epoch number). The loop then records a data cursor — (epoch,
+  batches consumed) — in every manifest, and a resumed run replays the
+  current epoch's stream up to the cursor (host-side data work only, no
+  device steps) before continuing, so interrupted-then-resumed training
+  walks the exact parameter stream of an uninterrupted run. Everything
+  else that shapes the stream already lives in the checkpoint tree:
+  params, full optimizer state (including the injected learning rate
+  when the state was built with ``mutable_lr=True``), and the step
+  counter.
+
+  Guard rails around the step:
+
+    * non-finite loss -> restore last-good checkpoint, cut the LR by
+      ``nan_guard.lr_cut`` (needs ``mutable_lr=True``; otherwise the
+      rollback happens without the cut), re-walk from its cursor. The
+      guard's rollback budget bounds the retries; with ``nan_guard=None``
+      a non-finite loss raises ``NonFiniteLossError`` immediately
+      (fail-stop beats training a NaN stream for 19 more epochs).
+    * ``watchdog`` (``ckpt.StallWatchdog``) is beaten after every step
+      (and through restore + cursor replay, which are host work, not
+      hangs) and its monitor thread is started/stopped around the loop.
+      The first step's XLA compile DOES count toward the idle window —
+      size ``timeout_s`` above the worst-case compile.
+    * ``preemption`` (``ckpt.PreemptionGuard``) — when its flag is set
+      (SIGTERM, or a scheduled ``preempt`` fault) the loop saves a
+      checkpoint tagged ``"preempt"`` at the next step boundary and
+      returns early with ``report["preempted"] = True``.
+    * ``fault_source`` (``ckpt.TrainFaultSource``) injects scheduled
+      crash / NaN-batch / preempt / hang faults for tests; pass its
+      ``store_hook`` to the ``CheckpointStore`` to also fault saves.
+
+  Checkpoints land at every epoch boundary (deduped when a periodic
+  save already covered that exact step), every ``save_every`` steps
+  (0 = boundaries only), on preemption, and once up front when the
+  store is empty (the rollback anchor). Losses are fetched per step —
+  the NaN check needs the value on the host; this loop trades the async
+  dispatch overlap of ``fit`` for the ability to notice, which is the
+  point.
+
+  Args:
+    state: initial ``TrainState`` (ignored when a checkpoint is
+      restored, except for its structure, which must match).
+    epochs: total epoch count (the resume cursor counts toward it).
+    make_batches: ``epoch -> iterable of batches`` (pure per epoch).
+    store: a ``ckpt.CheckpointStore``.
+    step: the ``(state, batch) -> (state, metrics)`` step; default
+      ``make_train_step()``.
+    save_every: additional save cadence in optimizer steps.
+    meta: extra manifest metadata (model config for ``serve --ckpt``).
+    resume: "auto" (restore newest good checkpoint if any), "never"
+      (fresh start; published checkpoints from earlier runs are cleared
+      so rollback can never land on a stale one), or "must" (raise if
+      nothing restorable).
+    nan_guard / watchdog / preemption / fault_source: see above.
+    on_epoch: optional ``(state, epoch, epoch_losses) -> None`` called
+      after each epoch-boundary save, at most once per epoch — a NaN
+      rollback that re-finishes a reported epoch does not re-fire it
+      (the CLI's valid-loss column stays one entry per epoch).
+    log: optional ``str -> None`` diagnostics sink.
+
+  Returns:
+    ``(state, report)`` — report keys: ``losses`` (this invocation's
+    per-step losses), ``final_step``, ``resumed_from`` (step or None),
+    ``preempted``, ``nan_rollbacks``, ``saves``, ``quarantined``.
+  """
+  from mpi_vision_tpu.ckpt.guards import NonFiniteLossError, PreemptionGuard
+
+  if resume not in ("auto", "never", "must"):
+    raise ValueError(f"resume must be auto/never/must, got {resume!r}")
+  if save_every < 0:
+    # A negative cadence would "work" via negative modulo (saving every
+    # |n| steps), silently masking a caller bug.
+    raise ValueError(f"save_every must be >= 0, got {save_every}")
+  step = step or make_train_step()
+  preempt = preemption if preemption is not None else PreemptionGuard()
+  say = log if log is not None else (lambda _msg: None)
+  # The template is only ever consulted for its pytree STRUCTURE (restore
+  # keys + unflatten) — keep ShapeDtypeStructs, not the initial arrays, or
+  # a full params+moments copy stays pinned for the whole run.
+  template = jax.tree_util.tree_map(
+      lambda x: jax.ShapeDtypeStruct(jnp.shape(x), jnp.result_type(x)),
+      _ckpt_tree(state))
+  user_meta = dict(meta or {})
+
+  resumed_from = None
+  e, b = 0, 0
+  if resume == "never":
+    # Fresh start over a used store: clear published history so the NaN
+    # rollback can never "restore" a stale checkpoint from a previous
+    # run (quarantined evidence is kept).
+    cleared = store.clear()
+    if cleared:
+      say(f"ckpt: resume='never' cleared {len(cleared)} old checkpoint(s)")
+  else:
+    restored = store.restore(
+        template=template,
+        on_quarantine=lambda s, r: say(
+            f"ckpt: quarantined step {s} ({r}); falling back"))
+    if restored is None:
+      if resume == "must":
+        raise FileNotFoundError(
+            f"resume='must' but no restorable checkpoint under {store.root}")
+    else:
+      tree = restored.tree(template)
+      state = state.replace(params=tree["params"],
+                            opt_state=tree["opt_state"], step=tree["step"])
+      cursor = restored.meta.get("cursor", {})
+      e, b = int(cursor.get("epoch", 0)), int(cursor.get("batch", 0))
+      resumed_from = restored.step
+      say(f"ckpt: resumed from step {restored.step} "
+          f"(epoch {e}, batch {b})")
+
+  # losses[0] is the loss of the step that advanced state.step past
+  # losses_base; a rollback below it (quarantined anchor) moves the base.
+  losses_base = int(state.step)
+  losses: list[float] = []
+  rollback_steps: list[int] = []
+
+  def wd_quiet():
+    # Host-side checkpoint I/O (save, rollback restore + re-hash) is not
+    # a device hang: suspend the monitor for its whole duration (a beat
+    # could not survive work longer than the timeout); re-arms on exit.
+    return (watchdog.suspended() if watchdog is not None
+            else contextlib.nullcontext())
+
+  def save(reason: str) -> None:
+    cur_meta = {"cursor": {"epoch": e, "batch": b}, "reason": reason,
+                **user_meta}
+    lr = current_learning_rate(state)
+    if lr is not None:
+      cur_meta["learning_rate"] = lr
+    with wd_quiet():
+      store.save(int(state.step), _ckpt_tree(state), meta=cur_meta)
+
+  if store.latest_step() is None:
+    save("initial")  # the rollback anchor for fresh runs
+
+  if watchdog is not None:
+    if not watchdog.running:
+      watchdog.start()
+    # Arm fresh: restore + per-array re-hashing happen before any step
+    # completes, and must not count as device idle time.
+    watchdog.beat()
+  # Where each epoch's retained losses begin in ``losses`` — survives
+  # intra-epoch NaN rollbacks (setdefault keeps the original start), so
+  # on_epoch sees the WHOLE epoch's retained stream, not just the steps
+  # since the last rollback re-entry.
+  epoch_loss_start: dict[int, int] = {}
+  last_reported = -1  # highest epoch already handed to on_epoch
+  try:
+    while e < epochs:
+      epoch_loss_start.setdefault(e, len(losses))
+      with wd_quiet():
+        # Building the epoch's data pipeline (scene walk, dataset
+        # construction) is host work between beats, same family as
+        # checkpoint I/O: it may legitimately exceed the stall timeout.
+        it = iter(make_batches(e))
+      try:
+        for _ in range(b):  # replay the data stream up to the cursor
+          next(it)
+          if watchdog is not None:
+            watchdog.beat()  # host-side replay progress, not a hang
+      except StopIteration:
+        # The epoch is shorter than the cursor (dataset shrank between
+        # runs): close the epoch out rather than crash on the skip.
+        say(f"ckpt: cursor batch {b} beyond epoch {e}'s stream; "
+            "advancing to the next epoch")
+        it = iter(())
+      rolled = False
+      for batch in it:
+        fault = (fault_source.on_step(int(state.step))
+                 if fault_source is not None else None)
+        if fault is not None and fault_source.fire_step(fault, preempt):
+          batch = fault_source.poison_batch(batch)
+        if preempt.requested.is_set():
+          save("preempt")
+          say(f"ckpt: preempted at step {int(state.step)}; saved")
+          _close_iter(it)
+          return state, _report(losses, state, resumed_from, store,
+                                nan_guard, rollback_steps, preempted=True)
+        new_state, metrics = step(state, batch)
+        loss = float(metrics["loss"])
+        if not math.isfinite(loss):
+          if nan_guard is None:
+            raise NonFiniteLossError(int(state.step), loss)
+          nan_guard.note_rollback(int(state.step), loss)
+          with wd_quiet():
+            restored = store.restore(
+                template=template,
+                on_quarantine=lambda s, r: say(
+                    f"ckpt: quarantined step {s} ({r}); falling back"))
+          if (restored is not None and restored.step == int(state.step)
+              and any(s < restored.step for s in store.steps())):
+            # The newest checkpoint IS the state that just produced the
+            # NaN (save landed right before the bad batch): restoring it
+            # replays the identical (params, batch) pair — the LR cut
+            # only changes FUTURE updates. Quarantine it (evidence, and
+            # it must not stay published: a later rollback from an
+            # earlier step would jump FORWARD into the known-bad state)
+            # and fall back to the next-newest good checkpoint.
+            store.quarantine(restored.step, "nan-replay-anchor")
+            with wd_quiet():
+              restored = store.restore(
+                  template=template,
+                  on_quarantine=lambda s, r: say(
+                      f"ckpt: quarantined step {s} ({r}); falling back"))
+          # (With no earlier checkpoint the same state replays as-is —
+          # correct for TRANSIENT NaNs, where the glitch won't repeat;
+          # the rollback budget bounds the deterministic-NaN case.)
+          if restored is None:
+            raise NonFiniteLossError(
+                int(state.step), loss, "no checkpoint left to roll back to")
+          rollback_steps.append(restored.step)
+          with wd_quiet():
+            tree = restored.tree(template)
+          state = state.replace(params=tree["params"],
+                                opt_state=tree["opt_state"],
+                                step=tree["step"])
+          old_lr = current_learning_rate(state)
+          if old_lr is not None:
+            state = set_learning_rate(state, old_lr * nan_guard.lr_cut)
+            say(f"ckpt: non-finite loss at step {restored.step}+; rolled "
+                f"back, lr {old_lr:.3g} -> {old_lr * nan_guard.lr_cut:.3g}")
+          else:
+            say(f"ckpt: non-finite loss; rolled back to step "
+                f"{restored.step} (lr fixed — no injected hyperparams)")
+          cursor = restored.meta.get("cursor", {})
+          e, b = int(cursor.get("epoch", 0)), int(cursor.get("batch", 0))
+          del losses[max(0, restored.step - losses_base):]
+          losses_base = min(losses_base, restored.step)
+          # Entries for epochs past the restore point (or pointing past
+          # the truncated list) are stale passes; drop them so re-entry
+          # records a fresh start index.
+          epoch_loss_start = {ep: i for ep, i in epoch_loss_start.items()
+                              if ep <= e and i <= len(losses)}
+          if old_lr is not None:
+            # Persist the cut (overwrite the restored step): if the
+            # replay NaNs again before any new save, the next rollback
+            # restores the ALREADY-cut LR and cuts again — the cut
+            # compounds instead of retrying the same LR forever.
+            save("nan-rollback")
+          rolled = True
+          break
+        state = new_state
+        losses.append(loss)
+        b += 1
+        if watchdog is not None:
+          watchdog.beat()
+        if save_every and int(state.step) % save_every == 0:
+          save("periodic")
+      if rolled:
+        # Abandoning the iterator mid-epoch: shut its machinery down
+        # (prefetch threads) BEFORE the next make_batches call, so a
+        # lingering worker cannot keep consuming shared RNG state while
+        # the replay stream is being rebuilt.
+        _close_iter(it)
+        continue
+      finished = e
+      e, b = e + 1, 0
+      if store.latest_step() != int(state.step):
+        # Skipped when a periodic save already landed on this exact
+        # step: the re-save would rewrite identical arrays (the two
+        # cursors differ but resume identically — replaying the
+        # finished epoch's tail is host-only work).
+        save("epoch")
+      start = epoch_loss_start.pop(finished, len(losses))
+      if on_epoch is not None and finished > last_reported:
+        # Exactly once per epoch: a NaN rollback that re-enters an
+        # already-reported epoch re-finishes it with only the re-walked
+        # tail in memory — re-firing would hand on_epoch a partial
+        # slice and double-count the epoch (the CLI appends a
+        # validation loss per call).
+        last_reported = finished
+        with wd_quiet():
+          # The CLI hangs a validation pass off on_epoch; like checkpoint
+          # I/O it runs between beats and may legitimately exceed the
+          # stall timeout.
+          on_epoch(state, finished, losses[start:])
+  finally:
+    if watchdog is not None:
+      watchdog.stop()
+  return state, _report(losses, state, resumed_from, store, nan_guard,
+                        rollback_steps, preempted=False)
+
+
+def _report(losses, state, resumed_from, store, nan_guard, rollback_steps,
+            preempted):
+  return {
+      "losses": list(losses),
+      "final_step": int(state.step),
+      "resumed_from": resumed_from,
+      "preempted": preempted,
+      "nan_rollbacks": 0 if nan_guard is None else nan_guard.rollbacks,
+      "nan_rollback_steps": list(rollback_steps),
+      "saves": store.saves,
+      "quarantined": store.quarantined,
+  }
 
 
 # --- Checkpointing (orbax) -------------------------------------------------

@@ -1,12 +1,16 @@
-"""Lint: serve/ and obs/ read time only through injectable clocks.
+"""Lint: serve/, obs/, ckpt/, and the hardened train loop read time only
+through injectable clocks.
 
-Every latency, deadline, and span edge in the serving stack must come
-from a clock the caller can inject — that is what makes the breaker,
-scheduler, tracer, and metrics deterministic in tier-1 (fake clocks)
-and keeps all timestamps on ONE base in production. A bare
-``time.time()`` / ``time.monotonic()`` / ``time.perf_counter()`` call
-creeping into a hot path silently breaks both, so this test greps the
-source.
+Every latency, deadline, span edge, stall measurement, and manifest
+timestamp must come from a clock the caller can inject — that is what
+makes the breaker, scheduler, tracer, metrics, checkpoint store, and
+stall watchdog deterministic in tier-1 (fake clocks) and keeps all
+timestamps on ONE base in production. A bare ``time.time()`` /
+``time.monotonic()`` / ``time.perf_counter()`` call creeping into a hot
+path silently breaks both, so this test greps the source — the whole
+``serve``/``obs``/``ckpt`` packages plus ``train/loop.py`` (the
+crash-safe ``fit_resumable`` path; the notebook-parity helpers around it
+ride along for free).
 
 Designated defaults stay legal: ``clock=time.monotonic`` in a signature
 or ``clock if clock else time.monotonic`` pass the *function object* —
@@ -18,8 +22,10 @@ is not a clock read.
 import pathlib
 import re
 
+import mpi_vision_tpu.ckpt
 import mpi_vision_tpu.obs
 import mpi_vision_tpu.serve
+import mpi_vision_tpu.train.loop
 
 _CLOCK_CALL = re.compile(r"\btime\.(time|monotonic|perf_counter)\s*\(")
 
@@ -29,18 +35,34 @@ def _package_sources(pkg):
   return sorted(root.glob("*.py"))
 
 
-def test_no_bare_clock_calls_in_serve_and_obs():
+def _linted_sources():
+  for pkg in (mpi_vision_tpu.serve, mpi_vision_tpu.obs,
+              mpi_vision_tpu.ckpt):
+    yield from _package_sources(pkg)
+  yield pathlib.Path(mpi_vision_tpu.train.loop.__file__)
+
+
+def test_no_bare_clock_calls_in_serve_obs_ckpt_train():
   offenders = []
-  for pkg in (mpi_vision_tpu.serve, mpi_vision_tpu.obs):
-    for path in _package_sources(pkg):
-      for lineno, line in enumerate(path.read_text().splitlines(), 1):
-        code = line.split("#", 1)[0]
-        if _CLOCK_CALL.search(code):
-          offenders.append(f"{path.name}:{lineno}: {line.strip()}")
+  for path in _linted_sources():
+    for lineno, line in enumerate(path.read_text().splitlines(), 1):
+      code = line.split("#", 1)[0]
+      if _CLOCK_CALL.search(code):
+        offenders.append(f"{path.name}:{lineno}: {line.strip()}")
   assert not offenders, (
-      "bare clock calls in serve/obs hot paths (inject a clock instead; "
-      "attribute references like clock=time.monotonic are fine):\n"
-      + "\n".join(offenders))
+      "bare clock calls in serve/obs/ckpt/train-loop hot paths (inject a "
+      "clock instead; attribute references like clock=time.monotonic are "
+      "fine):\n" + "\n".join(offenders))
+
+
+def test_lint_covers_the_ckpt_package_and_train_loop():
+  # Package-qualified so e.g. serve/faultinject.py can never satisfy a
+  # check meant for ckpt/faultinject.py. If these move, re-point the
+  # lint — silently shrinking coverage is exactly the failure mode this
+  # test exists to prevent.
+  rel = {"/".join(p.parts[-2:]) for p in _linted_sources()}
+  assert {"ckpt/store.py", "ckpt/guards.py", "ckpt/faultinject.py",
+          "serve/faultinject.py", "train/loop.py"} <= rel
 
 
 def test_lint_actually_catches_calls():

@@ -1,0 +1,609 @@
+"""ckpt/ subsystem tests: atomic store, guards, fault injection, the
+crash-safe train loop, and the checkpoint -> serve bridge.
+
+The heavyweight acceptance pin (SIGKILL a real subprocess mid-epoch,
+resume, compare digests) lives in tests/test_train_resume.py; these are
+the in-process behaviors: store atomicity + quarantine mechanics, guard
+state machines on fake clocks, and bit-exact resume through the
+SimulatedCrash / NaN / preempt fault paths.
+"""
+
+import json
+import os
+import signal
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from mpi_vision_tpu.ckpt import (
+    CheckpointStore,
+    CorruptCheckpointError,
+    NanGuard,
+    NonFiniteLossError,
+    PreemptionGuard,
+    SimulatedCrash,
+    StallWatchdog,
+    TrainFault,
+    TrainFaultSource,
+    flatten_arrays,
+    unflatten_arrays,
+)
+from mpi_vision_tpu.train import loop as tloop
+
+HW, PLANES = 16, 2
+
+
+def _tree(rng):
+  return {
+      "params": {"w": rng.normal(size=(3, 4)).astype(np.float32),
+                 "b": rng.normal(size=(4,)).astype(np.float32)},
+      "step": np.int64(7),
+  }
+
+
+class TestStore:
+
+  def test_roundtrip_bit_exact_including_scalars(self, rng, tmp_path):
+    store = CheckpointStore(str(tmp_path))
+    tree = _tree(rng)
+    store.save(7, tree, meta={"cursor": {"epoch": 1, "batch": 2}})
+    restored = store.restore(template=tree)
+    assert restored.step == 7
+    assert restored.meta["cursor"] == {"epoch": 1, "batch": 2}
+    out = restored.tree(tree)
+    assert np.shape(out["step"]) == ()          # 0-d stays 0-d
+    assert out["step"].dtype == np.int64
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+        np.asarray(a), np.asarray(b)), tree, out)
+
+  def test_bfloat16_leaves_roundtrip(self, tmp_path):
+    import jax.numpy as jnp
+
+    store = CheckpointStore(str(tmp_path))
+    # The 0-d scalar exercises the reshape-before-view raw-bytes path
+    # (numpy rejects re-viewing a 0-d array at a different itemsize).
+    tree = {"x": jnp.arange(6, dtype=jnp.bfloat16).reshape(2, 3) / 3,
+            "s": jnp.asarray(0.25, jnp.bfloat16)}
+    store.save(0, tree)
+    out = store.restore().tree({"x": tree["x"], "s": tree["s"]})
+    assert out["x"].dtype == jnp.bfloat16
+    np.testing.assert_array_equal(np.asarray(tree["x"], np.float32),
+                                  np.asarray(out["x"], np.float32))
+    assert out["s"].shape == () and out["s"].dtype == jnp.bfloat16
+    assert float(out["s"]) == 0.25
+
+  def test_partial_template_restores_subtree(self, rng, tmp_path):
+    store = CheckpointStore(str(tmp_path))
+    tree = _tree(rng)
+    store.save(1, tree)
+    params = store.restore().tree({"params": tree["params"]})["params"]
+    np.testing.assert_array_equal(params["w"], tree["params"]["w"])
+
+  def test_missing_template_key_raises(self, rng, tmp_path):
+    store = CheckpointStore(str(tmp_path))
+    store.save(1, _tree(rng))
+    with pytest.raises(KeyError, match="missing array"):
+      store.restore(template={"nope": np.zeros(1)})
+
+  def test_gc_keeps_last_k(self, rng, tmp_path):
+    store = CheckpointStore(str(tmp_path), keep=2)
+    for s in range(5):
+      store.save(s, _tree(rng))
+    assert store.steps() == [3, 4]
+
+  def test_overwrite_same_step(self, rng, tmp_path):
+    store = CheckpointStore(str(tmp_path))
+    store.save(3, _tree(rng))
+    tree2 = _tree(np.random.default_rng(99))
+    store.save(3, tree2)
+    out = store.restore().tree(tree2)
+    np.testing.assert_array_equal(out["params"]["w"], tree2["params"]["w"])
+    assert store.steps() == [3]
+
+  def test_truncated_arrays_quarantined_with_fallback(self, rng, tmp_path):
+    store = CheckpointStore(str(tmp_path))
+    store.save(1, _tree(rng))
+    good = store.restore().tree(_tree(rng))
+    store.save(2, _tree(np.random.default_rng(1)))
+    # Truncate the newest checkpoint's arrays file (torn write / bit rot).
+    path = os.path.join(store._step_dir(2), "arrays.npz")
+    with open(path, "r+b") as fh:
+      fh.truncate(os.path.getsize(path) // 2)
+    events = []
+    restored = store.restore(on_quarantine=lambda s, r: events.append((s, r)))
+    assert restored.step == 1                    # fell back to last-good
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+        np.asarray(a), np.asarray(b)), good, restored.tree(_tree(rng)))
+    assert store.quarantined == 1 and events and events[0][0] == 2
+    qdir = os.path.join(str(tmp_path), "quarantine")
+    assert len(os.listdir(qdir)) == 1
+    assert store.steps() == [1]                  # the bad dir is gone
+
+  def test_garbled_manifest_quarantined(self, rng, tmp_path):
+    store = CheckpointStore(str(tmp_path))
+    store.save(1, _tree(rng))
+    with open(os.path.join(store._step_dir(1), "manifest.json"), "w") as fh:
+      fh.write("{not json")
+    assert store.restore() is None               # nothing good left
+    assert store.quarantined == 1
+
+  def test_transient_read_error_does_not_quarantine(self, rng, tmp_path,
+                                                    monkeypatch):
+    # fd exhaustion (EMFILE) while reading a manifest is environmental,
+    # not corruption: the error must surface as-is and the healthy
+    # checkpoint must stay published for the next attempt.
+    import builtins
+    import errno
+
+    store = CheckpointStore(str(tmp_path))
+    store.save(1, _tree(rng))
+    real_open = builtins.open
+
+    def flaky_open(file, *a, **kw):
+      if str(file).endswith("manifest.json"):
+        raise OSError(errno.EMFILE, "Too many open files", str(file))
+      return real_open(file, *a, **kw)
+
+    monkeypatch.setattr(builtins, "open", flaky_open)
+    with pytest.raises(OSError) as ei:
+      store.restore()
+    monkeypatch.undo()
+    assert ei.value.errno == errno.EMFILE
+    assert store.quarantined == 0 and store.steps() == [1]
+    assert store.restore().step == 1             # healthy once fds free up
+
+  def test_mangled_step_field_quarantined_with_fallback(self, rng, tmp_path):
+    # JSON-valid manifest whose top-level "step" is gone (bit rot inside
+    # the key name): must quarantine-and-fall-back, not KeyError.
+    store = CheckpointStore(str(tmp_path))
+    store.save(1, _tree(rng))
+    store.save(2, _tree(np.random.default_rng(1)))
+    mpath = os.path.join(store._step_dir(2), "manifest.json")
+    with open(mpath) as fh:
+      manifest = json.load(fh)
+    manifest["step#"] = manifest.pop("step")
+    with open(mpath, "w") as fh:
+      json.dump(manifest, fh)
+    events = []
+    restored = store.restore(on_quarantine=lambda s, r: events.append((s, r)))
+    assert restored.step == 1                    # fell back to last-good
+    assert store.quarantined == 1 and "step invalid" in events[0][1]
+
+  def test_step_directory_mismatch_quarantined(self, rng, tmp_path):
+    # JSON-valid "step" that no longer matches the directory it lives in
+    # (single flipped digit survives every per-array hash check): a
+    # desynced Restored.step would truncate the wrong loss span on NaN
+    # rollback and dodge the newest-is-bad quarantine.
+    store = CheckpointStore(str(tmp_path))
+    store.save(1, _tree(rng))
+    store.save(2, _tree(np.random.default_rng(1)))
+    mpath = os.path.join(store._step_dir(2), "manifest.json")
+    with open(mpath) as fh:
+      manifest = json.load(fh)
+    manifest["step"] = 20
+    with open(mpath, "w") as fh:
+      json.dump(manifest, fh)
+    events = []
+    restored = store.restore(on_quarantine=lambda s, r: events.append((s, r)))
+    assert restored.step == 1                    # fell back to last-good
+    assert store.quarantined == 1
+    assert "manifest step 20 != directory step 2" in events[0][1]
+
+  def test_hash_mismatch_quarantined(self, rng, tmp_path):
+    store = CheckpointStore(str(tmp_path))
+    store.save(1, _tree(rng))
+    mpath = os.path.join(store._step_dir(1), "manifest.json")
+    with open(mpath) as fh:
+      manifest = json.load(fh)
+    key = next(iter(manifest["arrays"]))
+    manifest["arrays"][key]["sha256"] = "0" * 64
+    with open(mpath, "w") as fh:
+      json.dump(manifest, fh)
+    with pytest.raises(CorruptCheckpointError, match="hash mismatch"):
+      store.restore(step=1)                      # explicit step: raises
+    assert store.quarantined == 1
+
+  def test_empty_store_restores_none(self, tmp_path):
+    assert CheckpointStore(str(tmp_path)).restore() is None
+
+  def test_crash_before_rename_leaves_no_checkpoint(self, rng, tmp_path):
+    faults = TrainFaultSource().at_save(
+        1, TrainFault("crash", stage="pre_rename"))
+    store = CheckpointStore(str(tmp_path), fault_hook=faults.store_hook)
+    store.save(0, _tree(rng))
+    with pytest.raises(SimulatedCrash):
+      store.save(1, _tree(rng))
+    # The interrupted save must not have published; a NEW store (the
+    # restarted process) sweeps any staging leftovers and restores 0.
+    fresh = CheckpointStore(str(tmp_path))
+    assert fresh.steps() == [0]
+    assert not [n for n in os.listdir(str(tmp_path))
+                if n.startswith(".tmp-")]
+    assert fresh.restore().step == 0
+
+  def test_corrupt_write_fault_quarantines_on_restore(self, rng, tmp_path):
+    faults = TrainFaultSource().at_save(1, TrainFault("corrupt"))
+    store = CheckpointStore(str(tmp_path), fault_hook=faults.store_hook)
+    store.save(0, _tree(rng))
+    store.save(1, _tree(rng))                    # published, then corrupted
+    assert faults.injected["corrupt"] == 1
+    fresh = CheckpointStore(str(tmp_path))
+    restored = fresh.restore()
+    assert restored.step == 0 and fresh.quarantined == 1
+
+  def test_interrupted_same_step_replace_restores_aside(self, rng, tmp_path):
+    """A kill between move-aside and publish during a same-step re-save
+    must not lose the checkpoint: the init sweep restores the aside."""
+    store = CheckpointStore(str(tmp_path))
+    tree = _tree(rng)
+    store.save(3, tree)
+    # Simulate the mid-replace kill window: the published dir was moved
+    # aside and the process died before the replacement's rename.
+    # Our own pid: the sweep treats it as dead (a just-constructed store
+    # cannot have its own in-flight save), which is the recovery path.
+    os.rename(store._step_dir(3),
+              os.path.join(str(tmp_path),
+                           f".old-step_0000000003-{os.getpid()}-1"))
+    fresh = CheckpointStore(str(tmp_path))
+    assert fresh.steps() == [3]
+    restored = fresh.restore(template=tree)
+    assert restored.step == 3
+    np.testing.assert_array_equal(
+        restored.tree(tree)["params"]["w"], tree["params"]["w"])
+
+  def test_clear_removes_published_keeps_quarantine(self, rng, tmp_path):
+    store = CheckpointStore(str(tmp_path))
+    store.save(1, _tree(rng))
+    store.save(2, _tree(rng))
+    store.quarantine(2, "evidence")
+    assert store.clear() == [1]
+    assert store.steps() == [] and store.restore() is None
+    assert os.listdir(os.path.join(str(tmp_path), "quarantine"))
+
+  def test_flatten_unflatten_identity(self, rng):
+    tree = _tree(rng)
+    out = unflatten_arrays(flatten_arrays(tree), tree)
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+        np.asarray(a), np.asarray(b)), tree, out)
+
+
+class TestGuards:
+
+  def test_nan_guard_budget(self):
+    guard = NanGuard(max_rollbacks=2)
+    guard.note_rollback(3, float("nan"))
+    guard.note_rollback(5, float("inf"))
+    with pytest.raises(NonFiniteLossError, match="exhausted"):
+      guard.note_rollback(7, float("nan"))
+    assert guard.rollbacks == 2
+
+  def test_watchdog_fires_once_per_episode(self):
+    now = [0.0]
+    fired = []
+    dog = StallWatchdog(10.0, clock=lambda: now[0],
+                        on_stall=fired.append)
+    assert not dog.check()
+    now[0] = 5.0
+    dog.beat()
+    now[0] = 14.0                                # 9 s idle: fine
+    assert not dog.check()
+    now[0] = 16.0                                # 11 s idle: stall
+    assert dog.check() and fired == [11.0]
+    now[0] = 30.0
+    assert not dog.check()                       # same episode: no re-fire
+    dog.beat()                                   # progress re-arms
+    now[0] = 50.0
+    assert dog.check()
+    assert dog.stalls == 2
+
+  def test_watchdog_suspended_holds_fire_past_timeout(self):
+    # A checkpoint write longer than the timeout must not page: a beat
+    # before the save would not survive it, so saves suspend the monitor.
+    now = [0.0]
+    fired = []
+    dog = StallWatchdog(10.0, clock=lambda: now[0], on_stall=fired.append)
+    with dog.suspended():
+      now[0] = 40.0                              # 40 s "save": way past
+      assert not dog.check() and not fired       # suspended: holds fire
+    assert not dog.check()                       # exit re-armed the clock
+    now[0] = 51.0                                # 11 s since the re-arm
+    assert dog.check() and dog.stalls == 1       # real hangs still fire
+
+  def test_watchdog_thread_start_stop(self):
+    dog = StallWatchdog(0.01).start(poll_s=0.005)
+    assert dog.running
+    dog.stop()
+    assert not dog.running
+
+  def test_preemption_guard_signal_roundtrip(self):
+    guard = PreemptionGuard(signals=(signal.SIGTERM,))
+    before = signal.getsignal(signal.SIGTERM)
+    with guard:
+      assert not guard.requested.is_set()
+      signal.raise_signal(signal.SIGTERM)        # handled, not fatal
+      assert guard.requested.is_set()
+    assert signal.getsignal(signal.SIGTERM) is before
+
+  def test_poison_batch_only_floats(self):
+    batch = {"x": np.ones((2, 2), np.float32), "i": np.arange(3)}
+    bad = TrainFaultSource.poison_batch(batch)
+    assert np.isnan(bad["x"]).all()
+    np.testing.assert_array_equal(bad["i"], batch["i"])
+
+
+# -- the crash-safe loop, in process --------------------------------------
+
+
+def _batch(epoch: int, i: int):
+  rng = np.random.default_rng([11, epoch, i])
+  pose = np.eye(4, dtype=np.float32)
+  pose[0, 3] = 0.04
+  k = np.array([[8, 0, 8], [0, 8, 8], [0, 0, 1]], np.float32)
+  return {
+      "net_input": rng.uniform(
+          -1, 1, (1, HW, HW, 3 + 3 * PLANES)).astype(np.float32),
+      "ref_img": rng.uniform(-1, 1, (1, HW, HW, 3)).astype(np.float32),
+      "tgt_img": rng.uniform(-1, 1, (1, HW, HW, 3)).astype(np.float32),
+      "tgt_img_cfw": np.stack([pose]),
+      "ref_img_wfc": np.stack([np.eye(4, dtype=np.float32)]),
+      "intrinsics": np.stack([k]),
+      "mpi_planes": np.linspace(1.0, 0.01, PLANES, dtype=np.float32),
+  }
+
+
+def _epoch(e):
+  return [_batch(e, i) for i in range(4)]
+
+
+@pytest.fixture(scope="module")
+def tiny():
+  """One tiny state + compiled step shared by the loop tests."""
+  state = tloop.create_train_state(
+      jax.random.PRNGKey(0), num_planes=PLANES, image_size=(HW, HW),
+      norm=None, learning_rate=1e-3, mutable_lr=True)
+  return state, tloop.make_train_step(vgg_params=None)
+
+
+def _params_equal(a, b):
+  jax.tree.map(lambda x, y: np.testing.assert_array_equal(
+      np.asarray(x), np.asarray(y)), a, b)
+
+
+class TestFitResumable:
+
+  def test_mutable_lr_surgery(self, tiny):
+    state, _ = tiny
+    assert tloop.current_learning_rate(state) == pytest.approx(1e-3)
+    cut = tloop.set_learning_rate(state, 5e-4)
+    assert tloop.current_learning_rate(cut) == pytest.approx(5e-4)
+    fixed = tloop.create_train_state(
+        jax.random.PRNGKey(0), num_planes=PLANES, image_size=(HW, HW),
+        norm=None)
+    assert tloop.current_learning_rate(fixed) is None
+    with pytest.raises(ValueError, match="mutable_lr"):
+      tloop.set_learning_rate(fixed, 1e-4)
+
+  def test_soft_crash_then_resume_is_bit_exact(self, tiny, tmp_path):
+    state, step = tiny
+    clean, r_clean = tloop.fit_resumable(
+        state, 3, _epoch, CheckpointStore(str(tmp_path / "clean")),
+        step=step, save_every=2, resume="never")
+    assert r_clean["final_step"] == 12 and len(r_clean["losses"]) == 12
+
+    faults = TrainFaultSource().at_step(7, TrainFault("crash"))
+    store = CheckpointStore(str(tmp_path / "crash"),
+                            fault_hook=faults.store_hook)
+    with pytest.raises(SimulatedCrash):
+      tloop.fit_resumable(state, 3, _epoch, store, step=step,
+                          save_every=2, resume="never",
+                          fault_source=faults)
+    resumed, report = tloop.fit_resumable(
+        state, 3, _epoch, CheckpointStore(str(tmp_path / "crash")),
+        step=step, save_every=2, resume="auto")
+    assert report["resumed_from"] == 6
+    assert report["final_step"] == 12
+    _params_equal(clean.params, resumed.params)
+    _params_equal(clean.opt_state, resumed.opt_state)
+
+  def test_resume_must_raises_on_empty_store(self, tiny, tmp_path):
+    state, step = tiny
+    with pytest.raises(FileNotFoundError, match="resume='must'"):
+      tloop.fit_resumable(state, 1, _epoch,
+                          CheckpointStore(str(tmp_path)), step=step,
+                          resume="must")
+
+  def test_nan_batch_rolls_back_and_cuts_lr(self, tiny, tmp_path):
+    state, step = tiny
+    faults = TrainFaultSource().at_step(5, TrainFault("nan"))
+    guard = NanGuard(lr_cut=0.5)
+    out, report = tloop.fit_resumable(
+        state, 3, _epoch, CheckpointStore(str(tmp_path)), step=step,
+        save_every=2, resume="never", fault_source=faults,
+        nan_guard=guard)
+    assert faults.injected["nan"] == 1
+    assert report["nan_rollbacks"] == 1
+    assert report["final_step"] == 12            # finished despite the NaN
+    assert all(np.isfinite(report["losses"]))
+    assert tloop.current_learning_rate(out) == pytest.approx(5e-4)
+
+  def test_repeated_nan_compounds_the_lr_cut(self, tiny, tmp_path):
+    """A second NaN during the replay must cut from the ALREADY-cut LR
+    (the rollback save persists the cut), not retry the same LR."""
+    state, step = tiny
+    faults = (TrainFaultSource()
+              .at_step(5, TrainFault("nan"))
+              .at_step(6, TrainFault("nan")))
+    guard = NanGuard(lr_cut=0.5, max_rollbacks=3)
+    out, report = tloop.fit_resumable(
+        state, 3, _epoch, CheckpointStore(str(tmp_path)), step=step,
+        save_every=2, resume="never", fault_source=faults, nan_guard=guard)
+    assert report["nan_rollbacks"] == 2
+    assert report["final_step"] == 12
+    assert tloop.current_learning_rate(out) == pytest.approx(2.5e-4)
+
+  def test_nan_without_guard_fails_fast(self, tiny, tmp_path):
+    state, step = tiny
+    faults = TrainFaultSource().at_step(2, TrainFault("nan"))
+    with pytest.raises(NonFiniteLossError):
+      tloop.fit_resumable(state, 1, _epoch,
+                          CheckpointStore(str(tmp_path)), step=step,
+                          resume="never", fault_source=faults)
+
+  def test_preempt_fault_saves_and_resume_completes(self, tiny, tmp_path):
+    state, step = tiny
+    clean, _ = tloop.fit_resumable(
+        state, 3, _epoch, CheckpointStore(str(tmp_path / "clean")),
+        step=step, resume="never")
+    faults = TrainFaultSource().at_step(6, TrainFault("preempt"))
+    store_dir = str(tmp_path / "pre")
+    out, report = tloop.fit_resumable(
+        state, 3, _epoch, CheckpointStore(store_dir), step=step,
+        resume="never", fault_source=faults)
+    assert report["preempted"] and report["final_step"] == 6
+    resumed, r2 = tloop.fit_resumable(
+        state, 3, _epoch, CheckpointStore(store_dir), step=step,
+        resume="auto")
+    assert r2["resumed_from"] == 6 and not r2["preempted"]
+    _params_equal(clean.params, resumed.params)
+
+  def test_corrupted_checkpoint_falls_back_and_stays_bit_exact(
+      self, tiny, tmp_path):
+    """The acceptance pin, in process: the newest checkpoint is corrupted
+    by a scheduled corrupt-write fault; resume quarantines it, falls back
+    to the previous good one, and still reaches the bit-identical end
+    state (the replayed steps are deterministic)."""
+    state, step = tiny
+    clean, _ = tloop.fit_resumable(
+        state, 3, _epoch, CheckpointStore(str(tmp_path / "clean")),
+        step=step, save_every=2, resume="never")
+    # Crash at step 7 AND corrupt the step-6 checkpoint (save index 3:
+    # initial, step2, step4, step6 — the step-4 epoch-boundary save
+    # dedupes into the periodic save on the same step).
+    faults = (TrainFaultSource()
+              .at_step(7, TrainFault("crash"))
+              .at_save(3, TrainFault("corrupt")))
+    store_dir = str(tmp_path / "crash")
+    with pytest.raises(SimulatedCrash):
+      tloop.fit_resumable(
+          state, 3, _epoch,
+          CheckpointStore(store_dir, fault_hook=faults.store_hook),
+          step=step, save_every=2, resume="never", fault_source=faults)
+    assert faults.injected["corrupt"] == 1
+    store = CheckpointStore(store_dir)
+    resumed, report = tloop.fit_resumable(
+        state, 3, _epoch, store, step=step, save_every=2, resume="auto")
+    assert report["quarantined"] == 1            # step 6 was quarantined
+    assert report["resumed_from"] == 4           # previous good one
+    assert report["final_step"] == 12
+    _params_equal(clean.params, resumed.params)
+    _params_equal(clean.opt_state, resumed.opt_state)
+    assert os.path.isdir(os.path.join(store_dir, "quarantine"))
+
+  def test_hang_fault_trips_watchdog(self, tiny, tmp_path):
+    state, step = tiny
+    fired = []
+    faults = TrainFaultSource().at_step(2, TrainFault("hang", seconds=0.2))
+    dog = StallWatchdog(0.05, on_stall=fired.append).start(poll_s=0.01)
+    out, report = tloop.fit_resumable(
+        state, 1, _epoch, CheckpointStore(str(tmp_path)), step=step,
+        resume="never", fault_source=faults, watchdog=dog)
+    assert report["final_step"] == 4             # hang delayed, not killed
+    assert dog.stalls >= 1 and fired
+
+  def test_slow_make_batches_does_not_trip_watchdog(self, tiny, tmp_path):
+    # The first epoch's make_batches does the scene walk + dataset
+    # build eagerly — host work between beats, bracketed like
+    # checkpoint I/O rather than paged as a device hang.
+    state, step = tiny
+    fired = []
+
+    def slow_epoch(e):
+      time.sleep(0.2)
+      return _epoch(e)
+
+    dog = StallWatchdog(0.05, on_stall=fired.append).start(poll_s=0.01)
+    out, report = tloop.fit_resumable(
+        state, 1, slow_epoch, CheckpointStore(str(tmp_path)), step=step,
+        resume="never", watchdog=dog)
+    assert report["final_step"] == 4
+    assert dog.stalls == 0 and not fired
+
+  def test_slow_on_epoch_does_not_trip_watchdog(self, tiny, tmp_path):
+    # The CLI hangs a validation pass off on_epoch; it runs between
+    # beats, so a pass longer than the stall timeout must be bracketed
+    # by the same suspension as checkpoint I/O — not paged as a hang.
+    state, step = tiny
+    fired = []
+    dog = StallWatchdog(0.05, on_stall=fired.append).start(poll_s=0.01)
+    out, report = tloop.fit_resumable(
+        state, 1, _epoch, CheckpointStore(str(tmp_path)), step=step,
+        resume="never", watchdog=dog,
+        on_epoch=lambda *a: time.sleep(0.2))
+    assert report["final_step"] == 4
+    assert dog.stalls == 0 and not fired
+
+
+# -- checkpoint -> serve bridge -------------------------------------------
+
+
+class TestCkptToServe:
+
+  @pytest.fixture(scope="class")
+  def trained_store(self, tmp_path_factory):
+    root = str(tmp_path_factory.mktemp("ckpt_serve"))
+    state = tloop.create_train_state(
+        jax.random.PRNGKey(0), num_planes=PLANES, image_size=(HW, HW),
+        norm=None, learning_rate=1e-3, mutable_lr=True)
+    step = tloop.make_train_step(vgg_params=None)
+    meta = {"model": {"num_planes": PLANES, "img_size": HW, "norm": None,
+                      "compute_dtype": None, "depth_near": 1.0,
+                      "depth_far": 100.0}}
+    state, _ = tloop.fit_resumable(
+        state, 1, _epoch, CheckpointStore(root), step=step,
+        resume="never", meta=meta)
+    return root, state
+
+  def test_scenes_from_checkpoint(self, trained_store):
+    from mpi_vision_tpu.ckpt.export import scenes_from_checkpoint
+
+    root, state = trained_store
+    scenes, info = scenes_from_checkpoint(root, scenes=2)
+    assert len(scenes) == 2 and info["step"] == 4
+    ids = set()
+    for sid, rgba, depths, k in scenes:
+      ids.add(sid)
+      assert rgba.shape == (HW, HW, PLANES, 4)
+      assert depths.shape == (PLANES,) and k.shape == (3, 3)
+      assert np.isfinite(rgba).all()
+      assert info["params_digest"][:8] in sid    # version-addressed ids
+    assert len(ids) == 2
+
+  def test_restored_params_match_trained(self, trained_store):
+    from mpi_vision_tpu.ckpt.export import restore_params
+
+    root, state = trained_store
+    restored, meta, step = restore_params(root)
+    assert step == 4 and meta["num_planes"] == PLANES
+    _params_equal(state.params, restored.params)
+
+  def test_render_service_serves_ckpt_scenes(self, trained_store):
+    from mpi_vision_tpu.ckpt.export import scenes_from_checkpoint
+    from mpi_vision_tpu.serve import RenderService
+
+    root, _ = trained_store
+    scenes, _ = scenes_from_checkpoint(root, scenes=1)
+    with RenderService(max_batch=2, max_wait_ms=0.5,
+                       resilience=None) as svc:
+      for sid, rgba, depths, k in scenes:
+        svc.add_scene(sid, rgba, depths, k)
+      img = svc.render(scenes[0][0], np.eye(4, dtype=np.float32))
+      assert img.shape == (HW, HW, 3) and np.isfinite(img).all()
+      assert svc.cache.stats()["misses"] == 1
+
+  def test_missing_checkpoint_raises(self, tmp_path):
+    from mpi_vision_tpu.ckpt.export import restore_params
+
+    with pytest.raises(FileNotFoundError, match="no restorable"):
+      restore_params(str(tmp_path))

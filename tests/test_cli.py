@@ -68,13 +68,46 @@ def test_unknown_command_exits():
 
 
 def test_train_ckpt_overwrite(tmp_path, capsys):
-  """Re-running with the same --ckpt path must not crash (orbax force)."""
+  """Re-running with the same --ckpt path must not crash (resume='never'
+  clears the previous run's published checkpoints via store.clear())."""
   argv = ["train", "--synthetic", "--synthetic-scenes", "2",
           "--img-size", "32", "--num-planes", "4", "--epochs", "1",
           "--no-vgg-loss", "--ckpt", str(tmp_path / "ckpt")]
   assert cli.main(argv) == 0
   assert cli.main(argv) == 0
   capsys.readouterr()
+
+
+@pytest.mark.parametrize("argv", [
+    ["train", "--synthetic", "--resume"],
+    ["train", "--synthetic", "--save-every", "5", "--keep", "2"],
+    ["train", "--synthetic", "--no-nan-guard"],
+    ["serve", "--ckpt-scenes", "3"],
+    ["serve", "--ckpt-dataset", "/data/re10k"],
+])
+def test_ckpt_flags_without_ckpt_are_rejected(argv):
+  """Dangling checkpoint flags must fail loudly, not silently take the
+  non-checkpoint path (train: no crash safety; serve: synthetic scenes
+  instead of the trained MPIs)."""
+  with pytest.raises(SystemExit, match=r"require\(s\) --ckpt"):
+    cli.main(argv)
+
+
+def test_negative_save_every_rejected(tmp_path):
+  with pytest.raises(SystemExit, match="--save-every must be >= 0"):
+    cli.main(["train", "--synthetic", "--save-every", "-3",
+              "--ckpt", str(tmp_path / "ckpt")])
+
+
+def test_ckpt_scenes_below_one_rejected(tmp_path):
+  with pytest.raises(SystemExit, match="--ckpt-scenes must be >= 1"):
+    cli.main(["serve", "--ckpt", str(tmp_path), "--ckpt-scenes", "0"])
+
+
+def test_keep_below_one_rejected(tmp_path):
+  with pytest.raises(SystemExit, match="--keep must be >= 1"):
+    cli.main(["train", "--synthetic", "--keep", "0",
+              "--ckpt", str(tmp_path / "ckpt")])
 
 
 def test_train_zero_epochs_errors(capsys):

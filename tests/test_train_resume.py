@@ -1,0 +1,126 @@
+"""The kill-and-resume acceptance pin (tier-1, CPU, real SIGKILL).
+
+``bench/train_resume.py`` trains a tiny model through the ckpt/
+lifecycle in a SUBPROCESS and dies by actual SIGKILL (the fault source
+kills its own process) mid-epoch — no atexit, no finally, exactly what
+a preempted VM does. The pins:
+
+  * resume from the newest manifest reproduces the uninterrupted run's
+    final checkpoint BIT-IDENTICALLY (digest over params + optimizer
+    state + step, read back from disk);
+  * a checkpoint corrupted by the scheduled corrupt-write fault is
+    quarantined on resume, the run falls back to the previous good one,
+    and STILL lands on the bit-identical digest;
+  * the one-process ``--selftest`` (SimulatedCrash variant) agrees.
+
+All victim runs share one env (CPU platform, tunneled backends
+neutralized) so their digests are comparable; the independent first
+wave runs concurrently to keep the tier-1 bill down.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "bench", "train_resume.py")
+
+# Tiny run shape: 3 epochs x 4 batches, save every 2 steps. Step 7 is
+# mid-epoch-1 (between the step-6 and step-8 saves); save index 3 is the
+# step-6 checkpoint (initial, 2, 4, 6 — the epoch-boundary saves dedupe
+# into the periodic saves that land on the same steps).
+COMMON = ["--epochs", "3", "--batches", "4", "--save-every", "2",
+          "--seed", "0"]
+CRASH_STEP = "7"
+CORRUPT_SAVE = "3"
+
+
+def _env():
+  env = dict(os.environ)
+  env["JAX_PLATFORMS"] = "cpu"
+  env.pop("PALLAS_AXON_POOL_IPS", None)
+  return env
+
+
+def _spawn(*args):
+  return subprocess.Popen(
+      [sys.executable, SCRIPT, *COMMON, *args],
+      stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+      env=_env(), cwd=REPO)
+
+
+def _finish(proc, timeout=600):
+  out, err = proc.communicate(timeout=timeout)
+  return proc.returncode, out, err
+
+
+def _json_line(out: str, err: str) -> dict:
+  lines = [ln for ln in out.strip().splitlines() if ln.startswith("{")]
+  assert lines, f"no JSON line:\nstdout={out!r}\nstderr={err[-2000:]}"
+  return json.loads(lines[-1])
+
+
+def test_sigkill_midepoch_resume_is_bit_exact(tmp_path):
+  """SIGKILL at step 7 of 12 (mid-epoch), resume, compare digests —
+  plus the corrupted-checkpoint fallback variant, in one pass."""
+  base_dir = str(tmp_path / "baseline")
+  kill_dir = str(tmp_path / "killed")
+  rot_dir = str(tmp_path / "killed_corrupt")
+
+  # Wave 1 — three independent runs, concurrently: the uninterrupted
+  # baseline, a SIGKILL victim, and a SIGKILL victim whose newest
+  # pre-crash checkpoint (step 6) gets corrupted by the fault injector.
+  baseline = _spawn("--dir", base_dir, "--fresh")
+  killed = _spawn("--dir", kill_dir, "--fresh", "--crash-at", CRASH_STEP)
+  rotted = _spawn("--dir", rot_dir, "--fresh", "--crash-at", CRASH_STEP,
+                  "--corrupt-save", CORRUPT_SAVE)
+  rc_base, out_base, err_base = _finish(baseline)
+  rc_kill, _, err_kill = _finish(killed)
+  rc_rot, _, err_rot = _finish(rotted)
+
+  assert rc_base == 0, err_base[-2000:]
+  base = _json_line(out_base, err_base)
+  assert base["value"] == 12 and base["digest"]
+
+  # A hard kill: the process must have died by SIGKILL, printing nothing.
+  assert rc_kill == -signal.SIGKILL, (rc_kill, err_kill[-2000:])
+  assert rc_rot == -signal.SIGKILL, (rc_rot, err_rot[-2000:])
+  # ... and left a published checkpoint behind (atomic saves survived).
+  assert any(n.startswith("step_") for n in os.listdir(kill_dir))
+
+  # Wave 2 — resume both victims.
+  res_kill = _spawn("--dir", kill_dir)
+  res_rot = _spawn("--dir", rot_dir)
+  rc1, out1, err1 = _finish(res_kill)
+  rc2, out2, err2 = _finish(res_rot)
+  assert rc1 == 0, err1[-2000:]
+  assert rc2 == 0, err2[-2000:]
+  resumed = _json_line(out1, err1)
+  rot = _json_line(out2, err2)
+
+  # Clean kill: resumed from the newest save (step 6), bit-identical end.
+  assert resumed["resumed_from"] == 6
+  assert resumed["value"] == 12
+  assert resumed["digest"] == base["digest"], (
+      "SIGKILL-then-resume diverged from the uninterrupted run")
+
+  # Corrupted newest checkpoint: quarantined, fell back to step 4,
+  # STILL bit-identical (replayed steps are deterministic).
+  assert rot["quarantined"] == 1
+  assert rot["resumed_from"] == 4
+  assert rot["digest"] == base["digest"], (
+      "corrupt-fallback resume diverged from the uninterrupted run")
+  assert os.path.isdir(os.path.join(rot_dir, "quarantine"))
+
+
+def test_train_resume_selftest_smoke(tmp_path):
+  """The one-process --selftest (SimulatedCrash + resume) stays green —
+  the cheap canary that fails first if the resume contract breaks."""
+  proc = _spawn("--selftest")
+  rc, out, err = _finish(proc)
+  assert rc == 0, err[-2000:]
+  res = _json_line(out, err)
+  assert res["metric"] == "train_resume_selftest" and res["value"] == 1
+  assert res["bit_exact"] is True and res["resumed_from"] == 6

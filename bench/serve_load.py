@@ -34,6 +34,14 @@ rounds.
 names covering the request path; ``--trace --dry`` is the tier-1 smoke
 pinning the span tree end to end.
 
+``--cluster`` measures the multi-host tier instead: spawn N real backend
+processes (``serve/cluster.BackendPool``), route closed-loop traffic
+through a ``Router`` (consistent-hash placement, per-backend breakers),
+and — unless ``--no-cluster-kill`` — SIGKILL one backend mid-window as a
+chaos phase, so the JSON records failover behavior (reroutes, breaker
+isolation, post-kill throughput) next to the usual serving numbers.
+``--cluster --dry`` is the tier-1 smoke.
+
 Usage: python bench/serve_load.py [--duration 10] [--concurrency 8] ...
 """
 
@@ -86,6 +94,18 @@ def build_parser() -> argparse.ArgumentParser:
                   help="trace every request (obs.Tracer) and report the "
                        "trace accounting + slowest-exemplar span names "
                        "in the JSON")
+  ap.add_argument("--cluster", action="store_true",
+                  help="measure the multi-host tier: spawn backend "
+                       "processes, route through serve/cluster.Router, "
+                       "and (default) SIGKILL one backend mid-window")
+  ap.add_argument("--cluster-backends", type=int, default=3,
+                  help="backend processes to spawn (--cluster)")
+  ap.add_argument("--cluster-replication", type=int, default=2,
+                  help="ring replication factor (--cluster)")
+  ap.add_argument("--cluster-kill", action=argparse.BooleanOptionalAction,
+                  default=True,
+                  help="SIGKILL the hottest scene's primary backend at "
+                       "half the measured window (--cluster)")
   return ap
 
 
@@ -118,6 +138,116 @@ def random_pose(rng: np.random.Generator) -> np.ndarray:
   return pose
 
 
+def cluster_main(args) -> int:
+  """The --cluster measurement: real backend processes, routed traffic,
+  and a kill-a-backend chaos phase. One JSON line like the in-process
+  path, plus a ``cluster`` block (failovers, breaker isolation,
+  per-backend forwards, post-kill throughput)."""
+  from mpi_vision_tpu.serve.cluster import BackendPool, Router
+
+  env = dict(os.environ)
+  env.setdefault("JAX_PLATFORMS", "cpu")  # N local procs share one box
+  pool = BackendPool(
+      args.cluster_backends, scenes=args.scenes, img_size=args.img_size,
+      planes=args.num_planes, seed=args.seed, env=env, log=_log)
+  try:
+    _log(f"serve_load: spawning {args.cluster_backends} backend(s) "
+         f"[{args.scenes} scenes {args.img_size}x{args.img_size}"
+         f"x{args.num_planes}]")
+    backends = pool.start()
+    # Quick breaker so the kill phase shows isolation INSIDE the window:
+    # a couple of failed forwards open the dead backend's circuit and
+    # traffic stops probing the corpse.
+    router = Router(backends, replication=args.cluster_replication,
+                    breaker_threshold=2, breaker_reset_s=60.0,
+                    render_timeout_s=60.0)
+    ids = pool.scene_ids()
+    victim = router.placement(ids[0])[0] if args.cluster_kill else None
+
+    stop = threading.Event()
+    counts = [0] * args.concurrency
+    post_kill_counts = [0] * args.concurrency
+    killed = threading.Event()
+    failure_counts: collections.Counter = collections.Counter()
+    failure_lock = threading.Lock()
+
+    def worker(idx: int) -> None:
+      rng = np.random.default_rng(args.seed + 1 + idx)
+      while not stop.is_set():
+        sid = ids[0] if (rng.random() < 0.5 or len(ids) == 1) \
+            else ids[int(rng.integers(1, len(ids)))]
+        body = json.dumps({"scene_id": sid,
+                           "pose": random_pose(rng).tolist()}).encode()
+        try:
+          status, _, _ = router.forward_render(sid, body)
+        except Exception as e:  # noqa: BLE001 - chaos is the workload
+          with failure_lock:
+            failure_counts[type(e).__name__] += 1
+          time.sleep(0.005)
+          continue
+        if status != 200:
+          with failure_lock:
+            failure_counts[f"http_{status}"] += 1
+          continue
+        counts[idx] += 1
+        if killed.is_set():
+          post_kill_counts[idx] += 1
+
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+               for i in range(args.concurrency)]
+    t0 = time.perf_counter()
+    for t in threads:
+      t.start()
+    if victim is not None:
+      time.sleep(args.duration / 2)
+      pool.kill(victim)
+      killed.set()
+      _log(f"serve_load: killed backend {victim} at half-window "
+           f"(scenes fail over to replicas)")
+      time.sleep(args.duration / 2)
+    else:
+      time.sleep(args.duration)
+    stop.set()
+    for t in threads:
+      t.join(60)
+    elapsed = time.perf_counter() - t0
+
+    total = sum(counts)
+    if total == 0:
+      raise SystemExit("serve_load: no requests completed in the window")
+    snap = router.metrics.snapshot()
+    health = router.healthz()
+    breakers = {b: snap["state"] for b, snap in health["breakers"].items()}
+    rps = total / elapsed
+    record = {
+        "metric": "serve_load",
+        "value": round(rps, 3),
+        "unit": "renders/s",
+        "renders_per_sec": round(rps, 3),
+        "requests": total,
+        "concurrency": args.concurrency,
+        "dry": bool(args.dry),
+        "chaos": False,
+        "cluster": {
+            "backends": len(backends),
+            "replication": args.cluster_replication,
+            "killed": victim,
+            "post_kill_requests": sum(post_kill_counts),
+            "failovers": snap["failovers"],
+            "replica_exhausted": snap["replica_exhausted"],
+            "breaker_fastfails": snap["breaker_fastfails"],
+            "forwards": snap["forwards"],
+            "breakers": breakers,
+            "health": health["status"],
+            "failed_requests": dict(sorted(failure_counts.items())),
+        },
+    }
+    print(json.dumps(record))
+    return 0
+  finally:
+    pool.close()
+
+
 def main(argv=None) -> int:
   args = build_parser().parse_args(argv)
   if os.environ.get("SERVE_LOAD_DRY", "") not in ("", "0", "false"):
@@ -128,6 +258,11 @@ def main(argv=None) -> int:
     args.scenes = min(args.scenes, 2)
     args.img_size = min(args.img_size, 32)
     args.num_planes = min(args.num_planes, 4)
+    args.cluster_backends = min(args.cluster_backends, 3)
+  if args.cluster:
+    if args.dry:
+      args.duration = max(args.duration, 4.0)  # give the kill phase room
+    return cluster_main(args)
 
   from mpi_vision_tpu.serve import (
       FaultyEngine,

@@ -20,6 +20,9 @@ survive crashes, corruption, and preemption:
     above is testable on CPU in tier-1 (mirrors ``serve/faultinject``).
   * ``export``      — checkpoint -> baked MPI scenes for the ``serve``
     CLI (``serve --ckpt``), closing the train -> serve loop.
+  * ``watch``       — ``CheckpointWatcher``: poll the store for a newly
+    published step and fire a reload callback (live train -> serve:
+    ``serve --ckpt --reload-ckpt-s N`` swaps scenes without a restart).
 """
 
 from mpi_vision_tpu.ckpt.faultinject import (
@@ -40,9 +43,11 @@ from mpi_vision_tpu.ckpt.store import (
     flatten_arrays,
     unflatten_arrays,
 )
+from mpi_vision_tpu.ckpt.watch import CheckpointWatcher
 
 __all__ = [
     "CheckpointStore",
+    "CheckpointWatcher",
     "CorruptCheckpointError",
     "NanGuard",
     "NonFiniteLossError",

@@ -113,6 +113,7 @@ def restore_params(ckpt_dir: str, log=None):
 
 def scenes_from_checkpoint(ckpt_dir: str, dataset_path: str | None = None,
                            scenes: int = 2, prefix: str = "ckpt",
+                           stable_ids: bool = False,
                            log=None) -> tuple[list[tuple], dict]:
   """Render-ready scenes from a checkpoint's forward pass.
 
@@ -124,6 +125,12 @@ def scenes_from_checkpoint(ckpt_dir: str, dataset_path: str | None = None,
     scenes: examples (= scenes) to bake, drawn from the test split's
       fixed triplets (deterministic: same checkpoint -> same scenes).
     prefix: scene-id prefix.
+    stable_ids: scene ids are ``{prefix}_{i}`` instead of embedding the
+      step + params digest. Live checkpoint reload (``--reload-ckpt-s``)
+      needs this: the new step's scenes must SWAP IN under the ids
+      clients already hold (``RenderService.swap_scenes``), not appear
+      beside the stale ones under fresh names. The step/digest stay
+      available in ``info`` for logging.
     log: optional diagnostics sink.
 
   Returns:
@@ -171,7 +178,8 @@ def scenes_from_checkpoint(ckpt_dir: str, dataset_path: str | None = None,
       pred = state.apply_fn({"params": state.params},
                             jnp.asarray(example["net_input"])[None])
       rgba = mpi_from_net_output(pred, jnp.asarray(example["ref_img"])[None])
-      scene_id = f"{prefix}_{ckpt_step}_{digest[:8]}_{i:03d}"
+      scene_id = (f"{prefix}_{i:03d}" if stable_ids
+                  else f"{prefix}_{ckpt_step}_{digest[:8]}_{i:03d}")
       out.append((scene_id, np.asarray(rgba[0], np.float32), depths,
                   np.asarray(example["intrinsics"], np.float32)))
       say(f"serve: baked {scene_id} from checkpoint step {ckpt_step}")

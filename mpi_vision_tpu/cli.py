@@ -16,7 +16,13 @@ export cell 18). These commands make the same flow scriptable:
     ``/healthz``, ``/stats``, ``/metrics``, ``/debug/traces``,
     ``/debug/profile``) over synthetic scenes, a baked PNG MPI
     (``--mpi-dir``), or MPIs predicted by a trained checkpoint
-    (``--ckpt``, the train -> serve bridge).
+    (``--ckpt``, the train -> serve bridge; ``--reload-ckpt-s`` keeps
+    watching the store and live-swaps scenes on new publishes).
+  * ``cluster`` — run the multi-host routing tier (serve/cluster/): a
+    consistent-hash, replication-aware router over a pool of serve
+    backends (``--backends N`` spawns a local pool; ``--join`` fronts
+    existing hosts) with per-backend circuit breakers, failover, and
+    aggregated ``/stats`` + ``/metrics`` + ``/healthz``.
 
 All print a one-line JSON summary on stdout (diagnostics on stderr).
 """
@@ -33,6 +39,15 @@ import time
 
 def _log(msg: str) -> None:
   print(msg, file=sys.stderr, flush=True)
+
+
+def _write_port_file(path: str, port: int) -> None:
+  """Atomic write (tmp + rename): a supervisor polling the file must
+  never read a half-written port number."""
+  tmp_path = path + ".tmp"
+  with open(tmp_path, "w") as fh:
+    fh.write(str(port))
+  os.replace(tmp_path, path)
 
 
 def cmd_train(args: argparse.Namespace) -> dict:
@@ -329,7 +344,8 @@ def cmd_serve(args: argparse.Namespace) -> dict:
     # scenes instead would drop the trained MPIs the user asked for.
     wants_ckpt = [flag for flag, on in (
         ("--ckpt-scenes", args.ckpt_scenes is not None),
-        ("--ckpt-dataset", bool(args.ckpt_dataset))) if on]
+        ("--ckpt-dataset", bool(args.ckpt_dataset)),
+        ("--reload-ckpt-s", args.reload_ckpt_s > 0)) if on]
     if wants_ckpt:
       raise SystemExit(f"{', '.join(wants_ckpt)} require(s) --ckpt <dir>")
   if args.ckpt_scenes is not None and args.ckpt_scenes < 1:
@@ -356,7 +372,8 @@ def cmd_serve(args: argparse.Namespace) -> dict:
       max_wait_ms=args.max_wait_ms, method=args.method, use_mesh=use_mesh,
       max_queue=args.max_queue, resilience=resilience,
       cpu_fallback=args.cpu_fallback, tracer=tracer,
-      profile_dir=args.profile_dir or None)
+      profile_dir=args.profile_dir or None,
+      metrics_ttl_s=args.metrics_ttl_ms / 1e3)
   if args.mpi_dir:
     from mpi_vision_tpu.core.camera import intrinsics_matrix, inv_depths
     from mpi_vision_tpu.viewer import export
@@ -369,20 +386,50 @@ def cmd_serve(args: argparse.Namespace) -> dict:
     svc.add_scene(scene_id, mpi,
                   np.asarray(inv_depths(args.near, args.far, p)), k)
     _log(f"serve: loaded MPI scene {scene_id!r} [{h}x{w}x{p}]")
+  watcher = None
   if args.ckpt:
     # The train -> serve bridge (ROADMAP): restore the checkpoint, run
-    # the forward pass, bake the predicted MPIs as scenes.
+    # the forward pass, bake the predicted MPIs as scenes. With
+    # --reload-ckpt-s the ids are STABLE across steps so later reloads
+    # swap scenes in place under the ids clients already hold.
     from mpi_vision_tpu.ckpt.export import scenes_from_checkpoint
 
+    live_reload = args.reload_ckpt_s > 0
+    n_ckpt_scenes = args.ckpt_scenes if args.ckpt_scenes is not None else 2
     ckpt_scenes, ckpt_info = scenes_from_checkpoint(
         os.path.abspath(args.ckpt),
         dataset_path=args.ckpt_dataset or None,
-        scenes=args.ckpt_scenes if args.ckpt_scenes is not None else 2,
-        log=_log)
+        scenes=n_ckpt_scenes, stable_ids=live_reload, log=_log)
     for sid, rgba, depths, k in ckpt_scenes:
       svc.add_scene(sid, rgba, depths, k)
     _log(f"serve: {len(ckpt_scenes)} scene(s) from checkpoint step "
          f"{ckpt_info['step']} (params {ckpt_info['params_digest'][:8]})")
+    if live_reload:
+      # Live train -> serve: watch the store, re-bake, swap in place —
+      # in-flight requests finish on the scenes they already hold
+      # (ckpt/watch.py + RenderService.swap_scenes). Reload failures
+      # log-and-retry; the previous scenes keep serving.
+      from mpi_vision_tpu.ckpt import CheckpointStore, CheckpointWatcher
+
+      store = CheckpointStore(os.path.abspath(args.ckpt))
+
+      def _reload(step: int) -> None:
+        new_scenes, new_info = scenes_from_checkpoint(
+            os.path.abspath(args.ckpt),
+            dataset_path=args.ckpt_dataset or None,
+            scenes=n_ckpt_scenes, stable_ids=True, log=_log)
+        swapped = svc.swap_scenes(
+            {sid: (rgba, depths, k)
+             for sid, rgba, depths, k in new_scenes}, prebake=True)
+        _log(f"serve: live-reloaded {len(swapped)} scene(s) from "
+             f"checkpoint step {new_info['step']} "
+             f"(params {new_info['params_digest'][:8]})")
+
+      watcher = CheckpointWatcher(
+          store, _reload, poll_s=args.reload_ckpt_s,
+          initial_step=ckpt_info["step"], log=_log).start()
+      _log(f"serve: watching {args.ckpt} for new checkpoints every "
+           f"{args.reload_ckpt_s:g}s")
   if not args.mpi_dir and not args.ckpt:
     ids = svc.add_synthetic_scenes(
         args.scenes, height=args.img_size, width=args.img_size,
@@ -394,9 +441,19 @@ def cmd_serve(args: argparse.Namespace) -> dict:
     # Pay the compiles before traffic, not inside request latencies.
     svc.warmup()
     _log("serve: warm-up done (all batch buckets compiled)")
+  if args.prebake_fallback > 0:
+    warm = svc.prebake_fallback(args.prebake_fallback)
+    if warm:
+      _log(f"serve: pre-baked {len(warm)} fallback scene(s) "
+           f"({', '.join(warm)})")
+    else:
+      _log("serve: --prebake-fallback ignored (no fallback engine; "
+           "see --cpu-fallback/--resilience)")
 
   httpd = make_http_server(svc, host=args.host, port=args.port)
   port = httpd.server_address[1]
+  if args.port_file:
+    _write_port_file(args.port_file, port)
 
   # Graceful shutdown: containers send SIGTERM and expect in-flight
   # requests to drain, not a hard kill mid-render. The handlers only set
@@ -431,6 +488,8 @@ def cmd_serve(args: argparse.Namespace) -> dict:
   try:
     stop_event.wait(args.duration if args.duration > 0 else None)
   finally:
+    if watcher is not None:
+      watcher.stop()
     httpd.shutdown()  # stop accepting; in-flight handler threads finish
     stats = svc.stats()
     health = svc.healthz()
@@ -459,7 +518,111 @@ def cmd_serve(args: argparse.Namespace) -> dict:
       **({"ckpt_step": ckpt_info["step"],
           "ckpt_params_digest": ckpt_info["params_digest"][:16]}
          if args.ckpt else {}),
+      **({"ckpt_reload": watcher.snapshot()} if watcher is not None else {}),
   }
+
+
+def cmd_cluster(args: argparse.Namespace) -> dict:
+  import signal
+  import threading
+
+  from mpi_vision_tpu.obs import Tracer
+  from mpi_vision_tpu.serve.cluster import (
+      BackendPool,
+      Router,
+      make_router_http_server,
+  )
+
+  if bool(args.backends) == bool(args.join):
+    raise SystemExit(
+        "cluster needs exactly one of --backends N (spawn a local pool) "
+        "or --join host:port,... (front existing backends)")
+
+  pool = None
+  try:
+    if args.backends:
+      extra = []
+      if args.backend_args:
+        extra = args.backend_args.split()
+      pool = BackendPool(
+          args.backends, scenes=args.scenes, img_size=args.img_size,
+          planes=args.num_planes, host="127.0.0.1", extra_args=extra,
+          log=_log)
+      _log(f"cluster: spawning {args.backends} local backend(s) "
+           f"[{args.scenes} scenes {args.img_size}x{args.img_size}"
+           f"x{args.num_planes}]")
+      backends = pool.start()
+    else:
+      backends = {f"b{i}": addr.strip()
+                  for i, addr in enumerate(args.join.split(","))
+                  if addr.strip()}
+      if not backends:
+        raise SystemExit(f"--join parsed no addresses from {args.join!r}")
+
+    tracer = Tracer(ring=args.trace_ring) if args.trace else None
+    router = Router(
+        backends, replication=args.replication, vnodes=args.vnodes,
+        breaker_threshold=args.breaker_threshold,
+        breaker_reset_s=args.breaker_reset_s,
+        render_timeout_s=args.render_timeout_s,
+        health_timeout_s=args.health_timeout_s,
+        metrics_ttl_s=args.metrics_ttl_ms / 1e3, tracer=tracer)
+    httpd = make_router_http_server(router, host=args.host, port=args.port)
+    port = httpd.server_address[1]
+    if args.port_file:
+      _write_port_file(args.port_file, port)
+
+    stop_event = threading.Event()
+
+    def _on_signal(signum, frame):  # noqa: ARG001 - stdlib signature
+      stop_event.set()
+      try:
+        _log(f"cluster: received {signal.Signals(signum).name}; "
+             "shutting down")
+      except Exception:  # noqa: BLE001 - e.g. reentrant stderr write
+        pass
+
+    previous_handlers = {}
+    for sig in (signal.SIGTERM, signal.SIGINT):
+      try:
+        previous_handlers[sig] = signal.signal(sig, _on_signal)
+      except (ValueError, OSError):
+        pass
+
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    placement_note = (f"scene_000={router.placement('scene_000')}"
+                      if args.backends else "")
+    _log(f"cluster: router listening on http://{args.host}:{port} "
+         f"(/render, /healthz, /stats, /metrics, /debug/traces) over "
+         f"{len(backends)} backend(s), replication {args.replication}"
+         + (f"; {placement_note}" if placement_note else ""))
+
+    t0 = time.time()
+    try:
+      stop_event.wait(args.duration if args.duration > 0 else None)
+    finally:
+      httpd.shutdown()
+      router.close()
+      for sig, handler in previous_handlers.items():
+        signal.signal(sig, handler)
+      _log("cluster: router closed")
+
+    snap = router.metrics.snapshot()
+    return {
+        "command": "cluster",
+        "host": args.host,
+        "port": port,
+        "backends": {b: addr for b, addr in sorted(backends.items())},
+        "replication": args.replication,
+        "seconds": round(time.time() - t0, 1),
+        "router": snap,
+        **({"traces": tracer.finished} if tracer is not None else {}),
+    }
+  finally:
+    if pool is not None:
+      pool.close()
+      _log("cluster: local backend pool closed")
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -543,6 +706,10 @@ def build_parser() -> argparse.ArgumentParser:
   s.add_argument("--host", default="127.0.0.1")
   s.add_argument("--port", type=int, default=8080,
                  help="HTTP port (0 = ephemeral; logged on stderr)")
+  s.add_argument("--port-file", default="",
+                 help="write the bound port here (atomic tmp+rename) once "
+                      "listening — how a supervisor (cluster BackendPool) "
+                      "learns an ephemeral port without parsing stderr")
   s.add_argument("--duration", type=float, default=0.0,
                  help="seconds to serve; <= 0 runs until interrupted")
   s.add_argument("--scenes", type=int, default=4,
@@ -563,6 +730,11 @@ def build_parser() -> argparse.ArgumentParser:
                  help="RealEstate10K-layout root feeding the --ckpt "
                       "forward pass (default: procedural synthetic); "
                       "requires --ckpt")
+  s.add_argument("--reload-ckpt-s", type=float, default=0.0,
+                 help="poll --ckpt for a newly published step every this "
+                      "many seconds and live-swap the baked scenes "
+                      "without dropping in-flight requests (stable scene "
+                      "ids; <= 0 disables; requires --ckpt)")
   s.add_argument("--prefix", default="rgba_")
   s.add_argument("--near", type=float, default=1.0)
   s.add_argument("--far", type=float, default=100.0)
@@ -605,6 +777,11 @@ def build_parser() -> argparse.ArgumentParser:
                  choices=("auto", "on", "off"),
                  help="degraded-mode CPU engine while the breaker is open "
                       "(auto: only when the primary is not CPU)")
+  s.add_argument("--prebake-fallback", type=int, default=0,
+                 help="pre-bake this many scenes onto the CPU fallback at "
+                      "startup so the first breaker-open render does not "
+                      "pay a cold bake (0 = bake lazily on first degraded "
+                      "request)")
   s.add_argument("--trace", action=argparse.BooleanOptionalAction,
                  default=True,
                  help="record per-request span trees (X-Trace-Id header, "
@@ -618,7 +795,65 @@ def build_parser() -> argparse.ArgumentParser:
   s.add_argument("--profile-dir", default="",
                  help="enable /debug/profile?seconds=N device captures "
                       "(jax.profiler) into this TensorBoard logdir")
+  s.add_argument("--metrics-ttl-ms", type=float, default=250.0,
+                 help="memoize the /metrics exposition string this long "
+                      "(scrape storms cost one snapshot render per "
+                      "window; <= 0 renders per scrape)")
   s.set_defaults(fn=cmd_serve)
+
+  c = sub.add_parser(
+      "cluster",
+      help="run the multi-host routing tier (serve/cluster/): a scene-"
+           "sharded router over a pool of serve backends")
+  c.add_argument("--backends", type=int, default=0,
+                 help="spawn this many local backend processes "
+                      "(tests/demos; production backends run one per "
+                      "host and --join instead)")
+  c.add_argument("--join", default="",
+                 help="comma-separated host:port list of EXISTING serve "
+                      "backends to front (mutually exclusive with "
+                      "--backends)")
+  c.add_argument("--backend-args", default="",
+                 help="extra argv appended to every spawned backend's "
+                      "serve command (--backends mode only)")
+  c.add_argument("--host", default="127.0.0.1")
+  c.add_argument("--port", type=int, default=8070,
+                 help="router HTTP port (0 = ephemeral)")
+  c.add_argument("--port-file", default="",
+                 help="write the router's bound port here once listening")
+  c.add_argument("--duration", type=float, default=0.0,
+                 help="seconds to serve; <= 0 runs until interrupted")
+  c.add_argument("--replication", type=int, default=2,
+                 help="backends per scene on the consistent-hash ring "
+                      "(failover targets = replication - 1)")
+  c.add_argument("--vnodes", type=int, default=64,
+                 help="ring points per backend (balance smoothness)")
+  c.add_argument("--scenes", type=int, default=4,
+                 help="synthetic scenes per spawned backend (identical "
+                      "across the pool; --backends mode only)")
+  c.add_argument("--img-size", type=int, default=256)
+  c.add_argument("--num-planes", type=int, default=16)
+  c.add_argument("--breaker-threshold", type=int, default=3,
+                 help="consecutive per-backend failures that open that "
+                      "backend's circuit")
+  c.add_argument("--breaker-reset-s", type=float, default=10.0,
+                 help="per-backend open-circuit cooldown before the "
+                      "half-open probe")
+  c.add_argument("--render-timeout-s", type=float, default=120.0,
+                 help="per-attempt forward timeout (worst-case request "
+                      "latency = replication x this)")
+  c.add_argument("--health-timeout-s", type=float, default=2.0,
+                 help="per-backend budget for aggregated /healthz and "
+                      "/stats fan-outs")
+  c.add_argument("--metrics-ttl-ms", type=float, default=250.0,
+                 help="memoize the aggregated /metrics exposition this "
+                      "long (one pool fan-out per window)")
+  c.add_argument("--trace", action=argparse.BooleanOptionalAction,
+                 default=True,
+                 help="router-side request traces (W3C trace ids shared "
+                      "with backend traces via outbound traceparent)")
+  c.add_argument("--trace-ring", type=int, default=256)
+  c.set_defaults(fn=cmd_cluster)
   return ap
 
 

@@ -23,8 +23,10 @@ per-stage accounting, not a single end-to-end number:
 
 from mpi_vision_tpu.obs.profile import DeviceProfiler, ProfileBusyError
 from mpi_vision_tpu.obs.prom import (
+    ExpositionCache,
     Metric,
     Registry,
+    aggregate_metrics_texts,
     parse_metrics_text,
     render_serve_metrics,
     serve_registry,

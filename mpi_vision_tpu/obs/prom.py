@@ -19,6 +19,8 @@ from __future__ import annotations
 
 import dataclasses
 import math
+import threading
+import time
 
 _TYPES = ("counter", "gauge", "histogram")
 
@@ -230,6 +232,101 @@ def render_serve_metrics(stats: dict,
                          latency_hist: dict | None = None) -> str:
   """The ``/metrics`` response body for one stats snapshot."""
   return serve_registry(stats, latency_hist).render()
+
+
+class ExpositionCache:
+  """Memoize a rendered exposition string for a short TTL.
+
+  ``/metrics`` renders a full snapshot per scrape — cheap for one
+  Prometheus at 15 s intervals, not for an aggregated cluster endpoint
+  that fans out to every backend per scrape (ROADMAP obs follow-on). A
+  ~250 ms TTL bounds staleness well below any real scrape interval while
+  collapsing scrape storms to one render per window.
+
+  The render runs under the lock, so concurrent scrapes inside one
+  window cost exactly one render (the rest return the cached string).
+  ``ttl_s <= 0`` disables caching entirely. The clock is injectable —
+  the serve/-wide rule (tests pin freshness/staleness with fake clocks).
+  """
+
+  def __init__(self, render_fn, ttl_s: float = 0.25, clock=time.monotonic):
+    self._render_fn = render_fn
+    self.ttl_s = float(ttl_s)
+    self._clock = clock
+    self._lock = threading.Lock()
+    self._text: str | None = None
+    self._rendered_at = 0.0
+    self.renders = 0
+    self.cache_hits = 0
+
+  def get(self) -> str:
+    with self._lock:
+      now = self._clock()
+      if (self.ttl_s > 0 and self._text is not None
+          and now - self._rendered_at < self.ttl_s):
+        self.cache_hits += 1
+        return self._text
+      text = self._render_fn()
+      self.renders += 1
+      self._text = text
+      self._rendered_at = now
+      return text
+
+  def invalidate(self) -> None:
+    """Drop the cached string (the next ``get`` re-renders)."""
+    with self._lock:
+      self._text = None
+
+
+def aggregate_metrics_texts(texts, extra: "Registry | None" = None) -> str:
+  """Sum several Prometheus expositions into one (the cluster /metrics).
+
+  Every sample with the same ``(family, sample name, labels)`` key is
+  summed across inputs — the right aggregation for counters and
+  histograms, and for the gauges this stack exports (queue depths and
+  cache bytes add; the breaker one-hot becomes "backends per state";
+  ``uptime_seconds`` becomes total backend-seconds). Families keep
+  first-seen order and HELP/TYPE text; ``extra`` (e.g. the router's own
+  registry) is appended verbatim after the aggregated families.
+
+  Dead backends simply contribute nothing — aggregated counters dip when
+  a backend is lost, which is itself the signal (the router's
+  ``mpi_cluster_backend_up`` gauge says which one).
+  """
+  order: list[str] = []
+  fams: dict[str, dict] = {}
+  for text in texts:
+    for name, fam in parse_metrics_text(text).items():
+      agg = fams.get(name)
+      if agg is None:
+        agg = fams[name] = {"type": fam["type"], "help": fam["help"],
+                            "samples": {}, "order": []}
+        order.append(name)
+      for key, value in fam["samples"].items():
+        if key not in agg["samples"]:
+          agg["samples"][key] = 0.0
+          agg["order"].append(key)
+        agg["samples"][key] += value
+  lines = []
+  for name in order:
+    fam = fams[name]
+    if fam["help"]:
+      lines.append(f"# HELP {name} {fam['help']}")
+    if fam["type"]:
+      lines.append(f"# TYPE {name} {fam['type']}")
+    for sample_name, labels in fam["order"]:
+      label_str = ""
+      if labels:
+        inner = ",".join(f'{k}="{_escape_label(str(v))}"'
+                         for k, v in labels)
+        label_str = "{" + inner + "}"
+      lines.append(
+          f"{sample_name}{label_str} "
+          f"{format_value(fam['samples'][(sample_name, labels)])}")
+  out = "\n".join(lines) + ("\n" if lines else "")
+  if extra is not None:
+    out += extra.render()
+  return out
 
 
 def parse_metrics_text(text: str) -> dict:

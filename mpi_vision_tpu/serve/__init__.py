@@ -19,6 +19,11 @@ The observability layer (``mpi_vision_tpu.obs``) rides the same path:
 per-request span trees (X-Trace-Id, ``/debug/traces``), Prometheus text
 exposition (``/metrics``), and on-demand device profiling
 (``/debug/profile``) — see the README's Observability section.
+
+The multi-host tier lives in the ``cluster`` subpackage (imported as
+``mpi_vision_tpu.serve.cluster``, not re-exported here): a scene-sharded
+``Router`` with per-backend circuit breakers and failover over a pool of
+these serve processes — ``python -m mpi_vision_tpu cluster``.
 """
 
 from mpi_vision_tpu.obs import DeviceProfiler, ProfileBusyError, Tracer

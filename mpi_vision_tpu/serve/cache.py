@@ -82,6 +82,7 @@ class SceneCache:
     self.hits = 0
     self.misses = 0
     self.evictions = 0
+    self.invalidations = 0
 
   def get(self, scene_id: str) -> BakedScene | None:
     with self._lock:
@@ -111,6 +112,19 @@ class SceneCache:
     self.put(scene)
     return scene
 
+  def invalidate(self, scene_id: str) -> bool:
+    """Drop one baked scene (live checkpoint reload: the scene's host
+    data changed, so the next request must re-bake). Requests already
+    holding the old ``BakedScene`` finish on it — device buffers free
+    once the last reference drops. Returns whether the id was resident."""
+    with self._lock:
+      scene = self._scenes.pop(scene_id, None)
+      if scene is None:
+        return False
+      self._bytes -= scene.nbytes
+      self.invalidations += 1
+      return True
+
   def _evict_locked(self) -> None:
     while self._bytes > self.byte_budget and len(self._scenes) > 1:
       _, evicted = self._scenes.popitem(last=False)
@@ -135,5 +149,6 @@ class SceneCache:
           "hits": self.hits,
           "misses": self.misses,
           "evictions": self.evictions,
+          "invalidations": self.invalidations,
           "hit_rate": (self.hits / lookups) if lookups else None,
       }

@@ -1,0 +1,41 @@
+"""Multi-host serving cluster: scene-sharded routing over serve backends.
+
+The tier above one serve process (ROADMAP: "extend the engine beyond one
+process"): ``ring`` places scenes on backends by consistent hashing with
+configurable replication, ``router`` fronts the pool with health-aware
+forwarding, per-backend circuit breakers, failover, outbound W3C
+``traceparent`` propagation, and aggregated ``/stats`` + ``/metrics`` +
+``/healthz``; ``pool`` supervises local child backends so the whole tier
+is testable and benchable on one CPU box (``python -m mpi_vision_tpu
+cluster``; ``bench/serve_load.py --cluster``). Live checkpoint reload
+rides the backends themselves (``serve --ckpt --reload-ckpt-s N``,
+``ckpt.watch.CheckpointWatcher``) — the router needs no coordination to
+benefit: scenes swap in place under the same ids.
+"""
+
+from mpi_vision_tpu.serve.cluster.pool import BackendPool, BackendSpawnError
+from mpi_vision_tpu.serve.cluster.ring import HashRing
+from mpi_vision_tpu.serve.cluster.router import (
+    AllReplicasOpenError,
+    HttpTransport,
+    ReplicasExhaustedError,
+    Router,
+    RouterMetrics,
+    make_router_http_server,
+    make_traceparent,
+    new_trace_id_32,
+)
+
+__all__ = [
+    "AllReplicasOpenError",
+    "BackendPool",
+    "BackendSpawnError",
+    "HashRing",
+    "HttpTransport",
+    "ReplicasExhaustedError",
+    "Router",
+    "RouterMetrics",
+    "make_router_http_server",
+    "make_traceparent",
+    "new_trace_id_32",
+]

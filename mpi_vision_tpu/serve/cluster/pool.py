@@ -1,0 +1,259 @@
+"""Local backend supervision: spawn, health-gate, kill, resurrect.
+
+``BackendPool`` runs N real ``python -m mpi_vision_tpu serve`` child
+processes on localhost ephemeral ports — the harness that makes the
+cluster tier testable and benchable on one CPU box. It is deliberately
+a *test/bench* supervisor, not a production one (production runs one
+backend per host under k8s/systemd; the router neither knows nor cares
+who spawned its backends):
+
+  * each backend writes its bound port to a ``--port-file`` (parsing a
+    child's stderr for the listening line is a race, a file rename is
+    not), and the pool gates on ``/healthz`` == ok before declaring it
+    up;
+  * ``kill()`` delivers a real signal (tests use SIGKILL: the backend
+    gets no chance to drain, exactly like a host loss), ``restart()``
+    respawns on the SAME port so the router's breaker sees the backend
+    "come back" at its old address and re-closes through the half-open
+    probe;
+  * every backend serves the SAME synthetic scene set (ids and pixels
+    are a pure function of ``(seed, scene_id)`` — ``synthetic_scene``),
+    which is what makes replica failover return bit-identical pixels.
+
+Time reads go through injectable ``clock``/``sleep`` (the serve/-wide
+lint rule); child stdout/stderr land in per-backend log files under the
+pool's workdir for post-mortems.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+
+class BackendSpawnError(RuntimeError):
+  """A backend failed to come up healthy inside the startup budget."""
+
+
+class _Proc:
+  def __init__(self, backend_id: str, popen, port: int, log_path: str):
+    self.backend_id = backend_id
+    self.popen = popen
+    self.port = port
+    self.log_path = log_path
+
+
+class BackendPool:
+  """Spawn and supervise N local serve backends (tests/bench only).
+
+  Args:
+    n_backends: pool size.
+    scenes / img_size / planes: synthetic scene set every backend
+      serves (identical across the pool — replication needs replicas).
+    host: bind address for the children.
+    env: child environment (default: inherit). Tests pass the hardened
+      CPU-mesh env plus a shared ``JAX_COMPILATION_CACHE_DIR`` so N
+      cold JAX processes start in seconds, not minutes.
+    extra_args: appended to every child's ``serve`` argv (e.g.
+      ``["--no-resilience"]`` or checkpoint flags).
+    workdir: port files + logs (default: a self-cleaning temp dir).
+    startup_timeout_s: per-backend budget to bind + pass /healthz.
+    clock / sleep: injectable time sources.
+    log: diagnostics sink (None = silent).
+  """
+
+  def __init__(self, n_backends: int, scenes: int = 4, img_size: int = 32,
+               planes: int = 4, seed: int = 0, host: str = "127.0.0.1",
+               env: dict | None = None, extra_args=(),
+               workdir: str | None = None, startup_timeout_s: float = 180.0,
+               clock=time.monotonic, sleep=time.sleep, log=None):
+    if n_backends < 1:
+      raise ValueError(f"n_backends must be >= 1, got {n_backends}")
+    self.n_backends = int(n_backends)
+    self.scenes = int(scenes)
+    self.img_size = int(img_size)
+    self.planes = int(planes)
+    self.seed = int(seed)
+    self.host = host
+    self.env = dict(os.environ if env is None else env)
+    # Children run with cwd=workdir: put the package root on PYTHONPATH
+    # so `-m mpi_vision_tpu` resolves without an installed wheel.
+    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))))
+    self.env["PYTHONPATH"] = pkg_root + os.pathsep + self.env.get(
+        "PYTHONPATH", "")
+    self.extra_args = list(extra_args)
+    self._own_workdir = workdir is None
+    self.workdir = workdir or tempfile.mkdtemp(prefix="mpi_cluster_")
+    self.startup_timeout_s = float(startup_timeout_s)
+    self._clock = clock
+    self._sleep = sleep
+    self._log = log if log is not None else (lambda msg: None)
+    self._procs: dict[str, _Proc] = {}
+    self._closed = False
+
+  # -- lifecycle ----------------------------------------------------------
+
+  def scene_ids(self) -> list[str]:
+    """The scene ids every backend serves (server.add_synthetic_scenes)."""
+    return [f"scene_{i:03d}" for i in range(self.scenes)]
+
+  def addresses(self) -> dict[str, str]:
+    """``backend_id -> host:port`` for Router construction."""
+    return {bid: f"{self.host}:{p.port}"
+            for bid, p in sorted(self._procs.items())}
+
+  def start(self) -> dict[str, str]:
+    """Spawn every backend and wait until each passes ``/healthz``.
+
+    Children spawn concurrently (JAX import dominates startup; N
+    sequential imports would multiply it) and then health-gate in
+    order. Returns ``addresses()``.
+    """
+    pending = []
+    for i in range(self.n_backends):
+      backend_id, popen, port_file, log_path = self._spawn(f"b{i}")
+      # Register BEFORE gating: if any gate below fails, close() must be
+      # able to terminate every child already spawned, not orphan them.
+      self._procs[backend_id] = _Proc(backend_id, popen, 0, log_path)
+      pending.append((backend_id, popen, port_file))
+    for backend_id, popen, port_file in pending:
+      port = self._await_port(backend_id, popen, port_file)
+      proc = self._procs[backend_id]
+      proc.port = port
+      self._await_healthy(proc)
+      self._log(f"pool: {backend_id} healthy on {self.host}:{port}")
+    return self.addresses()
+
+  def _spawn(self, backend_id: str, port: int = 0):
+    port_file = os.path.join(self.workdir, f"{backend_id}.port")
+    if os.path.exists(port_file):
+      os.unlink(port_file)
+    log_path = os.path.join(self.workdir, f"{backend_id}.log")
+    argv = [
+        sys.executable, "-m", "mpi_vision_tpu", "serve",
+        "--host", self.host, "--port", str(port),
+        "--port-file", port_file,
+        "--scenes", str(self.scenes),
+        "--img-size", str(self.img_size),
+        "--num-planes", str(self.planes),
+        *self.extra_args,
+    ]
+    log_fh = open(log_path, "ab")
+    try:
+      popen = subprocess.Popen(argv, stdout=log_fh, stderr=log_fh,
+                               env=self.env, cwd=self.workdir)
+    finally:
+      log_fh.close()  # the child holds its own fd now
+    return backend_id, popen, port_file, log_path
+
+  def _await_port(self, backend_id: str, popen, port_file: str) -> int:
+    deadline = self._clock() + self.startup_timeout_s
+    while self._clock() < deadline:
+      if popen.poll() is not None:
+        raise BackendSpawnError(
+            f"{backend_id} exited rc={popen.returncode} before binding "
+            f"(log: {self.tail_log(backend_id)})")
+      if os.path.exists(port_file):
+        try:
+          with open(port_file) as fh:
+            return int(fh.read().strip())
+        except (OSError, ValueError):
+          pass  # written-but-not-renamed race; go around
+      self._sleep(0.05)
+    raise BackendSpawnError(
+        f"{backend_id} did not bind within {self.startup_timeout_s:.0f}s")
+
+  def _await_healthy(self, proc: _Proc) -> None:
+    deadline = self._clock() + self.startup_timeout_s
+    url = f"http://{self.host}:{proc.port}/healthz"
+    while self._clock() < deadline:
+      if proc.popen.poll() is not None:
+        raise BackendSpawnError(
+            f"{proc.backend_id} exited rc={proc.popen.returncode} before "
+            f"healthy (log: {self.tail_log(proc.backend_id)})")
+      try:
+        with urllib.request.urlopen(url, timeout=2.0) as resp:
+          if json.loads(resp.read()).get("status") == "ok":
+            return
+      except (OSError, ValueError):
+        pass
+      self._sleep(0.1)
+    raise BackendSpawnError(
+        f"{proc.backend_id} not healthy within {self.startup_timeout_s:.0f}s "
+        f"(log: {self.tail_log(proc.backend_id)})")
+
+  # -- chaos --------------------------------------------------------------
+
+  def kill(self, backend_id: str, sig: int = signal.SIGKILL) -> None:
+    """Deliver ``sig`` (default SIGKILL: a host loss, no drain) and wait
+    for the process to die."""
+    proc = self._procs[backend_id]
+    proc.popen.send_signal(sig)
+    proc.popen.wait(30)
+    self._log(f"pool: {backend_id} killed with signal {sig}")
+
+  def alive(self, backend_id: str) -> bool:
+    proc = self._procs.get(backend_id)
+    return proc is not None and proc.popen.poll() is None
+
+  def restart(self, backend_id: str) -> str:
+    """Respawn a dead backend on its OLD port (same address, so the
+    router's existing breaker re-closes via its half-open probe rather
+    than needing re-registration). Returns the address."""
+    old = self._procs[backend_id]
+    if old.popen.poll() is None:
+      raise RuntimeError(f"{backend_id} is still running; kill it first")
+    _, popen, port_file, log_path = self._spawn(backend_id, port=old.port)
+    port = self._await_port(backend_id, popen, port_file)
+    proc = _Proc(backend_id, popen, port, log_path)
+    self._procs[backend_id] = proc
+    self._await_healthy(proc)
+    self._log(f"pool: {backend_id} resurrected on {self.host}:{port}")
+    return f"{self.host}:{port}"
+
+  # -- teardown / forensics ----------------------------------------------
+
+  def tail_log(self, backend_id: str, n: int = 2000) -> str:
+    path = (self._procs[backend_id].log_path
+            if backend_id in self._procs else
+            os.path.join(self.workdir, f"{backend_id}.log"))
+    try:
+      with open(path, "rb") as fh:
+        fh.seek(0, os.SEEK_END)
+        size = fh.tell()
+        fh.seek(max(size - n, 0))
+        return fh.read().decode("utf-8", "replace")
+    except OSError:
+      return "<no log>"
+
+  def close(self) -> None:
+    if self._closed:
+      return
+    self._closed = True
+    for proc in self._procs.values():
+      if proc.popen.poll() is None:
+        proc.popen.terminate()
+    deadline = self._clock() + 10.0
+    for proc in self._procs.values():
+      timeout = max(deadline - self._clock(), 0.1)
+      try:
+        proc.popen.wait(timeout)
+      except subprocess.TimeoutExpired:
+        proc.popen.kill()
+        proc.popen.wait(10)
+    if self._own_workdir:
+      shutil.rmtree(self.workdir, ignore_errors=True)
+
+  def __enter__(self):
+    return self
+
+  def __exit__(self, *exc):
+    self.close()

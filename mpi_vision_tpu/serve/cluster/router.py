@@ -1,0 +1,715 @@
+"""Scene-sharded routing front end over a pool of serve backends.
+
+The multi-host serving tier (ROADMAP north star: one process is not
+"heavy traffic from millions of users"). A ``Router`` owns a consistent-
+hash ring (``ring.py``) placing every scene id on ``replication``
+backends, forwards ``/render`` to the scene's primary, and fails over
+down the replica list when a backend is unreachable, times out, or
+answers garbage. Health is tracked **per backend** with one
+``serve.resilience.CircuitBreaker`` each — the PR-2 breaker was global
+per service, and the ROADMAP follow-on is exactly this split: one bad
+host must fast-fail *its* requests onto replicas without degrading the
+fleet. A backend that comes back re-closes its own breaker through the
+standard half-open probe (the next request after the cooldown IS the
+probe).
+
+Cross-host observability: every forwarded request carries an outbound
+W3C ``traceparent`` header built from the router's trace id, and the
+backends already honor inbound traceparent (PR 4) — so one trace id
+resolves to a span tree on the router (``/debug/traces``) AND on the
+backend that served it, stitching the distributed trace end-to-end
+(ROADMAP obs follow-on closed). Aggregated ``/stats``, ``/metrics``
+(summed across the pool + ``mpi_cluster_*`` router families, memoized
+~250 ms), and ``/healthz`` (degraded-not-unhealthy while replicas
+cover for a dead backend) come from the same front end.
+
+Transport is injectable: the default speaks HTTP via urllib; tests
+inject deterministic fakes (malformed-JSON backends, truncated binary,
+connection refusals) without sockets.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import math
+import threading
+import time
+import urllib.error
+import urllib.request
+import uuid
+
+import functools
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from mpi_vision_tpu.obs import prom
+from mpi_vision_tpu.obs.trace import NULL_TRACE, NULL_TRACER, Tracer
+from mpi_vision_tpu.serve.resilience import CircuitBreaker
+from mpi_vision_tpu.serve.cluster.ring import HashRing
+from mpi_vision_tpu.serve.server import _MAX_BODY_BYTES, _inbound_trace_id
+
+
+def new_trace_id_32() -> str:
+  """A 32-hex W3C-sized trace id (the 16-hex in-process ids cannot ride
+  a ``traceparent``, whose trace-id field is exactly 32 hex chars)."""
+  return uuid.uuid4().hex
+
+
+def new_span_id_16() -> str:
+  return uuid.uuid4().hex[:16]
+
+
+def make_traceparent(trace_id: str, span_id: str | None = None) -> str:
+  """A version-00 W3C traceparent carrying ``trace_id`` (sampled flag
+  set — the router only propagates ids it is itself recording)."""
+  return f"00-{trace_id}-{span_id or new_span_id_16()}-01"
+
+
+class AllReplicasOpenError(RuntimeError):
+  """Every replica's breaker refused the request (HTTP 503)."""
+
+  def __init__(self, scene_id: str, retry_after_s: float):
+    self.retry_after_s = max(float(retry_after_s), 0.0)
+    super().__init__(
+        f"all replicas for scene {scene_id!r} have open circuits; "
+        f"retry after {self.retry_after_s:.1f}s")
+
+
+class ReplicasExhaustedError(RuntimeError):
+  """Every replica was tried and failed (HTTP 502)."""
+
+  def __init__(self, scene_id: str, attempts: list[str]):
+    self.attempts = attempts
+    super().__init__(
+        f"all replicas failed for scene {scene_id!r}: " + "; ".join(attempts))
+
+
+class HttpTransport:
+  """The default router->backend transport (stdlib urllib, no deps).
+
+  ``request`` returns ``(status, headers, body)`` for ANY HTTP response
+  (4xx/5xx included — the router decides what a status means) and raises
+  ``ConnectionError`` only when no HTTP conversation happened at all
+  (refused, reset, DNS, timeout) — the signal that the *host*, not the
+  request, is in trouble.
+  """
+
+  def request(self, method: str, url: str, body: bytes | None = None,
+              headers: dict | None = None,
+              timeout: float = 30.0) -> tuple[int, dict, bytes]:
+    req = urllib.request.Request(url, data=body, method=method,
+                                 headers=dict(headers or {}))
+    try:
+      with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, dict(resp.headers.items()), resp.read()
+    except urllib.error.HTTPError as e:
+      # An HTTP-level error IS a response; read it fully so the router
+      # can forward the backend's own error JSON.
+      with e:
+        return e.code, dict(e.headers.items()), e.read()
+    except (urllib.error.URLError, ConnectionError, TimeoutError,
+            OSError, http.client.HTTPException) as e:
+      # HTTPException (BadStatusLine, IncompleteRead, ...) is NOT an
+      # OSError: a half-dead backend writing a garbled status line or
+      # truncating mid-read must look like a dead host (fail over,
+      # breaker counts), not escape as an unclassified exception.
+      raise ConnectionError(str(e.reason if isinstance(
+          e, urllib.error.URLError) else e) or repr(e)) from e
+
+
+class RouterMetrics:
+  """Router-level counters (the backends keep their own ServeMetrics)."""
+
+  def __init__(self, clock=time.monotonic):
+    self._clock = clock
+    self._lock = threading.Lock()
+    self._t0 = clock()
+    self.requests = 0
+    self.forwards: dict[str, int] = {}
+    self.failovers = 0
+    self.bad_responses = 0
+    self.replica_exhausted = 0
+    self.breaker_fastfails = 0
+    self.breaker_opens = 0
+    self.bad_requests = 0
+
+  def record_request(self) -> None:
+    with self._lock:
+      self.requests += 1
+
+  def record_forward(self, backend_id: str) -> None:
+    with self._lock:
+      self.forwards[backend_id] = self.forwards.get(backend_id, 0) + 1
+
+  def record_failover(self) -> None:
+    with self._lock:
+      self.failovers += 1
+
+  def record_bad_response(self) -> None:
+    with self._lock:
+      self.bad_responses += 1
+
+  def record_replica_exhausted(self) -> None:
+    with self._lock:
+      self.replica_exhausted += 1
+
+  def record_breaker_fastfail(self) -> None:
+    with self._lock:
+      self.breaker_fastfails += 1
+
+  def record_breaker_open(self) -> None:
+    with self._lock:
+      self.breaker_opens += 1
+
+  def record_bad_request(self) -> None:
+    with self._lock:
+      self.bad_requests += 1
+
+  def snapshot(self) -> dict:
+    with self._lock:
+      return {
+          "uptime_s": round(max(self._clock() - self._t0, 0.0), 3),
+          "requests": self.requests,
+          "forwards": dict(sorted(self.forwards.items())),
+          "failovers": self.failovers,
+          "bad_responses": self.bad_responses,
+          "replica_exhausted": self.replica_exhausted,
+          "breaker_fastfails": self.breaker_fastfails,
+          "breaker_opens": self.breaker_opens,
+          "bad_requests": self.bad_requests,
+      }
+
+
+class _Backend:
+  """One pool member: address + its own breaker + contact bookkeeping."""
+
+  def __init__(self, backend_id: str, address: str, breaker: CircuitBreaker):
+    self.backend_id = backend_id
+    self.address = address  # host:port
+    self.breaker = breaker
+
+  @property
+  def base_url(self) -> str:
+    return f"http://{self.address}"
+
+  def snapshot(self) -> dict:
+    return {
+        "address": self.address,
+        "breaker": self.breaker.snapshot(),
+    }
+
+
+class Router:
+  """Scene-sharded, health-aware request routing over serve backends.
+
+  Args:
+    backends: mapping ``backend_id -> "host:port"`` (or an iterable of
+      addresses, ids auto-assigned ``b0..bN``).
+    replication / vnodes: ring knobs (``ring.HashRing``).
+    breaker_threshold / breaker_reset_s: per-backend circuit breaker
+      (``serve.resilience.CircuitBreaker`` — consecutive transport-level
+      failures open it; backend-*answered* errors like 404 never count).
+    render_timeout_s: per-attempt forward timeout; a request tries at
+      most ``replication`` attempts, so worst-case latency is bounded by
+      ``replication * render_timeout_s``.
+    health_timeout_s: per-backend budget for aggregated /healthz and
+      /stats fan-outs (a dead backend must cost one short timeout, not
+      hang the probe).
+    metrics_ttl_s: aggregated-exposition cache TTL (scrape storms fan
+      out to the pool once per window, not once per scrape).
+    tracer: optional ``obs.Tracer``; router traces use 32-hex W3C trace
+      ids so the SAME id appears in the backend's recorded trace.
+    transport: injectable request transport (tests); default urllib.
+    clock: one injectable monotonic base for breakers, metrics, and the
+      exposition cache.
+  """
+
+  def __init__(self, backends=None, replication: int = 2, vnodes: int = 64,
+               breaker_threshold: int = 3, breaker_reset_s: float = 10.0,
+               render_timeout_s: float = 120.0,
+               health_timeout_s: float = 2.0, metrics_ttl_s: float = 0.25,
+               tracer: Tracer | None = None, transport=None,
+               clock=time.monotonic):
+    self.replication = int(replication)
+    self.breaker_threshold = int(breaker_threshold)
+    self.breaker_reset_s = float(breaker_reset_s)
+    self.render_timeout_s = float(render_timeout_s)
+    self.health_timeout_s = float(health_timeout_s)
+    self.tracer = tracer if tracer is not None else NULL_TRACER
+    self.transport = transport if transport is not None else HttpTransport()
+    self._clock = clock
+    self.metrics = RouterMetrics(clock=clock)
+    self._lock = threading.Lock()
+    self._backends: dict[str, _Backend] = {}
+    self._ring = HashRing(vnodes=vnodes, replication=replication)
+    self._metrics_cache = prom.ExpositionCache(
+        self._render_metrics_text, ttl_s=metrics_ttl_s, clock=clock)
+    self._closed = False
+    if backends:
+      items = (backends.items() if isinstance(backends, dict)
+               else ((f"b{i}", addr) for i, addr in enumerate(backends)))
+      for backend_id, address in items:
+        self.add_backend(backend_id, address)
+
+  # -- membership ---------------------------------------------------------
+
+  def add_backend(self, backend_id: str, address: str) -> None:
+    backend_id, address = str(backend_id), str(address)
+    with self._lock:
+      if backend_id in self._backends:
+        raise ValueError(f"backend {backend_id!r} already registered")
+      breaker = CircuitBreaker(
+          failure_threshold=self.breaker_threshold,
+          reset_after_s=self.breaker_reset_s, clock=self._clock,
+          on_transition=lambda old, new: (
+              self.metrics.record_breaker_open()
+              if new == CircuitBreaker.OPEN else None))
+      self._backends[backend_id] = _Backend(backend_id, address, breaker)
+      self._ring.add(backend_id)
+
+  def remove_backend(self, backend_id: str) -> None:
+    with self._lock:
+      self._backends.pop(str(backend_id), None)
+      self._ring.remove(str(backend_id))
+
+  def backend_ids(self) -> list[str]:
+    with self._lock:
+      return sorted(self._backends)
+
+  def placement(self, scene_id: str) -> list[str]:
+    """The scene's replica set (backend ids, primary first) — a pure
+    function of the backend set, identical across router replicas."""
+    with self._lock:
+      return self._ring.placement(str(scene_id))
+
+  def _replicas(self, scene_id: str) -> list[_Backend]:
+    with self._lock:
+      return [self._backends[b] for b in self._ring.placement(str(scene_id))
+              if b in self._backends]
+
+  # -- request path -------------------------------------------------------
+
+  def forward_render(self, scene_id: str, body: bytes,
+                     accept: str | None = None, trace_id: str | None = None,
+                     trace=NULL_TRACE) -> tuple[int, dict, bytes]:
+    """Route one ``/render`` body to the scene's replica set.
+
+    Walks the placement list primary-first, skipping backends whose
+    breaker refuses (an ``allow_primary()`` True from a non-closed
+    breaker IS the half-open probe; its outcome re-closes or re-opens
+    that backend's circuit). Transport failures, 5xx statuses, and
+    malformed response bodies count against the backend's breaker and
+    fail over to the next replica; a backend that *answers* with 4xx is
+    healthy — its response is returned as-is and its breaker resets.
+
+    Returns ``(status, headers, body)`` of the winning response.
+    Raises ``AllReplicasOpenError`` (-> 503 + Retry-After) when every
+    breaker refused, ``ReplicasExhaustedError`` (-> 502) when every
+    attempt failed, ``KeyError`` when the ring is empty.
+    """
+    self.metrics.record_request()
+    replicas = self._replicas(scene_id)
+    if not replicas:
+      raise KeyError("no backends registered")
+    trace_id = trace_id or new_trace_id_32()
+    headers = {
+        "Content-Type": "application/json",
+        "traceparent": make_traceparent(trace_id),
+    }
+    if accept:
+      headers["Accept"] = accept
+    attempts: list[str] = []
+    retry_afters: list[float] = []
+    tried_any = False
+    for backend in replicas:
+      if not backend.breaker.allow_primary():
+        retry_afters.append(backend.breaker.retry_after_s())
+        continue
+      if tried_any:
+        self.metrics.record_failover()
+      tried_any = True
+      span = trace.start_span("forward", backend=backend.backend_id,
+                              address=backend.address)
+      outcome_recorded = False
+      try:
+        try:
+          status, resp_headers, resp_body = self.transport.request(
+              "POST", backend.base_url + "/render", body=body,
+              headers=headers, timeout=self.render_timeout_s)
+        except ConnectionError as e:
+          backend.breaker.record_failure()
+          outcome_recorded = True
+          attempts.append(f"{backend.backend_id}: unreachable ({e})")
+          trace.end_span(span, error=f"unreachable: {e}")
+          continue
+        if status >= 500:
+          backend.breaker.record_failure()
+          outcome_recorded = True
+          attempts.append(f"{backend.backend_id}: HTTP {status}")
+          trace.end_span(span, error=f"HTTP {status}")
+          continue
+        if status == 200:
+          reason = self._validate_render_body(resp_headers, resp_body)
+          if reason is not None:
+            # A 200 carrying garbage is a sick backend (half-dead
+            # process, truncating proxy): never forward it — the client
+            # gets a clean 502 or a replica's good pixels, and the
+            # garbage counts toward THIS backend's breaker.
+            backend.breaker.record_failure()
+            outcome_recorded = True
+            self.metrics.record_bad_response()
+            attempts.append(f"{backend.backend_id}: bad body ({reason})")
+            trace.end_span(span, error=f"bad body: {reason}")
+            continue
+        backend.breaker.record_success()
+        outcome_recorded = True
+        self.metrics.record_forward(backend.backend_id)
+        trace.end_span(span, status=status)
+        resp_headers = dict(resp_headers)
+        resp_headers["X-Backend-Id"] = backend.backend_id
+        return status, resp_headers, resp_body
+      finally:
+        if not outcome_recorded:
+          # An unexpected exception in the router itself says nothing
+          # about the backend: free a claimed half-open probe slot so
+          # the breaker cannot wedge in HALF_OPEN.
+          backend.breaker.release_probe()
+    if not tried_any:
+      self.metrics.record_breaker_fastfail()
+      raise AllReplicasOpenError(
+          scene_id, min(retry_afters) if retry_afters else 0.0)
+    self.metrics.record_replica_exhausted()
+    raise ReplicasExhaustedError(scene_id, attempts)
+
+  @staticmethod
+  def _validate_render_body(headers: dict, body: bytes) -> str | None:
+    """Why a 200 response body is unusable, or None when it checks out.
+
+    Cheap structural checks only (no base64 decode of megapixels): JSON
+    parses to an object with the response contract's keys and a b64
+    payload whose LENGTH matches the shape; binary bodies match their
+    shape headers byte-for-byte. Catches truncation (killed backend,
+    broken proxy) and non-JSON garbage.
+    """
+    ctype = ""
+    for key, value in headers.items():
+      if key.lower() == "content-type":
+        ctype = value
+        break
+    if "application/octet-stream" in ctype:
+      shape_hdr = next((v for k, v in headers.items()
+                        if k.lower() == "x-image-shape"), "")
+      try:
+        shape = [int(d) for d in shape_hdr.split(",")]
+        want = 4  # <f4 itemsize
+        for d in shape:
+          want *= d
+      except ValueError:
+        return f"unparseable X-Image-Shape {shape_hdr!r}"
+      if not shape or want <= 0:
+        return f"degenerate X-Image-Shape {shape_hdr!r}"
+      if len(body) != want:
+        return f"binary body is {len(body)} bytes, shape says {want}"
+      return None
+    try:
+      payload = json.loads(body)
+    except (ValueError, UnicodeDecodeError):
+      return "unparseable JSON"
+    if not isinstance(payload, dict):
+      return f"JSON body is {type(payload).__name__}, not an object"
+    missing = {"scene_id", "shape", "image_b64"} - set(payload)
+    if missing:
+      return f"missing keys {sorted(missing)}"
+    try:
+      nbytes = 4
+      for d in payload["shape"]:
+        nbytes *= int(d)
+      want_b64 = 4 * ((nbytes + 2) // 3)
+    except (TypeError, ValueError):
+      return f"unparseable shape {payload['shape']!r}"
+    b64 = payload["image_b64"]
+    if not isinstance(b64, str) or len(b64) != want_b64:
+      got = len(b64) if isinstance(b64, str) else type(b64).__name__
+      return f"image_b64 length {got} != expected {want_b64}"
+    return None
+
+  # -- aggregated observability ------------------------------------------
+
+  def _fan_out_get(self, path: str, timeout: float) -> dict[str, dict]:
+    """GET ``path`` from every backend -> ``{backend_id: result}`` where
+    result is the parsed JSON body or ``{"error": ...}``."""
+    with self._lock:
+      backends = list(self._backends.values())
+    out: dict[str, dict] = {}
+    for backend in backends:
+      try:
+        _, _, body = self.transport.request(
+            "GET", backend.base_url + path, timeout=timeout)
+        payload = json.loads(body)
+        if not isinstance(payload, dict):
+          raise ValueError(f"non-object JSON ({type(payload).__name__})")
+        out[backend.backend_id] = payload
+      except (ConnectionError, ValueError, UnicodeDecodeError) as e:
+        out[backend.backend_id] = {"error": str(e) or repr(e)}
+    return out
+
+  def healthz(self) -> dict:
+    """The aggregated health machine: ok / degraded / unhealthy.
+
+    ``degraded`` — not ``unhealthy`` — while any backend is down or
+    non-ok but at least one backend still answers: replicas are covering
+    (or will fast-fail crisply), and a liveness probe that killed the
+    router over one lost backend would turn a partial outage into a
+    total one. ``unhealthy`` only when the router itself is closed or
+    NO backend is reachable.
+    """
+    per_backend = self._fan_out_get("/healthz", self.health_timeout_s)
+    with self._lock:
+      breakers = {b: be.breaker.snapshot()
+                  for b, be in self._backends.items()}
+    statuses = {b: h.get("status", "unreachable")
+                for b, h in per_backend.items()}
+    reachable = [b for b, h in per_backend.items() if "error" not in h]
+    bad = sorted(b for b, s in statuses.items() if s != "ok")
+    open_breakers = sorted(b for b, s in breakers.items()
+                           if s["state"] != CircuitBreaker.CLOSED)
+    if self._closed:
+      status, reason = "unhealthy", "router closed"
+    elif not per_backend:
+      status, reason = "unhealthy", "no backends registered"
+    elif not reachable:
+      status, reason = "unhealthy", "no backend reachable"
+    elif bad or open_breakers:
+      status = "degraded"
+      parts = []
+      if bad:
+        parts.append(f"backends not ok: {', '.join(bad)}")
+      if open_breakers:
+        parts.append(f"breakers non-closed: {', '.join(open_breakers)}")
+      reason = ("; ".join(parts)
+                + f"; {len(reachable)}/{len(per_backend)} backends "
+                  "serving (replicas cover sharded scenes)")
+    else:
+      status, reason = "ok", None
+    out = {
+        "status": status,
+        "backends": {b: statuses[b] for b in sorted(statuses)},
+        "backends_total": len(per_backend),
+        "backends_reachable": len(reachable),
+        "replication": self.replication,
+        "breakers": {b: breakers[b] for b in sorted(breakers)},
+    }
+    if reason is not None:
+      out["reason"] = reason
+    return out
+
+  def stats(self) -> dict:
+    """Aggregated ``/stats``: the router's own counters + every
+    backend's snapshot (or its fan-out error)."""
+    per_backend = self._fan_out_get("/stats", self.health_timeout_s)
+    with self._lock:
+      backends = {b: be.snapshot() for b, be in self._backends.items()}
+    return {
+        "router": self.metrics.snapshot(),
+        "backend_info": {b: backends[b] for b in sorted(backends)},
+        "backends": {b: per_backend[b] for b in sorted(per_backend)},
+    }
+
+  def _cluster_registry(self) -> prom.Registry:
+    snap = self.metrics.snapshot()
+    with self._lock:
+      backends = list(self._backends.values())
+    reg = prom.Registry()
+    p = "mpi_cluster_"
+    reg.gauge(p + "backends", "Backends registered on the ring.",
+              len(backends))
+    reg.counter(p + "requests_total", "Render requests routed.",
+                snap["requests"])
+    fwd = reg.counter(p + "forwards_total",
+                      "Successful forwards per backend.")
+    for backend_id in sorted(snap["forwards"]):
+      fwd.sample(snap["forwards"][backend_id], {"backend": backend_id})
+    reg.counter(p + "failovers_total",
+                "Attempts that fell over to a replica.", snap["failovers"])
+    reg.counter(p + "bad_responses_total",
+                "200-status backend bodies rejected by validation.",
+                snap["bad_responses"])
+    reg.counter(p + "replica_exhausted_total",
+                "Requests that failed every replica (502).",
+                snap["replica_exhausted"])
+    reg.counter(p + "breaker_fastfails_total",
+                "Requests refused by every replica's breaker (503).",
+                snap["breaker_fastfails"])
+    reg.counter(p + "breaker_opens_total",
+                "Per-backend breaker CLOSED->OPEN transitions.",
+                snap["breaker_opens"])
+    up = reg.gauge(p + "backend_up",
+                   "1 while the backend's breaker is closed.")
+    for backend in sorted(backends, key=lambda b: b.backend_id):
+      up.sample(1 if backend.breaker.state == CircuitBreaker.CLOSED else 0,
+                {"backend": backend.backend_id})
+    return reg
+
+  def _render_metrics_text(self) -> str:
+    texts = []
+    for backend in sorted(self._snapshot_backends(),
+                          key=lambda b: b.backend_id):
+      try:
+        status, _, body = self.transport.request(
+            "GET", backend.base_url + "/metrics",
+            timeout=self.health_timeout_s)
+        if status == 200:
+          texts.append(body.decode("utf-8", "replace"))
+      except ConnectionError:
+        continue  # a dead backend contributes nothing (backend_up says so)
+    return prom.aggregate_metrics_texts(texts, extra=self._cluster_registry())
+
+  def _snapshot_backends(self) -> list[_Backend]:
+    with self._lock:
+      return list(self._backends.values())
+
+  def metrics_text(self) -> str:
+    """Aggregated ``/metrics``: pool-summed ``mpi_serve_*`` families plus
+    the router's ``mpi_cluster_*`` families, memoized ``metrics_ttl_s``."""
+    return self._metrics_cache.get()
+
+  def close(self) -> None:
+    self._closed = True
+
+  def __enter__(self):
+    return self
+
+  def __exit__(self, *exc):
+    self.close()
+
+
+# Response headers forwarded verbatim from the winning backend (plus the
+# router's own X-Trace-Id / X-Backend-Id). Hop-by-hop headers like
+# Content-Length are recomputed by the sender.
+_FORWARD_HEADERS = ("Content-Type", "X-Image-Shape", "X-Image-Dtype",
+                    "X-Scene-Id", "Retry-After")
+
+
+class _RouterHandler(BaseHTTPRequestHandler):
+  """The cluster front door: same endpoint surface as a backend, so a
+  client (or load balancer) cannot tell one process from the fleet."""
+
+  def __init__(self, router: Router, *args, **kwargs):
+    self.router = router
+    super().__init__(*args, **kwargs)
+
+  def log_message(self, fmt, *args):  # noqa: A003 - stdlib signature
+    pass
+
+  def _send_bytes(self, body: bytes, status: int = 200,
+                  content_type: str = "application/json",
+                  extra_headers: dict | None = None) -> None:
+    try:
+      self.send_response(status)
+      headers = dict(extra_headers or {})
+      headers.setdefault("Content-Type", content_type)
+      headers["Content-Length"] = str(len(body))
+      for key, value in headers.items():
+        self.send_header(key, value)
+      self.end_headers()
+      self.wfile.write(body)
+    except (BrokenPipeError, ConnectionResetError):
+      self.close_connection = True
+
+  def _send_json(self, payload: dict, status: int = 200,
+                 extra_headers: dict | None = None) -> None:
+    self._send_bytes(json.dumps(payload).encode(), status=status,
+                     extra_headers=extra_headers)
+
+  def do_GET(self):  # noqa: N802 - stdlib name
+    if self.path == "/healthz":
+      health = self.router.healthz()
+      self._send_json(health,
+                      status=503 if health["status"] == "unhealthy" else 200)
+    elif self.path == "/stats":
+      self._send_json(self.router.stats())
+    elif self.path == "/metrics":
+      self._send_bytes(
+          self.router.metrics_text().encode(),
+          content_type="text/plain; version=0.0.4; charset=utf-8")
+    elif self.path == "/debug/traces":
+      self._send_json(self.router.tracer.snapshot())
+    else:
+      self._send_json({"error": f"unknown path {self.path}"}, status=404)
+
+  def do_POST(self):  # noqa: N802 - stdlib name
+    if self.path != "/render":
+      self._send_json({"error": f"unknown path {self.path}"}, status=404)
+      return
+    inbound_tid = _inbound_trace_id(self.headers)
+    trace_id = inbound_tid or new_trace_id_32()
+    tid_hdr = {"X-Trace-Id": trace_id}
+    try:
+      length = int(self.headers.get("Content-Length", "0"))
+      if not 0 <= length <= _MAX_BODY_BYTES:
+        raise ValueError(f"bad body length ({length} bytes)")
+      body = self.rfile.read(length)
+      req = json.loads(body or b"{}")
+      if not isinstance(req, dict):
+        raise ValueError(
+            f"body must be a JSON object, got {type(req).__name__}")
+      scene_id = req["scene_id"]
+      if not isinstance(scene_id, str):
+        raise ValueError(
+            f"scene_id must be a string, got {type(scene_id).__name__}")
+    except (KeyError, TypeError, ValueError, json.JSONDecodeError) as e:
+      self.router.metrics.record_bad_request()
+      self._send_json({"error": f"bad request: {e}"}, status=400,
+                      extra_headers=tid_hdr)
+      return
+    except (BrokenPipeError, ConnectionResetError):
+      self.close_connection = True
+      return
+    tr = self.router.tracer.start_trace("route", trace_id=trace_id,
+                                        scene_id=scene_id, http=True)
+    try:
+      status, headers, resp_body = self.router.forward_render(
+          scene_id, body, accept=self.headers.get("Accept"),
+          trace_id=trace_id, trace=tr)
+    except KeyError as e:
+      tr.finish(error=repr(e))
+      self._send_json({"error": str(e)}, status=503, extra_headers=tid_hdr)
+      return
+    except AllReplicasOpenError as e:
+      tr.finish(error=repr(e))
+      retry_after = max(1, math.ceil(e.retry_after_s)) if e.retry_after_s \
+          else 1
+      self._send_json(
+          {"error": str(e), "retry_after_s": e.retry_after_s}, status=503,
+          extra_headers={"Retry-After": str(retry_after), **tid_hdr})
+      return
+    except ReplicasExhaustedError as e:
+      tr.finish(error=repr(e))
+      self._send_json({"error": str(e), "attempts": e.attempts},
+                      status=502, extra_headers=tid_hdr)
+      return
+    except Exception as e:  # noqa: BLE001 - the contract is 502, never 500
+      tr.finish(error=repr(e))
+      self._send_json({"error": f"routing failed: {e}"}, status=502,
+                      extra_headers=tid_hdr)
+      return
+    tr.finish()
+    out_headers = dict(tid_hdr)
+    for name in _FORWARD_HEADERS:
+      value = next((v for k, v in headers.items()
+                    if k.lower() == name.lower()), None)
+      if value is not None:
+        out_headers[name] = value
+    if "X-Backend-Id" in headers:
+      out_headers["X-Backend-Id"] = headers["X-Backend-Id"]
+    self._send_bytes(resp_body, status=status, extra_headers=out_headers)
+
+
+def make_router_http_server(router: Router, host: str = "127.0.0.1",
+                            port: int = 0) -> ThreadingHTTPServer:
+  """A ready-to-``serve_forever`` threaded front end (port 0 = ephemeral;
+  the bound port is ``server.server_address[1]``)."""
+  handler = functools.partial(_RouterHandler, router)
+  server = ThreadingHTTPServer((host, port), handler)
+  server.daemon_threads = True
+  return server

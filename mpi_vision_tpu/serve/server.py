@@ -49,6 +49,7 @@ import json
 import math
 import re
 import threading
+import time
 import urllib.parse
 import zlib
 from concurrent.futures import TimeoutError as FuturesTimeoutError
@@ -129,6 +130,12 @@ class RenderService:
       (``obs.profile.DeviceProfiler`` over ``jax.profiler``).
     profiler: explicit profiler override (tests inject fake trace
       contexts); wins over ``profile_dir``.
+    metrics_ttl_s: ``/metrics`` exposition-string cache TTL
+      (``obs.prom.ExpositionCache``) — scrape storms on the aggregated
+      cluster endpoint cost one snapshot render per window instead of
+      one per scrape; <= 0 renders fresh every scrape.
+    clock: injectable monotonic clock for the exposition cache (the
+      serve/-wide rule; scheduler/metrics/tracer carry their own).
   """
 
   def __init__(self, cache_bytes: int = 2 << 30, max_batch: int = 8,
@@ -138,7 +145,8 @@ class RenderService:
                resilience: ResilienceConfig | None = ResilienceConfig(),
                cpu_fallback: str = "auto", fallback_engine=None,
                tracer: Tracer | None = None, profile_dir: str | None = None,
-               profiler: DeviceProfiler | None = None):
+               profiler: DeviceProfiler | None = None,
+               metrics_ttl_s: float = 0.25, clock=time.monotonic):
     if cpu_fallback not in ("auto", "on", "off"):
       raise ValueError(
           f"cpu_fallback must be auto/on/off, got {cpu_fallback!r}")
@@ -176,6 +184,8 @@ class RenderService:
         fallback_scene_provider=(
             self._get_scene_fallback
             if self.fallback_engine is not None else None)).start()
+    self._metrics_cache = prom.ExpositionCache(
+        self._render_metrics_text, ttl_s=metrics_ttl_s, clock=clock)
     self._closed = False
 
   # -- scenes -------------------------------------------------------------
@@ -233,6 +243,54 @@ class RenderService:
 
     return self._fallback_cache.get_or_bake(scene_id, bake)
 
+  def swap_scenes(self, scenes: dict, prebake: bool = False) -> list[str]:
+    """Atomically publish new host data for ``scenes`` (live ckpt reload).
+
+    ``scenes`` maps scene id -> ``(rgba_layers, depths, intrinsics)``.
+    The registry updates first, then the baked caches (primary AND
+    fallback) invalidate the changed ids — so a request that raced the
+    swap serves either the old bake or the new one, never a mix, and no
+    in-flight request is dropped: futures already holding a
+    ``BakedScene`` render it to completion, and the old device buffers
+    free when the last reference drops. ``prebake=True`` re-bakes the
+    swapped scenes immediately so the first post-swap request does not
+    pay the bake either. Returns the swapped ids.
+    """
+    entries = {
+        str(sid): (np.asarray(rgba, np.float32),
+                   np.asarray(depths, np.float32),
+                   np.asarray(k, np.float32))
+        for sid, (rgba, depths, k) in scenes.items()}
+    with self._scene_lock:
+      self._scene_data.update(entries)
+    for sid in entries:
+      self.cache.invalidate(sid)
+      if self._fallback_cache is not None:
+        self._fallback_cache.invalidate(sid)
+    if prebake:
+      for sid in entries:
+        self._get_scene(sid)
+    return sorted(entries)
+
+  def prebake_fallback(self, k: int | None = None,
+                       scene_ids=None) -> list[str]:
+    """Pre-bake the hottest-K scenes onto the degraded-mode CPU engine.
+
+    Without this, the FIRST breaker-open render of each scene pays a
+    cold CPU bake on top of an already-degraded request (ROADMAP
+    resilience follow-on). "Hottest" defaults to registration order
+    (startup has no traffic stats yet); pass ``scene_ids`` to override.
+    No-op (returns []) when there is no fallback engine.
+    """
+    if self.fallback_engine is None:
+      return []
+    ids = list(scene_ids) if scene_ids is not None else self.scene_ids()
+    if k is not None:
+      ids = ids[:max(int(k), 0)]
+    for sid in ids:
+      self._get_scene_fallback(sid)
+    return ids
+
   def warmup(self, scene_ids=None) -> None:
     """Bake scenes (default: all registered) and compile every batch
     bucket up to the scheduler's ``max_batch`` for the first scene's
@@ -271,10 +329,14 @@ class RenderService:
 
   # -- observability ------------------------------------------------------
 
-  def metrics_text(self) -> str:
-    """The ``/metrics`` body: Prometheus text exposition of ``stats()``."""
+  def _render_metrics_text(self) -> str:
     return prom.render_serve_metrics(self.stats(),
                                      self.metrics.latency_histogram())
+
+  def metrics_text(self) -> str:
+    """The ``/metrics`` body: Prometheus text exposition of ``stats()``,
+    memoized for ``metrics_ttl_s`` (scrape storms cost one render)."""
+    return self._metrics_cache.get()
 
   def profile(self, seconds: float) -> dict:
     """Capture a device profile of live traffic (``/debug/profile``)."""

@@ -1,5 +1,5 @@
-"""Lint: serve/, obs/, ckpt/, and the hardened train loop read time only
-through injectable clocks.
+"""Lint: serve/ (cluster/ included), obs/, ckpt/, and the hardened train
+loop read time only through injectable clocks.
 
 Every latency, deadline, span edge, stall measurement, and manifest
 timestamp must come from a clock the caller can inject — that is what
@@ -25,6 +25,7 @@ import re
 import mpi_vision_tpu.ckpt
 import mpi_vision_tpu.obs
 import mpi_vision_tpu.serve
+import mpi_vision_tpu.serve.cluster
 import mpi_vision_tpu.train.loop
 
 _CLOCK_CALL = re.compile(r"\btime\.(time|monotonic|perf_counter)\s*\(")
@@ -36,8 +37,8 @@ def _package_sources(pkg):
 
 
 def _linted_sources():
-  for pkg in (mpi_vision_tpu.serve, mpi_vision_tpu.obs,
-              mpi_vision_tpu.ckpt):
+  for pkg in (mpi_vision_tpu.serve, mpi_vision_tpu.serve.cluster,
+              mpi_vision_tpu.obs, mpi_vision_tpu.ckpt):
     yield from _package_sources(pkg)
   yield pathlib.Path(mpi_vision_tpu.train.loop.__file__)
 
@@ -62,7 +63,9 @@ def test_lint_covers_the_ckpt_package_and_train_loop():
   # test exists to prevent.
   rel = {"/".join(p.parts[-2:]) for p in _linted_sources()}
   assert {"ckpt/store.py", "ckpt/guards.py", "ckpt/faultinject.py",
-          "serve/faultinject.py", "train/loop.py"} <= rel
+          "ckpt/watch.py", "serve/faultinject.py", "train/loop.py",
+          "cluster/router.py", "cluster/ring.py",
+          "cluster/pool.py"} <= rel
 
 
 def test_lint_actually_catches_calls():

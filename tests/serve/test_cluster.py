@@ -1,0 +1,640 @@
+"""serve/cluster tests: ring placement, router failover, the real pool.
+
+Three layers, cheapest first:
+
+  * ``HashRing`` unit tests — placement determinism, replication,
+    minimal movement on resize (the satellite pin: re-placement after a
+    pool resize is a pure function, not an accident of dict order).
+  * ``Router`` tests over an injectable fake transport — per-backend
+    breaker isolation, failover order, the 502-never-500 contract for
+    malformed/truncated backend bodies, resurrection through the
+    half-open probe, aggregated /healthz / /metrics — all deterministic
+    (fake clocks, no sockets except the router's own front end).
+  * The multi-process acceptance test — ≥3 REAL ``serve`` child
+    processes (BackendPool), ≥6 scenes sharded across them,
+    bit-identical routed renders, a SIGKILL mid-load with failover +
+    breaker isolation + degraded-not-unhealthy aggregation, and
+    router->backend trace stitching via the outbound W3C traceparent.
+"""
+
+import base64
+import json
+import os
+import sys
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mpi_vision_tpu.obs import Tracer, parse_metrics_text
+from mpi_vision_tpu.serve.cluster import (
+    AllReplicasOpenError,
+    BackendPool,
+    HashRing,
+    ReplicasExhaustedError,
+    Router,
+    make_router_http_server,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+# --- ring ----------------------------------------------------------------
+
+
+SCENES_100 = [f"scene_{i:03d}" for i in range(100)]
+
+
+def test_ring_placement_deterministic_and_order_free():
+  a = HashRing(["x", "y", "z"], replication=2)
+  b = HashRing(["z", "x", "y"], replication=2)  # insertion order differs
+  for sid in SCENES_100:
+    assert a.placement(sid) == b.placement(sid)
+    assert len(a.placement(sid)) == 2
+    assert len(set(a.placement(sid))) == 2  # replicas are distinct
+
+
+def test_ring_replication_clamped_to_pool_size():
+  ring = HashRing(["only"], replication=3)
+  assert ring.placement("s") == ["only"]
+  assert HashRing([], replication=2).placement("s") == []
+
+
+def test_ring_spreads_scenes_across_backends():
+  ring = HashRing(["a", "b", "c"], replication=1)
+  primaries = {ring.primary(sid) for sid in SCENES_100}
+  assert primaries == {"a", "b", "c"}  # nobody owns everything
+
+
+def test_ring_resize_moves_only_scenes_touching_the_changed_backend():
+  before = HashRing(["a", "b", "c"], replication=2)
+  grown = HashRing(["a", "b", "c", "d"], replication=2)
+  moved = 0
+  for sid in SCENES_100:
+    if "d" not in grown.placement(sid):
+      # Consistent hashing: adding d only remaps scenes d now serves.
+      assert grown.placement(sid) == before.placement(sid)
+    else:
+      moved += 1
+  assert 0 < moved < len(SCENES_100)  # d took some load, not all of it
+  # Removal is exactly the inverse: the survivor ring is bit-identical
+  # to one built without the backend (re-placement is deterministic).
+  shrunk = HashRing(["a", "b", "c", "d"], replication=2)
+  shrunk.remove("d")
+  for sid in SCENES_100:
+    assert shrunk.placement(sid) == before.placement(sid)
+
+
+# --- router over a fake transport ---------------------------------------
+
+
+class FakeTransport:
+  """address -> handler(method, path, body, headers) -> (status, headers,
+  body); raising ConnectionError simulates a dead host. Records calls."""
+
+  def __init__(self):
+    self.handlers = {}
+    self.calls = []
+
+  def set(self, address, handler):
+    self.handlers[address] = handler
+
+  def request(self, method, url, body=None, headers=None, timeout=30.0):
+    assert url.startswith("http://")
+    address, _, path = url[len("http://"):].partition("/")
+    self.calls.append((address, method, "/" + path))
+    return self.handlers[address](method, "/" + path, body, headers or {})
+
+
+def _good_render(scene_id, h=2, w=2, fill=0.5):
+  img = np.full((h, w, 3), fill, np.float32)
+  body = json.dumps({
+      "scene_id": scene_id, "shape": [h, w, 3], "dtype": "<f4",
+      "image_b64": base64.b64encode(img.tobytes()).decode(),
+  }).encode()
+  return 200, {"Content-Type": "application/json"}, body
+
+
+def _dead(method, path, body, headers):
+  raise ConnectionError("connection refused")
+
+
+class FakeClock:
+  def __init__(self, t=100.0):
+    self.t = t
+
+  def __call__(self):
+    return self.t
+
+
+def _two_backend_router(transport, clock=None, threshold=2, reset_s=10.0,
+                        tracer=None):
+  return Router({"a": "hostA:1", "b": "hostB:1"}, replication=2,
+                breaker_threshold=threshold, breaker_reset_s=reset_s,
+                transport=transport,
+                clock=clock if clock is not None else FakeClock(),
+                tracer=tracer)
+
+
+def _scene_with_primary(router, primary):
+  sid = next(s for s in SCENES_100 if router.placement(s)[0] == primary)
+  body = json.dumps({"scene_id": sid, "pose": np.eye(4).tolist()}).encode()
+  return sid, body
+
+
+def test_router_forwards_to_primary_and_carries_traceparent():
+  transport = FakeTransport()
+  seen = {}
+
+  def handler(method, path, body, headers):
+    seen.update(headers)
+    return _good_render("s")
+
+  transport.set("hostA:1", handler)
+  transport.set("hostB:1", handler)
+  router = _two_backend_router(transport)
+  sid, body = _scene_with_primary(router, "a")
+  status, headers, _ = router.forward_render(sid, body, trace_id="ab" * 16)
+  assert status == 200
+  assert headers["X-Backend-Id"] == "a"
+  assert len(transport.calls) == 1  # primary answered; no failover
+  # Outbound W3C traceparent: version 00, OUR trace id, sampled.
+  version, trace_id, span_id, flags = seen["traceparent"].split("-")
+  assert (version, trace_id, flags) == ("00", "ab" * 16, "01")
+  assert len(span_id) == 16
+
+
+def test_router_fails_over_to_replica_when_primary_is_dead():
+  transport = FakeTransport()
+  transport.set("hostA:1", _dead)
+  transport.set("hostB:1", lambda m, p, b, h: _good_render("s"))
+  router = _two_backend_router(transport)
+  sid, body = _scene_with_primary(router, "a")
+  status, headers, _ = router.forward_render(sid, body)
+  assert status == 200 and headers["X-Backend-Id"] == "b"
+  snap = router.metrics.snapshot()
+  assert snap["failovers"] == 1 and snap["forwards"] == {"b": 1}
+  assert router.stats()["backend_info"]["a"]["breaker"][
+      "consecutive_failures"] == 1
+
+
+def test_router_4xx_passthrough_is_not_a_backend_failure():
+  transport = FakeTransport()
+  err = json.dumps({"error": "unknown scene"}).encode()
+  transport.set("hostA:1", lambda m, p, b, h: (404, {}, err))
+  transport.set("hostB:1", lambda m, p, b, h: (404, {}, err))
+  router = _two_backend_router(transport)
+  sid, body = _scene_with_primary(router, "a")
+  status, headers, resp = router.forward_render(sid, body)
+  assert status == 404 and resp == err
+  assert len(transport.calls) == 1  # a 404 is an ANSWER: no failover
+  assert router.stats()["backend_info"]["a"]["breaker"][
+      "consecutive_failures"] == 0  # and the backend counts as healthy
+
+
+@pytest.mark.parametrize("bad_response", [
+    lambda: (200, {"Content-Type": "application/json"}, b"not json {"),
+    lambda: (200, {"Content-Type": "application/json"},
+             json.dumps({"scene_id": "s"}).encode()),  # missing keys
+    lambda: _truncated_json(),
+    lambda: (200, {"Content-Type": "application/octet-stream",
+                   "X-Image-Shape": "2,2,3", "X-Image-Dtype": "<f4"},
+             b"\x00" * 17),  # truncated binary: shape says 48 bytes
+    lambda: (200, {"Content-Type": "application/octet-stream",
+                   "X-Image-Shape": "nope", "X-Image-Dtype": "<f4"},
+             b"\x00" * 48),
+])
+def test_router_rejects_garbage_200s_and_fails_over(bad_response):
+  transport = FakeTransport()
+  transport.set("hostA:1", lambda m, p, b, h: bad_response())
+  transport.set("hostB:1", lambda m, p, b, h: _good_render("s"))
+  router = _two_backend_router(transport)
+  sid, body = _scene_with_primary(router, "a")
+  status, headers, _ = router.forward_render(sid, body)
+  assert status == 200 and headers["X-Backend-Id"] == "b"
+  snap = router.metrics.snapshot()
+  assert snap["bad_responses"] == 1 and snap["failovers"] == 1
+
+
+def _truncated_json():
+  full = json.dumps({
+      "scene_id": "s", "shape": [2, 2, 3], "dtype": "<f4",
+      "image_b64": base64.b64encode(b"\x00" * 48).decode()}).encode()
+  return 200, {"Content-Type": "application/json"}, full[:-20]
+
+
+def test_router_breaker_opens_and_isolates_only_the_bad_backend():
+  transport = FakeTransport()
+  transport.set("hostA:1", _dead)
+  transport.set("hostB:1", lambda m, p, b, h: _good_render("s"))
+  clock = FakeClock()
+  router = _two_backend_router(transport, clock=clock, threshold=2)
+  sid, body = _scene_with_primary(router, "a")
+  for _ in range(2):  # two failed attempts open a's circuit
+    status, _, _ = router.forward_render(sid, body)
+    assert status == 200  # the replica still answers every time
+  info = router.stats()["backend_info"]
+  assert info["a"]["breaker"]["state"] == "open"
+  assert info["b"]["breaker"]["state"] == "closed"  # isolation
+  transport.calls.clear()
+  status, headers, _ = router.forward_render(sid, body)
+  assert status == 200 and headers["X-Backend-Id"] == "b"
+  # The open breaker means the corpse is not even contacted.
+  assert all(addr != "hostA:1" for addr, _, _ in transport.calls)
+
+
+def test_router_resurrected_backend_recloses_via_half_open_probe():
+  transport = FakeTransport()
+  transport.set("hostA:1", _dead)
+  transport.set("hostB:1", lambda m, p, b, h: _good_render("s"))
+  clock = FakeClock()
+  router = _two_backend_router(transport, clock=clock, threshold=2,
+                               reset_s=10.0)
+  sid, body = _scene_with_primary(router, "a")
+  for _ in range(2):
+    router.forward_render(sid, body)
+  assert router.stats()["backend_info"]["a"]["breaker"]["state"] == "open"
+  # The backend comes back; after the cooldown the NEXT request is the
+  # half-open probe, and its success re-closes the circuit.
+  transport.set("hostA:1", lambda m, p, b, h: _good_render("s"))
+  clock.t += 10.1
+  status, headers, _ = router.forward_render(sid, body)
+  assert status == 200 and headers["X-Backend-Id"] == "a"
+  assert router.stats()["backend_info"]["a"]["breaker"]["state"] == "closed"
+
+
+def test_router_all_replicas_open_is_503_with_retry_after():
+  transport = FakeTransport()
+  transport.set("hostA:1", _dead)
+  transport.set("hostB:1", _dead)
+  clock = FakeClock()
+  router = _two_backend_router(transport, clock=clock, threshold=1)
+  sid, body = _scene_with_primary(router, "a")
+  with pytest.raises(ReplicasExhaustedError):
+    router.forward_render(sid, body)  # opens both breakers (threshold 1)
+  with pytest.raises(AllReplicasOpenError) as err:
+    router.forward_render(sid, body)
+  assert 0 < err.value.retry_after_s <= 10.0
+  assert router.metrics.snapshot()["breaker_fastfails"] == 1
+
+
+# --- the router's own HTTP front end ------------------------------------
+
+
+@pytest.fixture
+def http_router():
+  """A socketed router front end over fake backends: hostA answers
+  garbage 200s, hostB is dead — the 502-never-500 worst case."""
+  transport = FakeTransport()
+  transport.set("hostA:1",
+                lambda m, p, b, h: (200, {"Content-Type":
+                                          "application/json"}, b"garbage"))
+  transport.set("hostB:1", _dead)
+  router = _two_backend_router(transport, tracer=Tracer())
+  server = make_router_http_server(router)
+  thread = threading.Thread(target=server.serve_forever, daemon=True)
+  thread.start()
+  base = f"http://127.0.0.1:{server.server_address[1]}"
+  yield base, router, transport
+  server.shutdown()
+
+
+def _post(base, payload, raw=None):
+  data = raw if raw is not None else json.dumps(payload).encode()
+  req = urllib.request.Request(base + "/render", data=data,
+                               headers={"Content-Type": "application/json"})
+  try:
+    with urllib.request.urlopen(req, timeout=30) as resp:
+      return resp.status, dict(resp.headers.items()), resp.read()
+  except urllib.error.HTTPError as e:
+    with e:
+      return e.code, dict(e.headers.items()), e.read()
+
+
+def test_http_router_garbage_backends_answer_502_never_500(http_router):
+  base, _, _ = http_router
+  status, headers, body = _post(
+      base, {"scene_id": "scene_000", "pose": np.eye(4).tolist()})
+  assert status == 502
+  payload = json.loads(body)
+  assert "attempts" in payload and len(payload["attempts"]) >= 1
+  assert headers.get("X-Trace-Id")
+
+
+def test_http_router_rejects_malformed_requests_with_400(http_router):
+  base, _, _ = http_router
+  assert _post(base, None, raw=b"{nope")[0] == 400
+  assert _post(base, {"scene_id": ["not", "a", "string"],
+                      "pose": np.eye(4).tolist()})[0] == 400
+  assert _post(base, ["not", "an", "object"])[0] == 400
+
+
+def test_http_router_open_breakers_answer_503_with_retry_after(http_router):
+  base, router, _ = http_router
+  for _ in range(2):  # open both breakers (threshold 2, both backends bad)
+    _post(base, {"scene_id": "scene_000", "pose": np.eye(4).tolist()})
+  status, headers, _ = _post(
+      base, {"scene_id": "scene_000", "pose": np.eye(4).tolist()})
+  assert status == 503 and int(headers["Retry-After"]) >= 1
+
+
+# --- aggregated observability over fakes --------------------------------
+
+
+def _obs_backend(metrics_text, health_status="ok"):
+  def handler(method, path, body, headers):
+    if path == "/healthz":
+      return 200, {}, json.dumps({"status": health_status}).encode()
+    if path == "/stats":
+      return 200, {}, json.dumps({"requests": 1}).encode()
+    if path == "/metrics":
+      return 200, {}, metrics_text.encode()
+    return 404, {}, b"{}"
+  return handler
+
+
+_EXPO_A = """# HELP mpi_serve_requests_total Completed render requests.
+# TYPE mpi_serve_requests_total counter
+mpi_serve_requests_total 3
+# HELP mpi_serve_errors_total Failed requests by class.
+# TYPE mpi_serve_errors_total counter
+mpi_serve_errors_total{class="transient"} 1
+"""
+
+_EXPO_B = """# HELP mpi_serve_requests_total Completed render requests.
+# TYPE mpi_serve_requests_total counter
+mpi_serve_requests_total 5
+# HELP mpi_serve_errors_total Failed requests by class.
+# TYPE mpi_serve_errors_total counter
+mpi_serve_errors_total{class="transient"} 2
+"""
+
+
+def test_aggregated_healthz_degraded_not_unhealthy_with_one_dead():
+  transport = FakeTransport()
+  transport.set("hostA:1", _obs_backend(_EXPO_A))
+  transport.set("hostB:1", _dead)
+  router = _two_backend_router(transport)
+  health = router.healthz()
+  assert health["status"] == "degraded"  # NOT unhealthy: a is serving
+  assert health["backends"] == {"a": "ok", "b": "unreachable"}
+  assert health["backends_reachable"] == 1
+  assert "replicas cover" in health["reason"]
+
+
+def test_aggregated_healthz_unhealthy_only_when_nobody_answers():
+  transport = FakeTransport()
+  transport.set("hostA:1", _dead)
+  transport.set("hostB:1", _dead)
+  router = _two_backend_router(transport)
+  assert router.healthz()["status"] == "unhealthy"
+  ok = FakeTransport()
+  ok.set("hostA:1", _obs_backend(_EXPO_A))
+  ok.set("hostB:1", _obs_backend(_EXPO_B))
+  assert _two_backend_router(ok).healthz()["status"] == "ok"
+
+
+def test_aggregated_metrics_sums_backends_and_adds_cluster_families():
+  transport = FakeTransport()
+  transport.set("hostA:1", _obs_backend(_EXPO_A))
+  transport.set("hostB:1", _obs_backend(_EXPO_B))
+  router = _two_backend_router(transport)
+  families = parse_metrics_text(router.metrics_text())
+  assert families["mpi_serve_requests_total"]["samples"][
+      ("mpi_serve_requests_total", ())] == 8  # 3 + 5
+  assert families["mpi_serve_errors_total"]["samples"][
+      ("mpi_serve_errors_total", (("class", "transient"),))] == 3
+  assert families["mpi_cluster_backends"]["samples"][
+      ("mpi_cluster_backends", ())] == 2
+  up = families["mpi_cluster_backend_up"]["samples"]
+  assert up[("mpi_cluster_backend_up", (("backend", "a"),))] == 1
+  assert up[("mpi_cluster_backend_up", (("backend", "b"),))] == 1
+
+
+def test_aggregated_metrics_cached_for_ttl_under_injectable_clock():
+  clock = FakeClock()
+  transport = FakeTransport()
+  transport.set("hostA:1", _obs_backend(_EXPO_A))
+  transport.set("hostB:1", _obs_backend(_EXPO_B))
+  router = _two_backend_router(transport, clock=clock)
+  first = router.metrics_text()
+  fanouts = len(transport.calls)
+  # Inside the TTL: the STALE string comes back with zero fan-out.
+  transport.set("hostA:1", _obs_backend(_EXPO_B))
+  clock.t += 0.24
+  assert router.metrics_text() == first
+  assert len(transport.calls) == fanouts
+  # Past the TTL: one fresh fan-out, new numbers (5 + 5).
+  clock.t += 0.02
+  families = parse_metrics_text(router.metrics_text())
+  assert families["mpi_serve_requests_total"]["samples"][
+      ("mpi_serve_requests_total", ())] == 10
+  assert len(transport.calls) > fanouts
+
+
+# --- the real thing: multi-process cluster on CPU -----------------------
+
+
+N_BACKENDS = 3
+N_SCENES = 6
+IMG, PLANES = 32, 4
+
+
+def _pool_env():
+  sys.path.insert(0, REPO)
+  from _cpu_mesh import hardened_env
+
+  env = hardened_env(1)
+  env["JAX_COMPILATION_CACHE_DIR"] = os.path.join(REPO, ".jax_cache")
+  return env
+
+
+@pytest.fixture(scope="module")
+def cluster():
+  """≥3 real serve processes + a router with per-backend breakers.
+
+  Module-scoped: the pool spawn (3 JAX processes) is the expensive part;
+  the tests below run in definition order against one pool. The breaker
+  cooldown is LONG so an opened breaker stays visibly open for the
+  assertions; the resurrection test drives the probe through a fresh
+  router with its own short-cooldown breakers.
+  """
+  pool = BackendPool(
+      N_BACKENDS, scenes=N_SCENES, img_size=IMG, planes=PLANES,
+      env=_pool_env(),
+      extra_args=["--max-batch", "4", "--max-wait-ms", "1"],
+      log=lambda m: print(m, file=sys.stderr))
+  try:
+    backends = pool.start()
+  except Exception:
+    pool.close()
+    raise
+  router = Router(backends, replication=2, breaker_threshold=2,
+                  breaker_reset_s=600.0, render_timeout_s=120.0,
+                  tracer=Tracer())
+  yield pool, router
+  pool.close()
+
+
+def _render_body(sid, tx=0.0):
+  pose = np.eye(4)
+  pose[0, 3] = tx
+  return json.dumps({"scene_id": sid, "pose": pose.tolist()}).encode()
+
+
+def _decode(body):
+  payload = json.loads(body)
+  img = np.frombuffer(base64.b64decode(payload["image_b64"]), "<f4")
+  return img.reshape(payload["shape"])
+
+
+def test_cluster_shards_scenes_and_routes_bit_identically(cluster):
+  pool, router = cluster
+  sids = pool.scene_ids()
+  assert len(sids) >= 6
+  primaries = {router.placement(sid)[0] for sid in sids}
+  assert len(primaries) >= 2  # really sharded, not one hot backend
+  for sid in sids[:3]:
+    status, headers, body = router.forward_render(sid, _render_body(sid))
+    assert status == 200
+    routed = _decode(body)
+    assert routed.shape == (IMG, IMG, 3)
+    # Bit-identical to a DIRECT render on the very backend that served
+    # it (the router is a pure forwarder; placement changes nothing in
+    # the pixels).
+    backend_addr = pool.addresses()[headers["X-Backend-Id"]]
+    req = urllib.request.Request(
+        f"http://{backend_addr}/render", data=_render_body(sid),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=60) as resp:
+      direct = _decode(resp.read())
+    np.testing.assert_array_equal(routed, direct)
+
+
+def test_cluster_trace_stitches_router_to_backend(cluster):
+  pool, router = cluster
+  sid = pool.scene_ids()[0]
+  trace_id = "f" * 31 + "e"  # a fixed, greppable 32-hex id
+  tr = router.tracer.start_trace("route", trace_id=trace_id, scene_id=sid)
+  status, headers, _ = router.forward_render(
+      sid, _render_body(sid, tx=0.01), trace_id=trace_id, trace=tr)
+  tr.finish()
+  assert status == 200
+  # The backend honored the outbound traceparent: ITS response header
+  # carries OUR trace id...
+  assert headers["X-Trace-Id"] == trace_id
+  backend_addr = pool.addresses()[headers["X-Backend-Id"]]
+  with urllib.request.urlopen(
+      f"http://{backend_addr}/debug/traces", timeout=30) as resp:
+    backend_traces = json.loads(resp.read())
+  backend_ids = {t["trace_id"] for t in backend_traces["recent"]}
+  # ...and recorded a span tree under it, as did the router: one id,
+  # two processes, a stitched distributed trace.
+  assert trace_id in backend_ids
+  router_ids = {t["trace_id"] for t in router.tracer.snapshot()["recent"]}
+  assert trace_id in router_ids
+  backend_tr = next(t for t in backend_traces["recent"]
+                    if t["trace_id"] == trace_id)
+  assert {"queue_wait", "dispatch"} <= {s["name"]
+                                        for s in backend_tr["spans"]}
+
+
+def test_cluster_sigkill_mid_load_fails_over_and_isolates(cluster):
+  pool, router = cluster
+  sids = pool.scene_ids()
+  victim = router.placement(sids[0])[0]
+  victim_scenes = [s for s in sids if victim in router.placement(s)]
+  assert victim_scenes  # the victim must actually matter
+
+  stop = threading.Event()
+  failures: list[str] = []
+  post_kill_ok: set[str] = set()
+  killed = threading.Event()
+  lock = threading.Lock()
+
+  def worker(widx):
+    i = 0
+    while not stop.is_set():
+      sid = sids[(widx + i) % len(sids)]
+      i += 1
+      try:
+        status, _, _ = router.forward_render(
+            sid, _render_body(sid, tx=0.002 * (i % 5)))
+      except Exception as e:  # noqa: BLE001 - transition failures expected
+        with lock:
+          failures.append(f"{sid}: {e!r}")
+        continue
+      if status == 200 and killed.is_set():
+        with lock:
+          post_kill_ok.add(sid)
+
+  threads = [threading.Thread(target=worker, args=(w,), daemon=True)
+             for w in range(3)]
+  for t in threads:
+    t.start()
+  # Let the load establish, then SIGKILL one backend under it.
+  deadline = 60.0
+  import time as _time
+  t0 = _time.monotonic()
+  while not router.metrics.snapshot()["requests"] and \
+      _time.monotonic() - t0 < deadline:
+    _time.sleep(0.05)
+  pool.kill(victim)
+  killed.set()
+  # Keep loading until EVERY scene the victim served has rendered
+  # successfully post-kill (failover proven), or the deadline says no.
+  while not set(victim_scenes) <= post_kill_ok and \
+      _time.monotonic() - t0 < deadline:
+    _time.sleep(0.1)
+  stop.set()
+  for t in threads:
+    t.join(30)
+
+  assert set(victim_scenes) <= post_kill_ok, (
+      f"scenes never failed over: {set(victim_scenes) - post_kill_ok}; "
+      f"failures={failures[:5]}")
+  info = router.stats()["backend_info"]
+  assert info[victim]["breaker"]["state"] == "open"
+  for bid, binfo in info.items():
+    if bid != victim:
+      assert binfo["breaker"]["state"] == "closed", (
+          f"healthy backend {bid} breaker opened: {binfo}")  # isolation
+  health = router.healthz()
+  assert health["status"] == "degraded"  # NOT unhealthy: replicas cover
+  assert health["backends_reachable"] == N_BACKENDS - 1
+  assert router.metrics.snapshot()["failovers"] >= 1
+
+
+def test_cluster_resurrected_backend_serves_again(cluster):
+  """The dead backend restarts on its old port; a fresh router (short
+  breaker cooldown) sees its breaker open, then re-close through the
+  half-open probe, then traffic flows to it again."""
+  pool, router = cluster
+  sids = pool.scene_ids()
+  victim = router.placement(sids[0])[0]
+  if pool.alive(victim):  # runs after the SIGKILL test; be self-sufficient
+    pool.kill(victim)
+  probe_router = Router(pool.addresses(), replication=2,
+                        breaker_threshold=1, breaker_reset_s=0.5,
+                        render_timeout_s=120.0)
+  sid = next(s for s in sids if probe_router.placement(s)[0] == victim)
+  status, headers, _ = probe_router.forward_render(sid, _render_body(sid))
+  assert status == 200 and headers["X-Backend-Id"] != victim  # failover
+  assert probe_router.stats()["backend_info"][victim]["breaker"][
+      "state"] == "open"
+  pool.restart(victim)
+  import time as _time
+  deadline = _time.monotonic() + 30.0
+  served_by = None
+  while _time.monotonic() < deadline:
+    status, headers, _ = probe_router.forward_render(sid, _render_body(sid))
+    assert status == 200
+    if headers["X-Backend-Id"] == victim:
+      served_by = victim
+      break
+    _time.sleep(0.2)
+  assert served_by == victim, "probe never re-closed the breaker"
+  assert probe_router.stats()["backend_info"][victim]["breaker"][
+      "state"] == "closed"

@@ -20,7 +20,9 @@ import pytest
 
 from mpi_vision_tpu.obs import (
     DeviceProfiler,
+    ExpositionCache,
     ProfileBusyError,
+    aggregate_metrics_texts,
     parse_metrics_text,
     render_serve_metrics,
 )
@@ -344,6 +346,96 @@ def test_prom_text_renders_without_breaker():
   families = parse_metrics_text(text)
   assert "mpi_serve_breaker_state" not in families
   assert "mpi_serve_requests_total" in families
+
+
+# --- exposition caching (~250 ms TTL) + cluster aggregation --------------
+
+
+def test_exposition_cache_freshness_and_staleness_bounds():
+  clock = FakeClock()
+  versions = [0]
+  cache = ExpositionCache(lambda: f"v{versions[0]}\n", ttl_s=0.25,
+                          clock=clock)
+  assert cache.get() == "v0\n"
+  versions[0] = 1
+  # STALENESS bound: inside the TTL the cached string comes back even
+  # though the underlying snapshot changed — and costs zero renders.
+  clock.advance(0.249)
+  assert cache.get() == "v0\n"
+  assert cache.renders == 1 and cache.cache_hits == 1
+  # FRESHNESS bound: at/past the TTL the next get re-renders.
+  clock.advance(0.002)
+  assert cache.get() == "v1\n"
+  assert cache.renders == 2
+  versions[0] = 2
+  cache.invalidate()
+  assert cache.get() == "v2\n"  # explicit invalidation skips the TTL
+
+
+def test_exposition_cache_ttl_zero_disables_caching():
+  clock = FakeClock()
+  versions = [0]
+  cache = ExpositionCache(lambda: f"v{versions[0]}", ttl_s=0.0, clock=clock)
+  assert cache.get() == "v0"
+  versions[0] = 1
+  assert cache.get() == "v1"  # no TTL, no staleness, ever
+  assert cache.renders == 2 and cache.cache_hits == 0
+
+
+def test_render_service_metrics_text_cached_under_injectable_clock():
+  clock = FakeClock()
+  svc = RenderService(max_batch=2, max_wait_ms=0.5, use_mesh=False,
+                      resilience=None, metrics_ttl_s=0.25, clock=clock)
+  try:
+    svc.add_synthetic_scenes(1, height=H, width=W, planes=P)
+    svc.render("scene_000", _pose())
+    first = svc.metrics_text()
+    svc.render("scene_000", _pose(0.01))
+    # A scrape storm inside the window re-reads the same string even
+    # though the counters moved...
+    clock.advance(0.2)
+    assert svc.metrics_text() == first
+    # ...and one TTL later the new counters surface.
+    clock.advance(0.1)
+    families = parse_metrics_text(svc.metrics_text())
+    assert families["mpi_serve_requests_total"]["samples"][
+        ("mpi_serve_requests_total", ())] == 2
+  finally:
+    svc.close()
+
+
+def test_aggregate_metrics_texts_sums_counters_gauges_histograms():
+  m1, m2 = ServeMetrics(), ServeMetrics()
+  m1.record_request(0.002)
+  m1.record_request(0.8)
+  m2.record_request(0.002)
+  m2.record_rejected()
+  t1 = render_serve_metrics(m1.snapshot(), m1.latency_histogram())
+  t2 = render_serve_metrics(m2.snapshot(), m2.latency_histogram())
+  families = parse_metrics_text(aggregate_metrics_texts([t1, t2]))
+  samples = families["mpi_serve_requests_total"]["samples"]
+  assert samples[("mpi_serve_requests_total", ())] == 3
+  assert families["mpi_serve_rejected_total"]["samples"][
+      ("mpi_serve_rejected_total", ())] == 1
+  hist = families["mpi_serve_request_latency_seconds"]["samples"]
+  assert hist[("mpi_serve_request_latency_seconds_count", ())] == 3
+  # Cumulative buckets sum per-bound: both 2 ms requests land <= 0.0025.
+  assert hist[("mpi_serve_request_latency_seconds_bucket",
+               (("le", "0.0025"),))] == 2
+  # HELP/TYPE survive aggregation (Prometheus rejects typeless families).
+  assert families["mpi_serve_requests_total"]["type"] == "counter"
+  assert families["mpi_serve_requests_total"]["help"]
+
+
+def test_aggregate_metrics_texts_appends_extra_registry():
+  from mpi_vision_tpu.obs import Registry
+
+  reg = Registry()
+  reg.gauge("mpi_cluster_backends", "Backends registered.", 3)
+  out = aggregate_metrics_texts([], extra=reg)
+  families = parse_metrics_text(out)
+  assert families["mpi_cluster_backends"]["samples"][
+      ("mpi_cluster_backends", ())] == 3
 
 
 # --- HTTP: X-Trace-Id, /metrics, /debug/traces, /debug/profile ----------

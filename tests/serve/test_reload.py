@@ -1,0 +1,219 @@
+"""Live checkpoint reload: watcher semantics + zero-drop scene swaps.
+
+The acceptance pin for the train -> serve loop's last edge: a checkpoint
+published WHILE the service is under load swaps the scenes in place with
+zero failed in-flight requests — requests racing the swap serve either
+the old bake or the new one, never an error, never a mix. The watcher
+itself is pinned on a fake store (fire-once per step, failed reloads
+retried, stale steps ignored) and against a real ``CheckpointStore``
+whose publishes are atomic renames a concurrent poll can race safely.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mpi_vision_tpu.ckpt import CheckpointStore, CheckpointWatcher
+from mpi_vision_tpu.serve import RenderService, synthetic_scene
+
+H = W = 16
+P = 4
+
+
+def _pose(tx=0.0):
+  pose = np.eye(4, dtype=np.float32)
+  pose[0, 3] = tx
+  return pose
+
+
+# --- watcher unit behavior (fake store) ----------------------------------
+
+
+class FakeStore:
+  def __init__(self, step=None):
+    self.step = step
+    self.boom = None
+
+  def latest_step(self):
+    if self.boom is not None:
+      raise self.boom
+    return self.step
+
+
+def test_watcher_fires_once_per_new_step_and_ignores_stale():
+  store = FakeStore(step=None)
+  fired = []
+  w = CheckpointWatcher(store, fired.append, poll_s=1.0)
+  assert w.check_once() is None  # empty store: nothing to do
+  store.step = 5
+  assert w.check_once() == 5
+  assert w.check_once() is None  # same step: fire-once
+  store.step = 4
+  assert w.check_once() is None  # regression (GC'd newest): stale, ignored
+  store.step = 7
+  assert w.check_once() == 7
+  assert fired == [5, 7]
+  assert w.snapshot()["reloads"] == 2
+
+
+def test_watcher_initial_step_suppresses_the_startup_checkpoint():
+  store = FakeStore(step=5)
+  fired = []
+  w = CheckpointWatcher(store, fired.append, initial_step=5)
+  assert w.check_once() is None  # step 5 was the startup bake
+  store.step = 6
+  assert w.check_once() == 6
+  assert fired == [6]
+
+
+def test_watcher_failed_reload_is_retried_until_superseded():
+  store = FakeStore(step=3)
+  calls = []
+
+  def flaky(step):
+    calls.append(step)
+    if len(calls) < 3:
+      raise RuntimeError("bake failed")
+
+  logs = []
+  w = CheckpointWatcher(store, flaky, log=logs.append)
+  assert w.check_once() is None  # fails; step 3 stays unseen
+  assert w.check_once() is None  # retried next poll
+  assert w.check_once() == 3     # third time lucky
+  assert calls == [3, 3, 3]
+  snap = w.snapshot()
+  assert snap["reload_errors"] == 2 and snap["reloads"] == 1
+  assert snap["last_error"] is None  # cleared by the success
+  assert any("step 3 failed" in line for line in logs)
+
+
+def test_watcher_store_errors_counted_not_fatal():
+  store = FakeStore(step=1)
+  w = CheckpointWatcher(store, lambda s: None)
+  store.boom = OSError("transient NFS sadness")
+  assert w.check_once() is None
+  assert w.snapshot()["reload_errors"] == 1
+  store.boom = None
+  assert w.check_once() == 1  # recovered
+
+
+def test_watcher_thread_polls_and_stops():
+  store = FakeStore(step=None)
+  fired = []
+  with CheckpointWatcher(store, fired.append, poll_s=0.01).start() as w:
+    store.step = 2
+    deadline = time.monotonic() + 5.0
+    while not fired and time.monotonic() < deadline:
+      time.sleep(0.01)
+  assert fired == [2]
+  assert w.snapshot()["polls"] >= 1
+
+
+# --- zero-drop swap under load ------------------------------------------
+
+
+def test_swap_scenes_invalidates_both_caches_and_changes_pixels():
+  with RenderService(max_batch=2, max_wait_ms=0.5, use_mesh=False,
+                     resilience=None) as svc:
+    svc.add_scene("s", *synthetic_scene("s", H, W, P, seed=0))
+    before = svc.render("s", _pose())
+    assert svc.cache.stats()["misses"] == 1
+    svc.swap_scenes({"s": synthetic_scene("s", H, W, P, seed=99)})
+    after = svc.render("s", _pose())
+    stats = svc.cache.stats()
+    assert stats["invalidations"] == 1 and stats["misses"] == 2  # re-baked
+    assert not np.array_equal(before, after)  # really the new data
+    # And the new bake matches a service that NEVER saw the old data.
+    with RenderService(max_batch=2, max_wait_ms=0.5, use_mesh=False,
+                       resilience=None) as fresh:
+      fresh.add_scene("s", *synthetic_scene("s", H, W, P, seed=99))
+      np.testing.assert_array_equal(after, fresh.render("s", _pose()))
+
+
+def test_ckpt_publish_swaps_scenes_with_zero_failed_inflight(tmp_path):
+  """The acceptance pin: checkpoint publishes arrive while requests are
+  in flight; every request succeeds (old scenes or new, never an error)
+  and the pixels eventually serve the newest publish."""
+  store = CheckpointStore(str(tmp_path))
+  store.save(0, {"v": np.float32(0)})
+
+  with RenderService(max_batch=4, max_wait_ms=0.5, use_mesh=False,
+                     resilience=None) as svc:
+    scene_ids = ["ckpt_000", "ckpt_001"]
+    for sid in scene_ids:
+      svc.add_scene(sid, *synthetic_scene(sid, H, W, P, seed=0))
+
+    def reload_step(step):
+      # The CLI's _reload in miniature: derive new scene data from the
+      # published step, swap in place under the SAME ids, prebaked so
+      # the first post-swap request skips the bake too.
+      svc.swap_scenes({sid: synthetic_scene(sid, H, W, P, seed=step)
+                       for sid in scene_ids}, prebake=True)
+
+    watcher = CheckpointWatcher(store, reload_step, poll_s=1.0,
+                                initial_step=0)
+    stop = threading.Event()
+    failures: list[BaseException] = []
+    completed = [0]
+    lock = threading.Lock()
+
+    def hammer(widx):
+      i = 0
+      while not stop.is_set():
+        sid = scene_ids[(widx + i) % len(scene_ids)]
+        i += 1
+        try:
+          img = svc.render(sid, _pose(0.001 * (i % 7)), timeout=60)
+          assert img.shape == (H, W, 3)
+        except BaseException as e:  # noqa: BLE001 - ANY failure is the bug
+          with lock:
+            failures.append(e)
+          return
+        with lock:
+          completed[0] += 1
+
+    threads = [threading.Thread(target=hammer, args=(w,), daemon=True)
+               for w in range(4)]
+    for t in threads:
+      t.start()
+    deadline = time.monotonic() + 60.0
+    for step in (1, 2, 3):
+      # A real publish (atomic rename) lands mid-traffic...
+      while completed[0] < step * 20 and time.monotonic() < deadline:
+        time.sleep(0.005)
+      store.save(step, {"v": np.float32(step)})
+      assert watcher.check_once() == step  # ...and the watcher swaps it in.
+    while completed[0] < 80 and time.monotonic() < deadline:
+      time.sleep(0.005)
+    stop.set()
+    for t in threads:
+      t.join(30)
+
+    assert not failures, f"in-flight requests failed across swaps: " \
+                         f"{failures[:3]}"
+    assert completed[0] >= 80
+    assert watcher.snapshot()["reloads"] == 3
+    # The service now provably serves step 3's data.
+    got = svc.render(scene_ids[0], _pose())
+    with RenderService(max_batch=2, max_wait_ms=0.5, use_mesh=False,
+                       resilience=None) as fresh:
+      fresh.add_scene(scene_ids[0],
+                      *synthetic_scene(scene_ids[0], H, W, P, seed=3))
+      np.testing.assert_array_equal(got, fresh.render(scene_ids[0],
+                                                      _pose()))
+
+
+def test_swap_scenes_prebake_leaves_no_cold_first_request():
+  with RenderService(max_batch=2, max_wait_ms=0.5, use_mesh=False,
+                     resilience=None) as svc:
+    svc.add_scene("s", *synthetic_scene("s", H, W, P, seed=0))
+    svc.render("s", _pose())
+    svc.swap_scenes({"s": synthetic_scene("s", H, W, P, seed=1)},
+                    prebake=True)
+    misses_after_swap = svc.cache.stats()["misses"]
+    svc.render("s", _pose())  # must be a cache HIT on the new bake
+    stats = svc.cache.stats()
+    assert stats["misses"] == misses_after_swap
+    assert stats["hits"] >= 1

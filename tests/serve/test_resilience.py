@@ -407,6 +407,40 @@ def test_breaker_open_routes_to_cpu_fallback():
     svc.close()
 
 
+def test_prebake_fallback_serves_breaker_open_requests_warm():
+  """--prebake-fallback: the first degraded render must be a fallback-
+  cache HIT (the CPU bake was paid at startup), not a cold bake inside
+  an already-degraded request."""
+  svc, eng = make_service(ResilienceConfig(
+      max_retries=2, backoff_base_s=0.01, breaker_threshold=1,
+      breaker_reset_s=60.0, watchdog_s=60.0), cpu_fallback="on", scenes=3)
+  try:
+    warmed = svc.prebake_fallback(2)  # hottest-K = first two registered
+    assert warmed == ["scene_000", "scene_001"]
+    fb = svc._fallback_cache.stats()
+    assert fb["scenes"] == 2 and fb["misses"] == 2
+    eng.schedule = lambda idx: Fault("error")  # primary hard down
+    out = svc.render("scene_000", _pose(0.01))  # degrades to fallback
+    assert out.shape == (H, W, 3)
+    assert svc.metrics.fallback_renders >= 1
+    fb = svc._fallback_cache.stats()
+    assert fb["hits"] >= 1 and fb["misses"] == 2  # WARM: no new bake
+    # An un-prebaked scene still works — it just pays the cold bake.
+    svc.render("scene_002", _pose(0.01))
+    assert svc._fallback_cache.stats()["misses"] == 3
+  finally:
+    svc.close()
+
+
+def test_prebake_fallback_without_fallback_engine_is_a_noop():
+  svc, _ = make_service(ResilienceConfig(watchdog_s=60.0),
+                        cpu_fallback="off", warm=False)
+  try:
+    assert svc.prebake_fallback(2) == []
+  finally:
+    svc.close()
+
+
 # --- healthz state machine ----------------------------------------------
 
 

@@ -69,6 +69,31 @@ def test_serve_load_trace_dry_smoke():
           "h2d", "compute", "readback"} <= set(trace["span_names"])
 
 
+def test_serve_load_cluster_dry_smoke():
+  """The multi-host tier's tier-1 smoke: spawn real backend processes,
+  route through the cluster Router, SIGKILL one backend mid-window, and
+  the run must finish with failover + breaker isolation in the JSON."""
+  out = _run_dry(["--cluster"])
+  assert out["metric"] == "serve_load" and out["dry"] is True
+  assert out["renders_per_sec"] > 0 and out["requests"] > 0
+  cluster = out["cluster"]
+  assert cluster["backends"] == 3 and cluster["replication"] == 2
+  victim = cluster["killed"]
+  assert victim is not None
+  # The kill phase really happened and the fleet rode it out: requests
+  # kept completing after the SIGKILL, attempts failed over to replicas,
+  # and ONLY the dead backend's breaker opened.
+  assert cluster["post_kill_requests"] > 0
+  assert cluster["failovers"] >= 1
+  assert cluster["breakers"][victim] == "open"
+  for backend, state in cluster["breakers"].items():
+    if backend != victim:
+      assert state == "closed", f"healthy backend {backend} opened"
+  assert cluster["health"] == "degraded"
+  # Work landed on more than one backend: the ring really shards.
+  assert len(cluster["forwards"]) >= 2
+
+
 def test_serve_load_chaos_dry_smoke():
   """Chaos mode must inject faults AND finish healthy: the workload rides
   retries/fallback instead of aborting, and the JSON carries the

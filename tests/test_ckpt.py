@@ -580,6 +580,18 @@ class TestCkptToServe:
       assert info["params_digest"][:8] in sid    # version-addressed ids
     assert len(ids) == 2
 
+  def test_scenes_from_checkpoint_stable_ids_for_live_reload(
+      self, trained_store):
+    from mpi_vision_tpu.ckpt.export import scenes_from_checkpoint
+
+    root, _ = trained_store
+    scenes, info = scenes_from_checkpoint(root, scenes=2, stable_ids=True)
+    # Live reload swaps scenes IN PLACE: ids must be step-independent so
+    # a later checkpoint's bake lands under the ids clients already hold.
+    assert [sid for sid, *_ in scenes] == ["ckpt_000", "ckpt_001"]
+    assert all(info["params_digest"][:8] not in sid
+               for sid, *_ in scenes)
+
   def test_restored_params_match_trained(self, trained_store):
     from mpi_vision_tpu.ckpt.export import restore_params
 

@@ -84,6 +84,7 @@ def test_train_ckpt_overwrite(tmp_path, capsys):
     ["train", "--synthetic", "--no-nan-guard"],
     ["serve", "--ckpt-scenes", "3"],
     ["serve", "--ckpt-dataset", "/data/re10k"],
+    ["serve", "--reload-ckpt-s", "5"],
 ])
 def test_ckpt_flags_without_ckpt_are_rejected(argv):
   """Dangling checkpoint flags must fail loudly, not silently take the
@@ -91,6 +92,20 @@ def test_ckpt_flags_without_ckpt_are_rejected(argv):
   instead of the trained MPIs)."""
   with pytest.raises(SystemExit, match=r"require\(s\) --ckpt"):
     cli.main(argv)
+
+
+@pytest.mark.parametrize("argv", [
+    ["cluster"],                                     # neither
+    ["cluster", "--backends", "2", "--join", "h:1"],  # both
+])
+def test_cluster_needs_exactly_one_backend_source(argv):
+  with pytest.raises(SystemExit, match="exactly one of"):
+    cli.main(argv)
+
+
+def test_cluster_join_empty_address_list_rejected():
+  with pytest.raises(SystemExit, match="parsed no addresses"):
+    cli.main(["cluster", "--join", " , ,"])
 
 
 def test_negative_save_every_rejected(tmp_path):

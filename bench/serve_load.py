@@ -42,6 +42,17 @@ chaos phase, so the JSON records failover behavior (reroutes, breaker
 isolation, post-kill throughput) next to the usual serving numbers.
 ``--cluster --dry`` is the tier-1 smoke.
 
+``--inflight N`` sets the streaming-pipeline window (concurrent
+in-flight batches; 1 = the legacy blocking dispatch) and the JSON gains
+the pipeline accounting: ``dispatch_gap`` (device idle between
+flights — the "device never waits on the host" proof),
+``out_of_order_completions``, ``abandoned_batches``, and the per-scene
+latency breakdown. ``--ab`` runs the SAME load twice — pipelined
+(``--inflight``) then blocking (window 1) — in one process and emits a
+single ``serve_load_ab`` JSON line with both arms plus the speedup, so
+the streaming win is measurable on the CPU path and trendable across
+BENCH rounds. ``--ab --dry`` is the tier-1 smoke.
+
 Usage: python bench/serve_load.py [--duration 10] [--concurrency 8] ...
 """
 
@@ -75,6 +86,13 @@ def build_parser() -> argparse.ArgumentParser:
   ap.add_argument("--num-planes", type=int, default=16)
   ap.add_argument("--max-batch", type=int, default=8)
   ap.add_argument("--max-wait-ms", type=float, default=3.0)
+  ap.add_argument("--inflight", type=int, default=4,
+                  help="streaming-pipeline window (concurrent in-flight "
+                       "batches; 1 = legacy blocking dispatch)")
+  ap.add_argument("--ab", action="store_true",
+                  help="run the load twice — pipelined (--inflight) vs "
+                       "blocking (window 1) — and emit one serve_load_ab "
+                       "JSON line with both arms + speedup")
   ap.add_argument("--cache-mb", type=int, default=2048)
   ap.add_argument("--method", default="fused",
                   choices=("fused", "scan", "assoc"))
@@ -248,22 +266,10 @@ def cluster_main(args) -> int:
     pool.close()
 
 
-def main(argv=None) -> int:
-  args = build_parser().parse_args(argv)
-  if os.environ.get("SERVE_LOAD_DRY", "") not in ("", "0", "false"):
-    args.dry = True
-  if args.dry:
-    args.duration = min(args.duration, 2.0)
-    args.concurrency = min(args.concurrency, 4)
-    args.scenes = min(args.scenes, 2)
-    args.img_size = min(args.img_size, 32)
-    args.num_planes = min(args.num_planes, 4)
-    args.cluster_backends = min(args.cluster_backends, 3)
-  if args.cluster:
-    if args.dry:
-      args.duration = max(args.duration, 4.0)  # give the kill phase room
-    return cluster_main(args)
-
+def inprocess_run(args, inflight: int) -> dict:
+  """One measured in-process load window at the given pipeline window;
+  returns the headline JSON record (the single-run mode prints exactly
+  this; ``--ab`` calls it twice)."""
   from mpi_vision_tpu.serve import (
       FaultyEngine,
       RenderEngine,
@@ -289,20 +295,22 @@ def main(argv=None) -> int:
         seed=args.seed)
   svc = RenderService(
       cache_bytes=args.cache_mb << 20, max_batch=args.max_batch,
-      max_wait_ms=args.max_wait_ms, method=args.method, use_mesh=use_mesh,
+      max_wait_ms=args.max_wait_ms, max_inflight=inflight,
+      method=args.method, use_mesh=use_mesh,
       engine=engine, resilience=resilience, tracer=tracer)
   ids = svc.add_synthetic_scenes(
       args.scenes, height=args.img_size, width=args.img_size,
       planes=args.num_planes, seed=args.seed)
   _log(f"serve_load: {len(ids)} scenes "
        f"[{args.img_size}x{args.img_size}x{args.num_planes}], "
-       f"engine {svc.engine.describe()}")
+       f"inflight {inflight}, engine {svc.engine.describe()}")
 
   # Warm-up outside the measured window: bake every scene and compile all
   # batch buckets so the measurement is steady-state serving, not XLA
   # compiles.
   svc.warmup()
   svc.metrics.reset()  # measured window starts clean
+  svc.scheduler.reset_gap_clock()  # no gap spanning warmup->measurement
   if tracer is not None:
     tracer.reset()  # warm-up bakes would hog the slowest-N exemplars
   if args.chaos:
@@ -375,6 +383,16 @@ def main(argv=None) -> int:
       "batches": stats["batches"],
       "mean_batch_size": stats["mean_batch_size"],
       "concurrency": args.concurrency,
+      "inflight": inflight,
+      # The pipeline proof points: device idle between flights (must be
+      # ~0 when streaming), completions that beat an earlier dispatch,
+      # and abandoned flights; plus the per-scene latency breakdown for
+      # hot-scene regression hunting.
+      "dispatch_gap": stats["pipeline"]["dispatch_gap"],
+      "out_of_order_completions":
+          stats["pipeline"]["out_of_order_completions"],
+      "abandoned_batches": stats["pipeline"]["abandoned_batches"],
+      "per_scene": stats["per_scene"],
       "device": stats["engine"]["platform"],
       "sharded": stats["engine"]["sharded"],
       "dry": bool(args.dry),
@@ -402,7 +420,63 @@ def main(argv=None) -> int:
         "span_names": sorted({s["name"] for t in slowest
                               for s in t["spans"]}),
     }
+  return record
+
+
+def ab_main(args) -> int:
+  """The pipelined-vs-blocking A/B: the same closed-loop load, once at
+  ``--inflight`` and once at window 1 (the legacy blocking dispatch), in
+  one process so XLA compiles and scene bakes are identical. One JSON
+  line carries both arms + the speedup and each arm's dispatch-gap —
+  blocking shows a real gap per batch, pipelined must show ~0."""
+  if args.inflight < 2:
+    raise SystemExit("--ab needs --inflight >= 2 (the pipelined arm)")
+  _log(f"serve_load: A/B arm 1/2 — pipelined (inflight {args.inflight})")
+  pipelined = inprocess_run(args, args.inflight)
+  _log("serve_load: A/B arm 2/2 — blocking (inflight 1)")
+  blocking = inprocess_run(args, 1)
+  speedup = (pipelined["renders_per_sec"] / blocking["renders_per_sec"]
+             if blocking["renders_per_sec"] else None)
+  record = {
+      "metric": "serve_load_ab",
+      "value": round(speedup, 4) if speedup is not None else None,
+      "unit": "x_pipelined_over_blocking",
+      "speedup": round(speedup, 4) if speedup is not None else None,
+      "pipelined": pipelined,
+      "blocking": blocking,
+      "device": pipelined["device"],
+      "dry": bool(args.dry),
+  }
   print(json.dumps(record))
+  return 0
+
+
+def main(argv=None) -> int:
+  args = build_parser().parse_args(argv)
+  if os.environ.get("SERVE_LOAD_DRY", "") not in ("", "0", "false"):
+    args.dry = True
+  if args.dry:
+    args.duration = min(args.duration, 2.0)
+    args.concurrency = min(args.concurrency, 4)
+    args.scenes = min(args.scenes, 2)
+    args.img_size = min(args.img_size, 32)
+    args.num_planes = min(args.num_planes, 4)
+    args.cluster_backends = min(args.cluster_backends, 3)
+  if args.inflight < 1:
+    raise SystemExit(f"--inflight must be >= 1, got {args.inflight}")
+  if args.cluster:
+    if args.ab:
+      raise SystemExit("--ab measures the in-process pipeline; "
+                       "it does not combine with --cluster")
+    if args.dry:
+      args.duration = max(args.duration, 4.0)  # give the kill phase room
+    return cluster_main(args)
+  if args.ab:
+    if args.chaos:
+      raise SystemExit("--ab compares clean arms; it does not combine "
+                       "with --chaos")
+    return ab_main(args)
+  print(json.dumps(inprocess_run(args, args.inflight)))
   return 0
 
 

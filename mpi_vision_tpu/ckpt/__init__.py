@@ -18,6 +18,10 @@ survive crashes, corruption, and preemption:
   * ``faultinject`` — ``TrainFaultSource``: scheduled crash /
     corrupt-write / NaN-batch / preempt / hang faults so every behavior
     above is testable on CPU in tier-1 (mirrors ``serve/faultinject``).
+  * ``background``  — ``BackgroundSaver``: the same atomic saves on a
+    worker thread (at most one in flight), so big states serialize
+    while the step loop keeps training; parallel per-array hashing
+    lives in ``store`` (``train --async-save``).
   * ``export``      — checkpoint -> baked MPI scenes for the ``serve``
     CLI (``serve --ckpt``), closing the train -> serve loop.
   * ``watch``       — ``CheckpointWatcher``: poll the store for a newly
@@ -25,6 +29,7 @@ survive crashes, corruption, and preemption:
     ``serve --ckpt --reload-ckpt-s N`` swaps scenes without a restart).
 """
 
+from mpi_vision_tpu.ckpt.background import BackgroundSaver
 from mpi_vision_tpu.ckpt.faultinject import (
     SimulatedCrash,
     TrainFault,
@@ -46,6 +51,7 @@ from mpi_vision_tpu.ckpt.store import (
 from mpi_vision_tpu.ckpt.watch import CheckpointWatcher
 
 __all__ = [
+    "BackgroundSaver",
     "CheckpointStore",
     "CheckpointWatcher",
     "CorruptCheckpointError",

@@ -117,6 +117,28 @@ def _sha256(arr: np.ndarray) -> str:
   return hashlib.sha256(np.ascontiguousarray(arr).tobytes()).hexdigest()
 
 
+def _sha256_many(arrays: Mapping[str, np.ndarray]) -> dict[str, str]:
+  """Per-array sha256 over a flat dict, hashed in parallel.
+
+  ``hashlib`` releases the GIL on large buffers, so a small thread pool
+  hashes a multi-hundred-MB train state in parallel instead of pinning
+  one core for the whole save (the step-loop stall the background saver
+  exists to remove). Serial for trivial inputs — pool spin-up would cost
+  more than it saves.
+  """
+  keys = list(arrays)
+  total_bytes = sum(a.nbytes for a in arrays.values())
+  if len(keys) < 2 or total_bytes < (1 << 20):
+    return {k: _sha256(arrays[k]) for k in keys}
+  from concurrent.futures import ThreadPoolExecutor
+
+  workers = min(len(keys), os.cpu_count() or 2, 8)
+  with ThreadPoolExecutor(max_workers=workers,
+                          thread_name_prefix="mpi-ckpt-hash") as pool:
+    digests = pool.map(_sha256, (arrays[k] for k in keys))
+    return dict(zip(keys, digests))
+
+
 def _pid_alive(pid: int) -> bool:
   try:
     os.kill(pid, 0)
@@ -305,14 +327,15 @@ class CheckpointStore:
     os.makedirs(tmp)
     aside = None
     try:
+      # NOT ascontiguousarray: that promotes 0-d scalars (the step
+      # counter) to 1-d, silently changing the restored tree's shapes.
+      arrays = {k: np.asarray(a, order="C") for k, a in arrays.items()}
+      digests = _sha256_many(arrays)
       entries = {}
       stored = {}
       for key, arr in arrays.items():
-        # NOT ascontiguousarray: that promotes 0-d scalars (the step
-        # counter) to 1-d, silently changing the restored tree's shapes.
-        arr = np.asarray(arr, order="C")
         entry = {"shape": list(arr.shape), "dtype": str(arr.dtype),
-                 "sha256": _sha256(arr)}
+                 "sha256": digests[key]}
         if arr.dtype.kind not in _NATIVE_KINDS:
           # Non-native dtype (bf16 & friends): ship raw bytes, re-view on
           # restore from the manifest dtype. npz would pickle these.

@@ -72,6 +72,7 @@ def cmd_train(args: argparse.Namespace) -> dict:
         ("--save-every", args.save_every > 0),
         ("--keep", args.keep is not None),
         ("--nan-guard/--no-nan-guard", args.nan_guard is not None),
+        ("--async-save", args.async_save),
         ("--stall-timeout-s", args.stall_timeout_s > 0)) if on]
     if wants_ckpt:
       raise SystemExit(
@@ -203,6 +204,7 @@ def cmd_train(args: argparse.Namespace) -> dict:
     # fit_resumable contract: the batch stream is a pure function of the
     # epoch index, so the data cursor in each manifest replays exactly).
     from mpi_vision_tpu.ckpt import (
+        BackgroundSaver,
         CheckpointStore,
         NanGuard,
         PreemptionGuard,
@@ -211,7 +213,7 @@ def cmd_train(args: argparse.Namespace) -> dict:
 
     scene_list = None  # the load_scenes walk, shared across epochs
 
-    def make_batches(epoch: int):
+    def make_batches(epoch: int, skip: int = 0):
       # A FRESH dataset object per call (not a reseed of the shared
       # one): a prefetch worker from an abandoned iterator (NaN
       # rollback) may still be drawing triplets, and sharing one RNG
@@ -219,6 +221,9 @@ def cmd_train(args: argparse.Namespace) -> dict:
       # breaking the bit-exact-resume contract. The scene list is a
       # deterministic function of the path, though, so the directory
       # walk happens once — only the RNGs must be per-epoch fresh.
+      # ``skip`` is fit_resumable's cursor seek: iterate_batches draws
+      # the shuffle identically and jumps — a resume costs O(1) data
+      # work instead of replaying the cursor's worth of frame loads.
       nonlocal scene_list
       epoch_ds = cfg.data.make_dataset(
           rng=np.random.default_rng([args.seed, 101, epoch]),
@@ -226,11 +231,17 @@ def cmd_train(args: argparse.Namespace) -> dict:
       scene_list = epoch_ds.scenes
       return realestate.prefetch_batches(realestate.iterate_batches(
           epoch_ds, batch_size=cfg.data.batch_size,
-          rng=np.random.default_rng([args.seed, 202, epoch])))
+          rng=np.random.default_rng([args.seed, 202, epoch]), skip=skip))
 
     store = CheckpointStore(
         os.path.abspath(args.ckpt),
         keep=args.keep if args.keep is not None else 3)
+    if args.async_save:
+      # Background-thread serialization: the step loop keeps training
+      # while the previous state hashes/serializes/fsyncs; the loop
+      # flushes on exit so every save is published by the time the
+      # summary prints.
+      store = BackgroundSaver(store, log=_log)
     watchdog = (StallWatchdog(args.stall_timeout_s,
                               on_stall=lambda idle: _log(
                                   f"train: WATCHDOG no step completed in "
@@ -369,7 +380,8 @@ def cmd_serve(args: argparse.Namespace) -> dict:
                     emit=_log if args.trace_log else None)
   svc = RenderService(
       cache_bytes=args.cache_mb << 20, max_batch=args.max_batch,
-      max_wait_ms=args.max_wait_ms, method=args.method, use_mesh=use_mesh,
+      max_wait_ms=args.max_wait_ms, max_inflight=args.max_inflight,
+      method=args.method, use_mesh=use_mesh,
       max_queue=args.max_queue, resilience=resilience,
       cpu_fallback=args.cpu_fallback, tracer=tracer,
       profile_dir=args.profile_dir or None,
@@ -514,6 +526,7 @@ def cmd_serve(args: argparse.Namespace) -> dict:
       "errors": stats["errors"],
       "rejected": stats["rejected"],
       "resilience": stats["resilience"],
+      "pipeline": stats["pipeline"],
       **({"traces": svc.tracer.finished} if args.trace else {}),
       **({"ckpt_step": ckpt_info["step"],
           "ckpt_params_digest": ckpt_info["params_digest"][:16]}
@@ -684,6 +697,11 @@ def build_parser() -> argparse.ArgumentParser:
                  help="on a non-finite loss, roll back to the last good "
                       "checkpoint and halve the learning rate (default on; "
                       "requires --ckpt; --no-nan-guard fails fast instead)")
+  t.add_argument("--async-save", action="store_true",
+                 help="serialize checkpoints on a background thread "
+                      "(ckpt.BackgroundSaver: at most one save in "
+                      "flight, flushed at exit) so big states no longer "
+                      "stall the step loop; requires --ckpt")
   t.add_argument("--stall-timeout-s", type=float, default=0.0,
                  help="warn when no step completes for this long "
                       "(<= 0 disables the stall watchdog)")
@@ -743,6 +761,11 @@ def build_parser() -> argparse.ArgumentParser:
                  help="micro-batch cap per device dispatch")
   s.add_argument("--max-wait-ms", type=float, default=3.0,
                  help="straggler window before a partial batch dispatches")
+  s.add_argument("--max-inflight", type=int, default=4,
+                 help="streaming-pipeline window: concurrent in-flight "
+                      "batches (h2d/compute/readback overlap, futures "
+                      "complete out of dispatch order); 1 = legacy "
+                      "blocking dispatch")
   s.add_argument("--cache-mb", type=int, default=2048,
                  help="baked-scene cache byte budget")
   s.add_argument("--max-queue", type=int, default=1024,

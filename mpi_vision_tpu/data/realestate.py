@@ -207,20 +207,54 @@ class RealEstateDataset:
     return make_example(self.dataset_path, scene, indexes,
                         self.img_size, self.num_planes)
 
+  def skip_example(self, i: int) -> None:
+    """Consume example ``i``'s randomness WITHOUT loading its frames.
+
+    The training split draws its triplet from the shared ``rng`` per
+    access, so the example stream depends on call order — a resume that
+    simply jumped past the cursor would desync the RNG and break the
+    bit-exact contract. This consumes exactly the draws ``__getitem__``
+    would (microseconds) while skipping ``make_example``'s image IO —
+    the actual O(cursor) cost ``iterate_batches(skip=...)`` removes.
+    """
+    if not self.is_valid:
+      draw_triplet(self.scenes[i], self.rng, self.min_dist, self.max_dist)
+
 
 def iterate_batches(dataset: RealEstateDataset, batch_size: int = 1,
                     shuffle: bool = True,
-                    rng: np.random.Generator | None = None
-                    ) -> Iterator[Mapping[str, jnp.ndarray]]:
+                    rng: np.random.Generator | None = None,
+                    skip: int = 0) -> Iterator[Mapping[str, jnp.ndarray]]:
   """Collate examples into jnp batch dicts (reference bs=1, cell 8:97-101).
 
   ``mpi_planes`` is stacked to [B, P] exactly as a torch dataloader would;
   the losses use row 0 (the reference's ``dep['mpi_planes'][0]``).
+
+  ``skip`` starts the stream at batch index ``skip`` WITHOUT loading the
+  skipped batches' frames: the shuffle order is drawn identically, and a
+  dataset exposing ``skip_example`` (``RealEstateDataset``) consumes its
+  per-example randomness in microseconds instead of paying
+  ``make_example``'s image IO — so a checkpoint resume seeks to its data
+  cursor without the O(cursor) frame-load replay, and the yielded stream
+  is bit-identical to iterating past them (pinned in tests). Datasets
+  without the hook fall back to materializing the skipped examples
+  (stateful example RNGs must be consumed identically either way).
   """
+  if skip < 0:
+    raise ValueError(f"skip must be >= 0, got {skip}")
   order = np.arange(len(dataset))
   if shuffle:
     (rng or np.random.default_rng()).shuffle(order)
-  for start in range(0, len(order) - batch_size + 1, batch_size):
+  n_batches = max((len(order) - batch_size) // batch_size + 1, 0)
+  if skip:
+    consume = getattr(dataset, "skip_example", None)
+    for i in order[:min(skip, n_batches) * batch_size]:
+      if consume is not None:
+        consume(int(i))
+      else:
+        dataset[int(i)]
+  for start in range(skip * batch_size, len(order) - batch_size + 1,
+                     batch_size):
     examples = [dataset[int(i)] for i in order[start:start + batch_size]]
     yield {k: jnp.asarray(np.stack([e[k] for e in examples]))
            for k in examples[0]}

@@ -191,6 +191,22 @@ def serve_registry(stats: dict,
     reg.counter(p + key + "_total", help_text, res.get(key, 0))
   reg.gauge(p + "queue_depth", "Pending requests in the scheduler queue.",
             stats.get("queue_depth", 0))
+  pipeline = stats.get("pipeline") or {}
+  gap = pipeline.get("dispatch_gap") or {}
+  reg.gauge(p + "inflight", "Flights currently in the pipeline window.",
+            pipeline.get("inflight", 0))
+  reg.counter(p + "dispatch_gaps_total",
+              "Launches that found the device idle (nothing in flight).",
+              gap.get("count", 0))
+  reg.counter(p + "dispatch_gap_seconds_total",
+              "Cumulative device idle time between flights.",
+              gap.get("total_s", 0.0))
+  reg.counter(p + "out_of_order_completions_total",
+              "Flights completed while an earlier dispatch was in flight.",
+              pipeline.get("out_of_order_completions", 0))
+  reg.counter(p + "abandoned_batches_total",
+              "Flights abandoned with device work possibly still running.",
+              pipeline.get("abandoned_batches", 0))
   if latency_hist is not None:
     reg.histogram(p + "request_latency_seconds",
                   "End-to-end request latency (enqueue to response).",

@@ -6,6 +6,10 @@ coalesce concurrent same-scene pose requests into one batched device
 dispatch (``scheduler`` -> ``engine``, sharded across visible devices),
 export latency/throughput/batch/cache metrics (``metrics``), and front it
 all with an in-process API plus a stdlib HTTP server (``server``).
+Dispatch is a streaming pipeline (``engine.submit/poll/wait`` + scheduler
+flights): up to ``max_inflight`` batches overlap h2d/compute/readback via
+JAX async dispatch and complete out of dispatch order — see the README's
+"Streaming pipeline" section.
 ``python -m mpi_vision_tpu serve`` runs it; ``bench/serve_load.py`` is the
 closed-loop load generator (``--chaos`` injects scheduled faults).
 
@@ -29,7 +33,7 @@ these serve processes — ``python -m mpi_vision_tpu cluster``.
 from mpi_vision_tpu.obs import DeviceProfiler, ProfileBusyError, Tracer
 
 from mpi_vision_tpu.serve.cache import BakedScene, SceneCache, bake_scene
-from mpi_vision_tpu.serve.engine import RenderEngine
+from mpi_vision_tpu.serve.engine import InFlightBatch, RenderEngine
 from mpi_vision_tpu.serve.faultinject import Fault, FaultyEngine
 from mpi_vision_tpu.serve.metrics import ServeMetrics
 from mpi_vision_tpu.serve.resilience import (

@@ -1,4 +1,4 @@
-"""Device dispatch for batched pose renders.
+"""Device dispatch for batched pose renders — streaming by design.
 
 One baked scene + a ``[V, 4, 4]`` pose batch in, ``[V, H, W, 3]`` host
 images out. Routing: with more than one visible device the batch goes
@@ -8,17 +8,56 @@ the render); on a single chip it goes through the batched
 ``core.render.render_views`` entry. Both run under one ``jax.jit`` per
 (scene-geometry, batch-bucket) pair.
 
+The dispatch API is a **streaming pipeline** (Potamoi, PAPERS.md: keep
+transfer and compute overlapped so the device never waits on the host):
+
+  * ``submit(scene, poses)`` enqueues the pose h2d and the compiled
+    render **asynchronously** (JAX async dispatch — no
+    ``block_until_ready`` anywhere on the submit path) and returns an
+    ``InFlightBatch`` handle. A bounded in-flight window
+    (``max_inflight``) backpressures submitters instead of letting an
+    unbounded device queue build.
+  * ``poll(handle)`` is the non-blocking readiness probe.
+  * ``wait(handle)`` is the ONE synchronization point: it blocks until
+    the device result is ready, copies it to the host, releases the
+    window slot, and stamps the handle's phase timings.
+  * ``abandon(handle)`` releases a handle's window slot without waiting
+    (the scheduler's watchdog calls it for batches it gave up on, so a
+    hung device drains the window instead of wedging it).
+
+``render_batch`` is now just ``submit`` + ``wait`` — the blocking
+convenience entry, bit-identical to the pipelined path because it *is*
+the pipelined path with a window of one caller. Submitting batch N+1
+while batch N computes overlaps N+1's pose transfer with N's compute and
+N's readback with N+1's compute; XLA executes the enqueued work in
+order, so results are independent of how many batches are in flight.
+
+Phase timings: the old engine split h2d/compute/readback with host syncs
+*between* phases — exactly the mid-pipeline stalls streaming removes.
+The handle's phase split is now measured on the submitter/waiter's own
+timeline (h2d = host enqueue cost, compute = submit-to-ready, readback =
+device-to-host copy) and the phases are additionally marked with
+``jax.profiler.TraceAnnotation`` so an on-demand ``/debug/profile``
+capture attributes overlapped transfers correctly instead of
+double-counting them against compute. Under overlap, ``compute``
+includes time queued behind earlier in-flight batches — that is the
+honest number for a serialized device.
+
 Batches are padded up to bucket sizes (powers of two, times the device
 count on the sharded path) by repeating the last pose, and the padding
-views are sliced off before returning — so the jit cache stays bounded at
-O(log max_batch) entries per scene geometry instead of one per observed
-batch size. Per-view math is independent of batch size, which is what
-lets the scheduler promise bit-identical images whatever batch a request
+views are sliced off at ``wait`` — so the jit cache stays bounded at
+O(log max_batch) entries per scene geometry. Pose buffers are **donated**
+to their dispatch on backends that support donation (TPU/GPU; the CPU
+backend would only warn) — each bucket's executable reuses its pose
+input buffer instead of allocating per batch. Per-view math is
+independent of batch size, which is what lets the scheduler promise
+bit-identical images whatever batch (or window position) a request
 lands in.
 """
 
 from __future__ import annotations
 
+import threading
 import time
 
 import jax
@@ -34,6 +73,49 @@ def _next_pow2(n: int) -> int:
   return 1 << max(n - 1, 0).bit_length() if n > 1 else 1
 
 
+class InFlightBatch:
+  """One asynchronously dispatched batch: device output + bookkeeping.
+
+  ``out`` is the un-synced device array (padded bucket shape); ``views``
+  is the live view count to slice back out. ``timings`` is populated by
+  ``RenderEngine.wait`` (keys ``h2d_s`` / ``compute_s`` / ``readback_s``,
+  durations on the engine's clock). The window slot is released exactly
+  once — by ``wait`` (success or failure) or by ``abandon``, whichever
+  runs first; a watchdog-abandoned waiter finishing late is a no-op.
+  """
+
+  __slots__ = ("out", "views", "t_submit", "h2d_enqueue_s", "timings",
+               "_engine", "_released", "_lock")
+
+  def __init__(self, engine: "RenderEngine", out, views: int,
+               t_submit: float, h2d_enqueue_s: float):
+    self.out = out
+    self.views = views
+    self.t_submit = t_submit
+    self.h2d_enqueue_s = h2d_enqueue_s
+    self.timings: dict | None = None
+    self._engine = engine
+    self._released = False
+    self._lock = threading.Lock()
+
+  def release_slot(self) -> bool:
+    """Free this handle's window slot (idempotent); True on first call."""
+    with self._lock:
+      if self._released:
+        return False
+      self._released = True
+    self._engine._release_slot()
+    return True
+
+  def abandon(self) -> None:
+    """Release the slot without waiting and count the abandonment on the
+    engine that issued this handle (a fallback engine's handle must not
+    skew the primary's accounting). No-op on an already-released handle,
+    so sweeping every handle a flight ever submitted is safe."""
+    if self.release_slot():
+      self._engine._count_abandoned()
+
+
 class RenderEngine:
   """Batched render dispatch over the visible devices.
 
@@ -47,43 +129,65 @@ class RenderEngine:
     devices: device list override (default ``jax.devices()``).
     clock: injectable timer for the per-dispatch phase split (the obs
       lint forbids bare time reads in serve/ hot paths).
-    phase_sync: sync after the pose transfer so h2d and compute are
-      separable in the phase split. Costs one extra device round-trip
-      per dispatch (poses are tiny, but over a tunneled TPU every sync
-      is an RPC) — False folds the transfer into the compute phase.
+    max_inflight: bound on concurrently submitted (un-waited) batches;
+      ``submit`` past it blocks until a slot frees. This is device-queue
+      backpressure, not a concurrency promise — the device still runs
+      batches in submission order.
+    phase_sync: obsolete (the pre-streaming engine synced after the pose
+      transfer to split h2d from compute; the streaming pipeline has no
+      mid-pipeline syncs to toggle). Accepted and ignored so existing
+      constructors keep working; phase attribution now comes from the
+      handle timings + ``jax.profiler`` annotations.
   """
 
   def __init__(self, method: str = "fused",
                convention: Convention = Convention.REF_HOMOGRAPHY,
                use_mesh: bool | None = None, devices=None,
-               clock=time.perf_counter, phase_sync: bool = True):
+               clock=time.perf_counter, max_inflight: int = 8,
+               phase_sync: bool = True):
+    if max_inflight < 1:
+      raise ValueError(f"max_inflight must be >= 1, got {max_inflight}")
     self.method = method
     self.convention = convention
     self.devices = jax.devices() if devices is None else list(devices)
     self.use_mesh = (len(self.devices) > 1) if use_mesh is None else use_mesh
     self._clock = clock
-    self.phase_sync = phase_sync
+    self.max_inflight = int(max_inflight)
+    self.phase_sync = phase_sync  # kept for constructor compatibility
+    self._slots = threading.Semaphore(self.max_inflight)
+    self._inflight_lock = threading.Lock()
+    self._inflight = 0
     self.dispatches = 0
+    self.abandoned = 0
     self.last_render_s = 0.0
-    # Phase split of the last dispatch: host->device pose transfer,
-    # device compute (dispatch + wait), device->host image readback.
-    # Durations only (no absolute times) so consumers on a different
-    # clock base can still anchor them.
+    # Phase split of the last *waited* dispatch (see module docstring for
+    # the streaming semantics). Durations only (no absolute times) so
+    # consumers on a different clock base can still anchor them. Shared
+    # engine state: with overlapped batches prefer the per-handle
+    # ``InFlightBatch.timings`` — this field is a convenience snapshot.
     self.last_timings = {"h2d_s": 0.0, "compute_s": 0.0, "readback_s": 0.0}
     if self.use_mesh:
       from mpi_vision_tpu.parallel import mesh as pmesh
 
       self._mesh = pmesh.make_mesh(devices=self.devices)
-      self._render_jit = jax.jit(
-          lambda mpi, poses, depths, k: pmesh.render_views_sharded(
-              mpi, poses, depths, k, self._mesh,
-              convention=self.convention, method=self.method))
+      render_fn = lambda mpi, poses, depths, k: pmesh.render_views_sharded(  # noqa: E731
+          mpi, poses, depths, k, self._mesh,
+          convention=self.convention, method=self.method)
     else:
       self._mesh = None
-      self._render_jit = jax.jit(
-          lambda mpi, poses, depths, k: render.render_views(
-              mpi, poses, depths, k,
-              convention=self.convention, method=self.method))
+      render_fn = lambda mpi, poses, depths, k: render.render_views(  # noqa: E731
+          mpi, poses, depths, k,
+          convention=self.convention, method=self.method)
+    # Donate the pose buffer to the dispatch where the backend supports
+    # donation (TPU/GPU): each batch's pose array is freshly transferred
+    # and never read again on the host, so the executable can reuse its
+    # bytes — one fewer live buffer per in-flight batch. The CPU backend
+    # does not implement donation and would log a warning per compile, so
+    # it keeps the plain jit (poses are tiny there anyway).
+    if self.devices[0].platform in ("tpu", "gpu"):
+      self._render_jit = jax.jit(render_fn, donate_argnums=(1,))
+    else:
+      self._render_jit = jax.jit(render_fn)
 
   def batch_bucket(self, v: int) -> int:
     """Padded batch size dispatched for a logical batch of ``v``."""
@@ -94,10 +198,38 @@ class RenderEngine:
     n = len(self.devices)
     return n * _next_pow2(-(-v // n))
 
-  def render_batch(self, scene: BakedScene, poses) -> np.ndarray:
-    """Render ``poses [V, 4, 4]`` against ``scene`` -> host ``[V, H, W, 3]``.
+  @property
+  def inflight(self) -> int:
+    """Currently submitted batches whose slot is not yet released."""
+    with self._inflight_lock:
+      return self._inflight
 
-    One compiled device dispatch (after warm-up) per batch bucket.
+  def _acquire_slot(self) -> None:
+    self._slots.acquire()
+    with self._inflight_lock:
+      self._inflight += 1
+
+  def _release_slot(self) -> None:
+    with self._inflight_lock:
+      self._inflight -= 1
+    self._slots.release()
+
+  def _count_abandoned(self) -> None:
+    # Counters are bumped from concurrent completion workers now, not a
+    # single dispatcher thread — unguarded += would drop increments.
+    with self._inflight_lock:
+      self.abandoned += 1
+
+  # -- streaming API ------------------------------------------------------
+
+  def submit(self, scene: BakedScene, poses) -> InFlightBatch:
+    """Asynchronously dispatch ``poses [V, 4, 4]`` against ``scene``.
+
+    Enqueues the pose h2d and the compiled render without any device
+    sync and returns immediately with an ``InFlightBatch`` handle (pass
+    it to ``poll``/``wait``). Blocks only when ``max_inflight`` handles
+    are already un-waited (window backpressure). Errors the device
+    raises asynchronously surface at ``wait``.
     """
     poses = np.asarray(poses, np.float32)
     if poses.ndim != 3 or poses.shape[-2:] != (4, 4):
@@ -107,32 +239,90 @@ class RenderEngine:
     if bucket != v:
       poses = np.concatenate(
           [poses, np.repeat(poses[-1:], bucket - v, axis=0)])
-    t0 = self._clock()
-    if self.use_mesh:
-      poses_dev = jnp.asarray(poses)
-    else:
-      # Commit poses to THIS engine's device rather than the process
-      # default: for the degraded-mode CPU fallback the default backend
-      # is the dead device the fallback exists to route around, and an
-      # uncommitted jnp.asarray would stage the transfer there.
-      poses_dev = jax.device_put(poses, self.devices[0])
-    # Sync after the pose transfer so h2d and compute are separable in
-    # traces; with phase_sync off, h2d reads ~0 and the transfer cost
-    # shows up inside compute instead.
-    if self.phase_sync:
-      jax.block_until_ready(poses_dev)
-    t1 = self._clock()
-    out = self._render_jit(scene.rgba_layers, poses_dev,
-                           scene.depths, scene.intrinsics)
-    jax.block_until_ready(out)
-    t2 = self._clock()
-    out = np.asarray(out)
-    t3 = self._clock()
-    self.last_render_s = t3 - t0
-    self.last_timings = {"h2d_s": t1 - t0, "compute_s": t2 - t1,
-                         "readback_s": t3 - t2}
-    self.dispatches += 1
-    return out[:v]
+    self._acquire_slot()
+    try:
+      t0 = self._clock()
+      # The annotations mark the *enqueue* host regions; the device-side
+      # attribution of the transfer/compute themselves comes from the
+      # profiler's own stream, so overlapped transfers are never
+      # double-counted against compute in a capture.
+      with jax.profiler.TraceAnnotation("serve:h2d_enqueue"):
+        if self.use_mesh:
+          poses_dev = jnp.asarray(poses)
+        else:
+          # Commit poses to THIS engine's device rather than the process
+          # default: for the degraded-mode CPU fallback the default
+          # backend is the dead device the fallback exists to route
+          # around, and an uncommitted jnp.asarray would stage the
+          # transfer there.
+          poses_dev = jax.device_put(poses, self.devices[0])
+      t1 = self._clock()
+      with jax.profiler.TraceAnnotation("serve:compute_enqueue"):
+        out = self._render_jit(scene.rgba_layers, poses_dev,
+                               scene.depths, scene.intrinsics)
+    except BaseException:
+      self._release_slot()
+      raise
+    with self._inflight_lock:  # concurrent submitters: don't drop counts
+      self.dispatches += 1
+    return InFlightBatch(self, out, v, t0, t1 - t0)
+
+  def poll(self, handle: InFlightBatch) -> bool:
+    """Non-blocking: is ``handle``'s device result ready to read back?"""
+    is_ready = getattr(handle.out, "is_ready", None)
+    if is_ready is None:  # older jax: no probe; wait() will block briefly
+      return True
+    try:
+      return bool(is_ready())
+    except Exception:  # noqa: BLE001 - a failed batch IS ready (to raise)
+      return True
+
+  def wait(self, handle: InFlightBatch) -> np.ndarray:
+    """THE sync point: block until ready, read back, release the slot.
+
+    Returns the live ``[V, H, W, 3]`` host views (padding sliced off).
+    Device errors from the async dispatch raise here. Safe to call once
+    per handle; the slot is released even on failure (and ``abandon``
+    beats a late waiter without double-releasing).
+    """
+    try:
+      with jax.profiler.TraceAnnotation("serve:wait_device"):
+        jax.block_until_ready(handle.out)
+      t1 = self._clock()
+      with jax.profiler.TraceAnnotation("serve:readback"):
+        host = np.asarray(handle.out)
+      t2 = self._clock()
+    finally:
+      handle.release_slot()
+    # Streaming phase split (handle timeline): h2d = host enqueue cost of
+    # the pose transfer, compute = submit-to-ready (includes device queue
+    # wait behind earlier in-flight batches), readback = d2h copy. The
+    # three tile [t_submit, t2] exactly.
+    handle.timings = {
+        "h2d_s": handle.h2d_enqueue_s,
+        "compute_s": max((t1 - handle.t_submit) - handle.h2d_enqueue_s, 0.0),
+        "readback_s": t2 - t1,
+    }
+    self.last_render_s = t2 - handle.t_submit
+    self.last_timings = dict(handle.timings)
+    return host[:handle.views]
+
+  def abandon(self, handle: InFlightBatch) -> None:
+    """Release a handle's window slot without waiting on its result.
+
+    For batches the scheduler's watchdog gave up on: the device work
+    cannot be cancelled, but its window slot must not stay held by a
+    zombie waiter — otherwise a hung device drains ``max_inflight`` and
+    wedges every later submit. Counted in ``abandoned`` on the handle's
+    own engine.
+    """
+    handle.abandon()
+
+  # -- blocking convenience ----------------------------------------------
+
+  def render_batch(self, scene: BakedScene, poses) -> np.ndarray:
+    """Blocking render: ``submit`` + ``wait`` (one sync, at readback)."""
+    return self.wait(self.submit(scene, poses))
 
   def render_one(self, scene: BakedScene, pose) -> np.ndarray:
     """Single-pose convenience entry: ``[4, 4]`` -> ``[H, W, 3]``."""
@@ -148,7 +338,7 @@ class RenderEngine:
     device (the serving analogue of ``bench.py --allow-cpu``)."""
     return RenderEngine(method=self.method, convention=self.convention,
                         use_mesh=False, devices=jax.devices("cpu"),
-                        phase_sync=self.phase_sync)
+                        max_inflight=self.max_inflight)
 
   def describe(self) -> dict:
     return {
@@ -157,4 +347,6 @@ class RenderEngine:
         "sharded": self.use_mesh,
         "method": self.method,
         "dispatches": self.dispatches,
+        "max_inflight": self.max_inflight,
+        "abandoned": self.abandoned,
     }

@@ -140,23 +140,59 @@ class FaultyEngine:
 
   # -- engine surface -----------------------------------------------------
 
-  def render_batch(self, scene, poses):
+  def _apply_dispatch_fault(self) -> None:
+    """Consume and fire the next scheduled dispatch fault (if any).
+
+    Runs at the dispatch point — ``render_batch`` on the blocking
+    surface, ``submit`` on the streaming one — so one fault fires per
+    attempt either way, and hangs/slows land on the attempt thread where
+    the watchdog can abandon them.
+    """
     fault = self._next_fault()
-    if fault is not None:
-      with self._lock:
-        self.injected[fault.kind] += 1
-      if fault.kind == "error":
-        self._raise(fault, "injected fault")
-      elif fault.kind == "hang":
-        # Simulates a dispatch that never returns (tunnel gone mid-call):
-        # hold until released or the bounded hold elapses, then raise —
-        # by then the watchdog abandoned this thread and the result is
-        # discarded either way.
-        self.release.wait(fault.seconds)
-        self._raise(fault, "injected hang released")
-      else:  # slow
-        time.sleep(fault.seconds)
+    if fault is None:
+      return
+    with self._lock:
+      self.injected[fault.kind] += 1
+    if fault.kind == "error":
+      self._raise(fault, "injected fault")
+    elif fault.kind == "hang":
+      # Simulates a dispatch that never returns (tunnel gone mid-call):
+      # hold until released or the bounded hold elapses, then raise —
+      # by then the watchdog abandoned this thread and the result is
+      # discarded either way.
+      self.release.wait(fault.seconds)
+      self._raise(fault, "injected hang released")
+    else:  # slow
+      time.sleep(fault.seconds)
+
+  def render_batch(self, scene, poses):
+    self._apply_dispatch_fault()
     return self.inner.render_batch(scene, poses)
+
+  # Streaming surface (scheduler pipeline): the fault fires at submit —
+  # the dispatch point — then everything delegates to the wrapped
+  # engine, so an un-faulted batch rides the real async pipeline.
+
+  def submit(self, scene, poses):
+    self._apply_dispatch_fault()
+    return self.inner.submit(scene, poses)
+
+  def poll(self, handle) -> bool:
+    return self.inner.poll(handle)
+
+  def wait(self, handle):
+    return self.inner.wait(handle)
+
+  def abandon(self, handle) -> None:
+    self.inner.abandon(handle)
+
+  @property
+  def max_inflight(self):
+    return self.inner.max_inflight
+
+  @property
+  def inflight(self):
+    return self.inner.inflight
 
   def _raise(self, fault: Fault, default_msg: str):
     msg = fault.message or f"{default_msg} (UNAVAILABLE: device injected)"

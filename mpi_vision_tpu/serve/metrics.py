@@ -30,6 +30,16 @@ LATENCY_BUCKETS_S = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
                      0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
 
 
+# Per-scene latency tracking is bounded: at most this many distinct
+# scenes get their own bucket; the rest aggregate under "_other" so a
+# scene-id cardinality explosion cannot balloon /stats.
+PER_SCENE_CAP = 32
+# Recent-latency window per scene (percentiles are recent-only, like the
+# global window, just smaller — per-scene tails are for hot-scene
+# regression hunting, not capacity planning).
+PER_SCENE_WINDOW = 512
+
+
 class ServeMetrics:
   """Aggregates the serving layer's observability counters."""
 
@@ -69,9 +79,26 @@ class ServeMetrics:
       self.breaker_opens = 0
       self.breaker_fastfails = 0
       self.client_disconnects = 0
+      # Pipeline accounting (PR 7): flights in the air, device idle gaps
+      # between dispatches (the "device never waits on the host" proof),
+      # completions that beat an earlier-dispatched straggler, and
+      # batches the watchdog abandoned mid-flight.
+      self._inflight = 0
+      self.dispatch_gaps = 0
+      self.dispatch_gap_seconds = 0.0
+      self.dispatch_gap_max_s = 0.0
+      self.out_of_order_completions = 0
+      self.abandoned_batches = 0
+      # Per-scene latency breakdown (hot-scene regression hunting):
+      # scene -> [count, sum_s, max_s, deque(recent latencies)].
+      self._per_scene: dict = {}
 
-  def record_request(self, latency_s: float) -> None:
-    """One request completed, queue-to-response latency."""
+  def record_request(self, latency_s: float, scene_id: str | None = None) -> None:
+    """One request completed, queue-to-response latency.
+
+    ``scene_id`` feeds the bounded per-scene breakdown; None (legacy
+    callers) skips it.
+    """
     with self._lock:
       self.requests += 1
       self._latencies.append(latency_s)
@@ -82,6 +109,18 @@ class ServeMetrics:
           break
       else:
         self._lat_overflow += 1
+      if scene_id is not None:
+        key = str(scene_id)
+        if key not in self._per_scene and len(self._per_scene) >= PER_SCENE_CAP:
+          key = "_other"
+        entry = self._per_scene.get(key)
+        if entry is None:
+          entry = self._per_scene[key] = [
+              0, 0.0, 0.0, collections.deque(maxlen=PER_SCENE_WINDOW)]
+        entry[0] += 1
+        entry[1] += latency_s
+        entry[2] = max(entry[2], latency_s)
+        entry[3].append(latency_s)
 
   def record_error(self, kind: str, count: int = 1) -> None:
     """``count`` requests failed with a ``kind``-class error.
@@ -130,6 +169,32 @@ class ServeMetrics:
     """The client hung up mid-response (BrokenPipe/ConnectionReset)."""
     with self._lock:
       self.client_disconnects += 1
+
+  def set_inflight(self, n: int) -> None:
+    """Gauge: flights currently in the pipeline window."""
+    with self._lock:
+      self._inflight = int(n)
+
+  def record_dispatch_gap(self, gap_s: float) -> None:
+    """The device sat idle ``gap_s`` between the previous flight's
+    completion and the next launch (with the pipeline saturated this
+    must stay ~0 — the streaming engine's headline invariant)."""
+    with self._lock:
+      self.dispatch_gaps += 1
+      self.dispatch_gap_seconds += max(gap_s, 0.0)
+      self.dispatch_gap_max_s = max(self.dispatch_gap_max_s, gap_s)
+
+  def record_out_of_order(self) -> None:
+    """A flight completed while an earlier-dispatched one was still in
+    the air — completions are not serialized behind stragglers."""
+    with self._lock:
+      self.out_of_order_completions += 1
+
+  def record_abandoned_batch(self) -> None:
+    """A whole flight exhausted its deadline/watchdog budget and was
+    abandoned with device work possibly still running."""
+    with self._lock:
+      self.abandoned_batches += 1
 
   def record_batch(self, size: int, render_s: float,
                    phases: dict | None = None) -> None:
@@ -199,6 +264,31 @@ class ServeMetrics:
               "breaker_opens": self.breaker_opens,
               "breaker_fastfails": self.breaker_fastfails,
               "client_disconnects": self.client_disconnects,
+          },
+          "pipeline": {
+              "inflight": self._inflight,
+              "out_of_order_completions": self.out_of_order_completions,
+              "abandoned_batches": self.abandoned_batches,
+              "dispatch_gap": {
+                  "count": self.dispatch_gaps,
+                  "total_s": round(self.dispatch_gap_seconds, 6),
+                  "mean_ms": (round(
+                      self.dispatch_gap_seconds / self.dispatch_gaps * 1e3, 3)
+                      if self.dispatch_gaps else None),
+                  "max_ms": round(self.dispatch_gap_max_s * 1e3, 3),
+              },
+          },
+          "per_scene": {
+              sid: {
+                  "requests": entry[0],
+                  "mean_ms": round(entry[1] / entry[0] * 1e3, 3),
+                  "p50_ms": round(
+                      percentile(sorted(entry[3]), 0.50) * 1e3, 3),
+                  "p99_ms": round(
+                      percentile(sorted(entry[3]), 0.99) * 1e3, 3),
+                  "max_ms": round(entry[2] * 1e3, 3),
+              }
+              for sid, entry in sorted(self._per_scene.items())
           },
       }
       if lat:

@@ -1,4 +1,4 @@
-"""Micro-batching scheduler: coalesce same-scene pose renders.
+"""Pipelined micro-batching scheduler: coalesce, stream, complete.
 
 The serving win (Potamoi-style streaming renderers, PAPERS.md): per-pose
 renders of an already-baked scene are cheap and *batch on the view axis
@@ -6,19 +6,40 @@ for free*, so concurrent requests for the same scene should ride one
 device dispatch, not N. Requests enter a FIFO; a single dispatcher thread
 takes the oldest pending request, coalesces every other pending request
 for the SAME scene (up to ``max_batch``), waits up to ``max_wait_ms``
-from that request's enqueue for stragglers, and dispatches the batch to
-the engine as one compiled call. Each request's future resolves with its
+from that request's enqueue for stragglers, and hands the batch to the
+pipeline as one compiled call. Each request's future resolves with its
 own view — bit-identical to an unbatched render of the same pose
 (``core.render.render_views`` batches element-independently; the engine
 pads with repeated poses, never altering live views).
 
-One dispatch in flight at a time: the device is the serialized resource,
-and the queue is the backpressure signal (depth exported via metrics).
-Requests for other scenes keep FIFO order among themselves.
+**The pipeline** (this file's PR-7 rebuild): the dispatcher no longer
+blocks on completion. Each assembled batch becomes a *flight*; up to
+``max_inflight`` flights run concurrently on a completion pool, each
+asynchronously enqueuing its device work (``engine.submit`` — JAX async
+dispatch, no mid-pipeline syncs) and syncing only at readback
+(``engine.wait``). While flight N waits on the device, the dispatcher is
+assembling and submitting flight N+1 — pose h2d, compute, and readback
+overlap, and the device never idles between batches (pinned by the
+``dispatch_gap`` metric: time the device sat idle before a flight began
+while nothing was in flight). Futures resolve **out of dispatch order**:
+a straggler flight (retry storm, slow fault, cold bake) does not hold up
+the completions queued behind it. ``max_inflight=1`` reproduces the old
+blocking behavior exactly — one flight at a time, the dispatcher
+backpressured until it completes — and is the A/B baseline in
+``bench/serve_load.py``.
+
+Resilience attaches to the *flight*, not the dispatcher: every flight
+runs its attempts (retry/backoff/breaker/watchdog, degraded-mode
+fallback) on its own completion worker, with its own deadline. A flight
+the watchdog gives up on is *abandoned* — its futures fail, its device
+work cannot be cancelled, but its engine window slot is released
+(``engine.abandon``) and the abandonment is counted
+(``abandoned_batches``) so a hung device degrades loudly instead of
+silently wedging the window.
 
 Tracing rides the queue: each ``_Pending`` carries its request's
 ``obs.trace.Trace`` (the no-op singleton when tracing is off), the
-dispatcher closes the queue-wait span, stamps the shared batch-assembly/
+flight closes the queue-wait span, stamps the shared batch-assembly/
 dispatch/attempt/phase spans into every batch member, and finishes the
 trace when the future resolves. All time reads go through the injected
 ``clock`` so spans, deadlines, and latencies share one base.
@@ -27,6 +48,7 @@ trace when the future resolves. All time reads go through the injected
 from __future__ import annotations
 
 import dataclasses
+import queue as queue_mod
 import threading
 import time
 from collections import deque
@@ -64,11 +86,28 @@ class _Pending:
   qspan: int = 0                 # open queue_wait span handle
 
 
+@dataclasses.dataclass
+class _Flight:
+  """One assembled batch moving through the pipeline."""
+
+  seq: int                      # dispatch order (for out-of-order proof)
+  batch: list                   # claimed, live _Pending requests
+  poses: np.ndarray             # stacked [V, 4, 4]
+  deadline: float | None        # the batch's most patient member
+  recorder: object              # SpanRecorder or None (tracing off)
+  assembly: tuple | None        # (t0, t1) of the straggler window
+  retired: bool = False         # pipeline bookkeeping done (idempotent)
+
+
 class MicroBatcher:
-  """Request queue + dispatcher thread in front of a ``RenderEngine``.
+  """Request queue + streaming dispatch pipeline over a ``RenderEngine``.
 
   Args:
-    engine: the device dispatch layer.
+    engine: the device dispatch layer. Engines exposing the streaming
+      API (``submit``/``wait`` — ``RenderEngine``, ``FaultyEngine``) get
+      async-enqueued attempts; engines exposing only ``render_batch``
+      run their attempts synchronously on the flight's worker (same
+      overlap across flights, no split phase timings).
     scene_provider: ``scene_id -> BakedScene`` (typically
       ``SceneCache.get_or_bake`` partial'd over the server's scene
       registry); exceptions fail the whole batch's futures.
@@ -80,8 +119,12 @@ class MicroBatcher:
     max_queue: pending-request cap; submissions beyond it raise
       ``QueueFullError`` (shed load instead of queueing past the point
       where callers' timeouts make the work dead anyway).
+    max_inflight: concurrent flights (the pipeline window). 1 = the
+      legacy blocking behavior: the dispatcher waits for each flight
+      before assembling the next. >= 2 overlaps h2d/compute/readback
+      across flights and completes out of dispatch order.
     resilient: optional ``resilience.ResilientExecutor``; when set, every
-      dispatch runs through its retry/breaker/watchdog machinery and an
+      flight runs through its retry/breaker/watchdog machinery and an
       open breaker fast-fails submissions (``CircuitOpenError``) unless a
       fallback engine can degrade instead.
     fallback_engine / fallback_scene_provider: the degraded-mode route —
@@ -95,7 +138,7 @@ class MicroBatcher:
   def __init__(self, engine: RenderEngine, scene_provider,
                metrics: ServeMetrics | None = None,
                max_batch: int = 8, max_wait_ms: float = 2.0,
-               max_queue: int = 1024,
+               max_queue: int = 1024, max_inflight: int = 1,
                resilient: ResilientExecutor | None = None,
                fallback_engine=None, fallback_scene_provider=None,
                clock=time.monotonic):
@@ -103,6 +146,8 @@ class MicroBatcher:
       raise ValueError(f"max_batch must be >= 1, got {max_batch}")
     if max_queue < 1:
       raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+    if max_inflight < 1:
+      raise ValueError(f"max_inflight must be >= 1, got {max_inflight}")
     if fallback_engine is not None and fallback_scene_provider is None:
       raise ValueError("fallback_engine requires fallback_scene_provider")
     self.engine = engine
@@ -111,6 +156,7 @@ class MicroBatcher:
     self.max_batch = max_batch
     self.max_wait_s = max(max_wait_ms, 0.0) / 1e3
     self.max_queue = max_queue
+    self.max_inflight = int(max_inflight)
     self.resilient = resilient
     self.fallback_engine = fallback_engine
     self.fallback_scene_provider = fallback_scene_provider
@@ -120,6 +166,14 @@ class MicroBatcher:
     self._stop = False
     self._thread: threading.Thread | None = None
     self._last_assembly: tuple[float, float] | None = None
+    # Pipeline state (guarded by _cond): live flight count + sequence
+    # tracking for the dispatch-gap and out-of-order metrics.
+    self._inflight = 0
+    self._seq = 0
+    self._live_seqs: set[int] = set()
+    self._last_done_t: float | None = None
+    self._flights: "queue_mod.Queue[_Flight | None]" = queue_mod.Queue()
+    self._completers: list[threading.Thread] = []
 
   @property
   def rejected(self) -> int:
@@ -133,6 +187,12 @@ class MicroBatcher:
       raise RuntimeError("MicroBatcher already started")
     self._thread = threading.Thread(target=self._loop,
                                     name="mpi-serve-dispatch", daemon=True)
+    self._completers = [
+        threading.Thread(target=self._complete_loop,
+                         name=f"mpi-serve-complete-{i}", daemon=True)
+        for i in range(self.max_inflight)]
+    for t in self._completers:
+      t.start()
     self._thread.start()
     return self
 
@@ -154,11 +214,23 @@ class MicroBatcher:
           req.future.set_exception(exc)
           req.trace.finish(error=repr(exc))
       self.metrics.set_queue_depth(0)
+    # In-flight flights complete naturally (their watchdogs/deadlines
+    # bound them); the sentinel wakes each completer once the backlog is
+    # drained, and the join is bounded so a truly hung flight can only
+    # cost the timeout, never a wedged shutdown.
+    for _ in self._completers:
+      self._flights.put(None)
+    for t in self._completers:
+      t.join(timeout)
+    self._completers = []
 
   def dispatcher_alive(self) -> bool:
-    """Is the dispatcher thread running? (healthz's liveness signal —
-    a wedged/ dead dispatcher with a growing queue must not report ok.)"""
-    return self._thread is not None and self._thread.is_alive()
+    """Is the whole pipeline running? (healthz's liveness signal — a
+    wedged/dead dispatcher OR a dead completion worker with a growing
+    queue must not report ok; the completers resolve the futures now, so
+    they are as load-bearing as the dispatcher itself.)"""
+    return (self._thread is not None and self._thread.is_alive()
+            and all(t.is_alive() for t in self._completers))
 
   # -- request path -------------------------------------------------------
 
@@ -170,7 +242,7 @@ class MicroBatcher:
     stop at it, the dispatch watchdog tightens to it, and a request still
     queued past it fails instead of burning a dispatch.
 
-    ``trace`` is this request's ``obs.trace.Trace``; the dispatcher
+    ``trace`` is this request's ``obs.trace.Trace``; the pipeline
     records its span tree (queue-wait onward) and finishes it when the
     future resolves. The default no-op singleton costs nothing.
     """
@@ -209,9 +281,9 @@ class MicroBatcher:
     when the dispatch behind it hangs (the watchdog abandons it).
 
     Owns ``trace``'s error edge: submit-time rejections and caller
-    timeouts finish it here; everything past the queue the dispatcher
+    timeouts finish it here; everything past the queue the flight
     finishes (``Trace.finish`` is idempotent, so the race with a late
-    dispatcher resolution is safe).
+    completion is safe).
     """
     try:
       fut = self.submit(scene_id, pose, timeout=timeout, trace=trace)
@@ -225,7 +297,7 @@ class MicroBatcher:
       trace.finish(error="caller timed out waiting on the future")
       raise
     except Exception as e:
-      trace.finish(error=repr(e))  # dispatcher usually beat us (no-op)
+      trace.finish(error=repr(e))  # the flight usually beat us (no-op)
       raise
 
   # -- dispatcher ---------------------------------------------------------
@@ -274,62 +346,33 @@ class MicroBatcher:
         # Everything same-scene was cancelled during the wait; go around
         # (other-scene requests are back in the queue, NOT a stop).
 
-  def _span_render(self, engine, scene_provider, scene_id, poses,
-                   recorder):
-    """One attempt body: scene lookup/bake + engine render; returns
-    ``(images, render_s, phase_timings)``.
+  def reset_gap_clock(self) -> None:
+    """Forget the last completion time so the next launch records no
+    dispatch gap. Load generators call this next to ``metrics.reset()``
+    — otherwise the first measured-window gap would span the whole
+    warmup-to-measurement idle and pollute the freshly-reset stats."""
+    with self._cond:
+      self._last_done_t = None
 
-    The bake span covers the scene-provider call — a cache hit is ~0 ms,
-    a miss is the real bake — and a failed bake carries its error on the
-    span before re-raising, so the trace tree stays complete through
-    retries/fallback.
+  def _wait_for_slot(self) -> bool:
+    """Block until a pipeline slot frees (or stop). True = slot held.
 
-    Runs on the watchdog's attempt thread, which may be ABANDONED
-    mid-call and finish after a retry already won: all results travel in
-    the return value (discarded for abandoned attempts — never a shared
-    box a zombie could overwrite), and spans record under the parent
-    captured at entry, so a zombie's late spans land under its own dead
-    attempt instead of the live one.
+    The dispatcher acquires its slot BEFORE assembling a batch, so with
+    ``max_inflight=1`` requests keep queueing (and shedding at
+    ``max_queue``) while the single flight runs — the legacy blocking
+    backpressure, preserved exactly.
     """
-    parent = recorder.current_parent() if recorder is not None else None
-    tb0 = self._clock()
-    try:
-      scene = scene_provider(scene_id)
-    except Exception as e:
-      if recorder is not None:
-        recorder.record("bake", tb0, self._clock(), error=repr(e),
-                        parent=parent, scene_id=scene_id)
-      raise
-    if recorder is not None:
-      recorder.record("bake", tb0, self._clock(), parent=parent,
-                      scene_id=scene_id)
-    # device_render_seconds must stay DEVICE time: the timer runs inside
-    # the attempt closures, around the engine call only — never around
-    # retry backoffs, abandoned watchdog waits, or scene bakes.
-    t0 = self._clock()
-    out = engine.render_batch(scene, poses)
-    t1 = self._clock()
-    # last_timings is engine-shared state: a zombie attempt finishing in
-    # the read window could swap in ITS phase split — same dispatch
-    # magnitudes, never accumulated twice, so the race stays cosmetic
-    # (render_s above is thread-local and immune).
-    timings = getattr(engine, "last_timings", None)
-    if recorder is not None and timings:
-      # Engine timings are durations on its own clock; anchor them inside
-      # [t0, t1] back-to-front so the sub-spans tile the render span.
-      h2d_end = t0 + timings["h2d_s"]
-      compute_end = h2d_end + timings["compute_s"]
-      recorder.record("h2d", t0, h2d_end, parent=parent)
-      recorder.record("compute", h2d_end, compute_end, parent=parent)
-      recorder.record("readback", compute_end,
-                      compute_end + timings["readback_s"], parent=parent)
-    return out, t1 - t0, timings
+    with self._cond:
+      while self._inflight >= self.max_inflight and not self._stop:
+        self._cond.wait()
+      return not self._stop
 
-  def _dispatch(self, batch: list[_Pending]) -> None:
+  def _make_flight(self, batch: list[_Pending]) -> _Flight | None:
+    """Claim futures, expire dead requests, stamp assembly spans."""
     # Claim every future first (PENDING -> RUNNING): a future that was
     # cancelled between dequeue and here drops out, and a claimed one can
     # no longer be cancelled under us (set_result would InvalidStateError,
-    # killing the only dispatcher thread).
+    # killing a completion worker).
     batch = [r for r in batch if r.future.set_running_or_notify_cancel()]
     # A request whose deadline already passed has a caller that gave up
     # (or will, before the result lands): fail it now rather than let it
@@ -346,63 +389,220 @@ class MicroBatcher:
         req.trace.finish(error=repr(exc))
       else:
         live.append(req)
-    batch = live
-    if not batch:
-      return
+    if not live:
+      return None
     assembly = self._last_assembly
-    for req in batch:
+    for req in live:
       req.trace.end_span(req.qspan)
       if assembly is not None:
         req.trace.add_span("batch_assembly", assembly[0], assembly[1],
-                           size=len(batch))
+                           size=len(live))
     # Shared span records (one dispatch, many traces) — only allocated
     # when at least one batch member is actually traced, so the disabled
     # path stays allocation-free.
     recorder = (SpanRecorder(self._clock)
-                if any(r.trace is not NULL_TRACE for r in batch) else None)
+                if any(r.trace is not NULL_TRACE for r in live) else None)
     # The batch's dispatch budget follows its MOST patient member: a
     # short-timeout request must not drag its batchmates' watchdog down
     # to its own deadline (the impatient caller's future times out on its
     # own clock either way). A single deadline-free member lifts the cap
     # entirely, leaving the plain watchdog_s hang guard in charge.
-    deadlines = [r.deadline for r in batch if r.deadline is not None]
-    deadline = max(deadlines) if len(deadlines) == len(batch) else None
-    poses = np.stack([r.pose for r in batch])
+    deadlines = [r.deadline for r in live if r.deadline is not None]
+    deadline = max(deadlines) if len(deadlines) == len(live) else None
+    poses = np.stack([r.pose for r in live])
+    return _Flight(seq=0, batch=live, poses=poses, deadline=deadline,
+                   recorder=recorder, assembly=assembly)
+
+  def _launch(self, flight: _Flight) -> None:
+    """Register the flight in the pipeline window and hand it to the
+    completion pool. The dispatch-gap metric records how long the device
+    sat with NOTHING in flight before this launch — the number that must
+    stay ~0 for the pipeline to claim the device never idles."""
+    with self._cond:
+      flight.seq = self._seq
+      self._seq += 1
+      if self._inflight == 0 and self._last_done_t is not None:
+        self.metrics.record_dispatch_gap(self._clock() - self._last_done_t)
+      self._inflight += 1
+      self._live_seqs.add(flight.seq)
+      self.metrics.set_inflight(self._inflight)
+    self._flights.put(flight)
+
+  def _retire(self, flight: _Flight) -> None:
+    """Pipeline bookkeeping the moment the flight's device work is over
+    (before futures/spans, so gap measurement reflects the device, not
+    host-side completion work). Idempotent: the completer's crash guard
+    may re-retire a flight that already retired before failing."""
+    with self._cond:
+      if flight.retired:
+        return
+      flight.retired = True
+      self._live_seqs.discard(flight.seq)
+      if any(s < flight.seq for s in self._live_seqs):
+        # An earlier-dispatched flight is still in the air: this
+        # completion is out of dispatch order (a straggler did not hold
+        # us up) — the pipeline's whole point, so count the proof.
+        self.metrics.record_out_of_order()
+      self._inflight -= 1
+      self._last_done_t = self._clock()
+      self.metrics.set_inflight(self._inflight)
+      self._cond.notify_all()
+
+  def _loop(self) -> None:
+    while True:
+      if not self._wait_for_slot():
+        return
+      batch = self._take_batch()
+      if not batch:
+        return
+      flight = self._make_flight(batch)
+      if flight is None:
+        continue  # everything expired/cancelled; the slot was never used
+      self._launch(flight)
+
+  # -- completion path ----------------------------------------------------
+
+  def _complete_loop(self) -> None:
+    while True:
+      flight = self._flights.get()
+      if flight is None:
+        return
+      try:
+        self._run_flight(flight)
+      except BaseException as e:  # noqa: BLE001 - worker must survive
+        # _run_flight handles expected failures itself; this guard is
+        # for bugs in the resolution tail. The worker stays alive (a
+        # dead completer would silently halt the pipeline while healthz
+        # reads ok) and the flight's callers get the error instead of
+        # hanging to their timeouts.
+        self._retire(flight)  # idempotent; frees the window slot
+        for req in flight.batch:
+          if not req.future.done():
+            try:
+              req.future.set_exception(e)
+            except Exception:  # noqa: BLE001 - racing a late resolution
+              pass
+            req.trace.finish(error=repr(e))
+
+  def _bake_with_span(self, scene_provider, scene_id, recorder, parent):
+    """Scene lookup/bake with its trace span — a cache hit is ~0 ms, a
+    miss is the real bake, and a failed bake carries its error on the
+    span before re-raising, so the trace tree stays complete through
+    retries/fallback."""
+    tb0 = self._clock()
+    try:
+      scene = scene_provider(scene_id)
+    except Exception as e:
+      if recorder is not None:
+        recorder.record("bake", tb0, self._clock(), error=repr(e),
+                        parent=parent, scene_id=scene_id)
+      raise
+    if recorder is not None:
+      recorder.record("bake", tb0, self._clock(), parent=parent,
+                      scene_id=scene_id)
+    return scene
+
+  def _record_phases(self, recorder, parent, t0, timings) -> None:
+    """Anchor the engine's phase durations inside the attempt's render
+    window front-to-back so the sub-spans tile it. Under overlap,
+    "compute" includes device queue wait behind earlier flights — the
+    honest per-flight number."""
+    if recorder is None or not timings:
+      return
+    h2d_end = t0 + timings["h2d_s"]
+    compute_end = h2d_end + timings["compute_s"]
+    recorder.record("h2d", t0, h2d_end, parent=parent)
+    recorder.record("compute", h2d_end, compute_end, parent=parent)
+    recorder.record("readback", compute_end,
+                    compute_end + timings["readback_s"], parent=parent)
+
+  def _streaming_attempt(self, engine, scene_provider, scene_id, poses,
+                         recorder, handles):
+    """One attempt via the streaming engine API: bake + async submit +
+    wait (the only sync). Returns ``(images, render_s, phase_timings)``.
+
+    Runs on the watchdog's attempt thread, which may be ABANDONED
+    mid-wait and finish after a retry already won: all results travel in
+    the return value, spans record under the parent captured at entry,
+    and every submitted handle is appended to ``handles`` so the flight
+    can sweep-release engine window slots when it ends — whichever
+    attempts were abandoned along the way.
+    """
+    parent = recorder.current_parent() if recorder is not None else None
+    scene = self._bake_with_span(scene_provider, scene_id, recorder, parent)
+    # device_render_seconds must stay DEVICE-window time: the timer runs
+    # around submit+wait only — never around retry backoffs, abandoned
+    # watchdog waits, or scene bakes.
+    t0 = self._clock()
+    handle = engine.submit(scene, poses)
+    handles.append(handle)
+    out = engine.wait(handle)
+    t1 = self._clock()
+    self._record_phases(recorder, parent, t0, handle.timings)
+    return out, t1 - t0, handle.timings
+
+  def _span_render(self, engine, scene_provider, scene_id, poses,
+                   recorder):
+    """One attempt via the legacy blocking engine surface
+    (``render_batch`` only — test doubles and wrappers without the
+    streaming API). Same contract as ``_streaming_attempt`` minus the
+    async split (``last_timings`` is engine-shared state; the race with
+    a zombie attempt stays cosmetic, as before the rebuild)."""
+    parent = recorder.current_parent() if recorder is not None else None
+    scene = self._bake_with_span(scene_provider, scene_id, recorder, parent)
+    t0 = self._clock()
+    out = engine.render_batch(scene, poses)
+    t1 = self._clock()
+    timings = getattr(engine, "last_timings", None)
+    self._record_phases(recorder, parent, t0, timings)
+    return out, t1 - t0, timings
+
+  def _attempt_fn(self, engine, scene_provider, scene_id, poses, recorder,
+                  handles):
+    """The attempt closure for one engine: streaming when the engine
+    supports it, legacy otherwise."""
+    if callable(getattr(engine, "submit", None)) and callable(
+        getattr(engine, "wait", None)):
+      return lambda: self._streaming_attempt(
+          engine, scene_provider, scene_id, poses, recorder, handles)
+    return lambda: self._span_render(
+        engine, scene_provider, scene_id, poses, recorder)
+
+  def _run_flight(self, flight: _Flight) -> None:
+    batch, recorder = flight.batch, flight.recorder
+    scene_id = batch[0].scene_id
+    poses = flight.poses
+    handles: list = []
     d0 = self._clock()
     try:
       # Each attempt returns (images, render_s, phases) — results travel
       # by return value so an attempt thread the watchdog abandoned can
       # never overwrite the winning attempt's accounting.
+      primary_fn = self._attempt_fn(self.engine, self.scene_provider,
+                                    scene_id, poses, recorder, handles)
       if self.resilient is not None:
-
-        def primary_fn(scene_id=batch[0].scene_id):
-          # Scene lookup INSIDE the resilient call: a cache-miss bake
-          # onto a dead device must retry / count toward the breaker /
-          # degrade to the fallback exactly like a failed render — a
-          # cold scene during an outage is the worst time to fail raw.
-          return self._span_render(self.engine, self.scene_provider,
-                                   scene_id, poses, recorder)
-
         fallback_fn = None
         if self.fallback_engine is not None:
-          def fallback_fn(scene_id=batch[0].scene_id):
-            # Bake onto the FALLBACK's devices at call time: baking every
-            # scene to CPU up front would double host->device traffic for
-            # an outage that may never happen.
-            return self._span_render(
-                self.fallback_engine, self.fallback_scene_provider,
-                scene_id, poses, recorder)
+          # Bake onto the FALLBACK's devices at call time: baking every
+          # scene to CPU up front would double host->device traffic for
+          # an outage that may never happen.
+          fallback_fn = self._attempt_fn(
+              self.fallback_engine, self.fallback_scene_provider,
+              scene_id, poses, recorder, handles)
         out, render_s, phases = self.resilient.run(
-            primary_fn, fallback_fn=fallback_fn, deadline=deadline,
+            primary_fn, fallback_fn=fallback_fn, deadline=flight.deadline,
             recorder=recorder)
       else:
-        out, render_s, phases = self._span_render(
-            self.engine, self.scene_provider, batch[0].scene_id, poses,
-            recorder)
+        out, render_s, phases = primary_fn()
     except Exception as e:  # noqa: BLE001 - forwarded to every caller
+      self._retire(flight)
       kind = ("deadline" if getattr(e, "deadline_capped", False)
               else classify_error(e))
       self.metrics.record_error(kind, count=len(batch))
+      if isinstance(e, DispatchTimeoutError):
+        # The batch is ABANDONED with device work possibly still running
+        # on a zombie attempt thread.
+        self.metrics.record_abandoned_batch()
       d1 = self._clock()
       err = repr(e)
       for req in batch:
@@ -413,11 +613,28 @@ class MicroBatcher:
         req.future.set_exception(e)
         req.trace.finish(error=err)
       return
+    finally:
+      # Sweep EVERY handle the flight ever submitted: a watchdog-
+      # abandoned attempt's zombie thread may hold its engine window
+      # slot forever (hung device) even when a later retry or the CPU
+      # fallback won — without the sweep, each hung-then-recovered
+      # flight would leak one slot until the window wedged every future
+      # submit. abandon() is a no-op on handles wait() already released.
+      # Residual: a zombie abandoned while still INSIDE submit appends
+      # its handle after this sweep; that slot frees itself if the
+      # device ever completes/errors the work (wait's finally), and a
+      # device hung forever has the breaker routing around the whole
+      # engine anyway.
+      for handle in handles:
+        if callable(getattr(handle, "abandon", None)):
+          handle.abandon()
+    self._retire(flight)
     d1 = self._clock()
     self.metrics.record_batch(len(batch), render_s, phases=phases)
     done = self._clock()
     for i, req in enumerate(batch):
-      self.metrics.record_request(done - req.t_enqueue)
+      self.metrics.record_request(done - req.t_enqueue,
+                                  scene_id=req.scene_id)
       dspan = req.trace.add_span("dispatch", d0, d1, size=len(batch))
       if recorder is not None:
         recorder.replay(req.trace, parent=dspan)
@@ -425,10 +642,3 @@ class MicroBatcher:
       # caller holding one image must not pin bucket x image bytes.
       req.future.set_result(out[i].copy())
       req.trace.finish()
-
-  def _loop(self) -> None:
-    while True:
-      batch = self._take_batch()
-      if not batch:
-        return
-      self._dispatch(batch)

@@ -115,6 +115,10 @@ class RenderService:
   Args:
     cache_bytes: scene-cache byte budget.
     max_batch / max_wait_ms: micro-batching knobs (scheduler.py).
+    max_inflight: streaming-pipeline window (scheduler.py): concurrent
+      flights whose h2d/compute/readback overlap and whose futures
+      complete out of dispatch order. 1 = the legacy blocking dispatch
+      (the A/B baseline in ``bench/serve_load.py``).
     method / use_mesh: renderer routing knobs (engine.py).
     resilience: retry/breaker/watchdog knobs (resilience.py); None turns
       the whole resilience layer off (raw PR-1 behavior).
@@ -139,7 +143,8 @@ class RenderService:
   """
 
   def __init__(self, cache_bytes: int = 2 << 30, max_batch: int = 8,
-               max_wait_ms: float = 2.0, method: str = "fused",
+               max_wait_ms: float = 2.0, max_inflight: int = 4,
+               method: str = "fused",
                use_mesh: bool | None = None, max_queue: int = 1024,
                engine: RenderEngine | None = None,
                resilience: ResilienceConfig | None = ResilienceConfig(),
@@ -154,8 +159,13 @@ class RenderService:
       # The fallback only engages through the resilience layer's breaker;
       # accepting the combination silently would drop an explicit knob.
       raise ValueError("cpu_fallback='on' requires resilience enabled")
+    if max_inflight < 1:
+      raise ValueError(f"max_inflight must be >= 1, got {max_inflight}")
+    # The engine's own window must not be the bottleneck under retries
+    # (an abandoned attempt can briefly hold a slot next to its retry's).
     self.engine = engine if engine is not None else RenderEngine(
-        method=method, use_mesh=use_mesh)
+        method=method, use_mesh=use_mesh,
+        max_inflight=max(8, 2 * max_inflight))
     self.cache = cache_mod.SceneCache(byte_budget=cache_bytes)
     self.metrics = ServeMetrics()
     self.tracer = tracer if tracer is not None else NULL_TRACER
@@ -179,7 +189,8 @@ class RenderService:
     self.scheduler = MicroBatcher(
         self.engine, self._get_scene, metrics=self.metrics,
         max_batch=max_batch, max_wait_ms=max_wait_ms,
-        max_queue=max_queue, resilient=self.resilient,
+        max_queue=max_queue, max_inflight=max_inflight,
+        resilient=self.resilient,
         fallback_engine=self.fallback_engine,
         fallback_scene_provider=(
             self._get_scene_fallback
@@ -348,6 +359,8 @@ class RenderService:
 
   def stats(self) -> dict:
     out = self.metrics.snapshot(cache_stats=self.cache.stats())
+    out.setdefault("pipeline", {})["max_inflight"] = \
+        self.scheduler.max_inflight
     out["engine"] = self.engine.describe()
     if self.resilient is not None:
       out["breaker"] = self.resilient.breaker.snapshot()

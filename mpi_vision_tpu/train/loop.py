@@ -23,7 +23,9 @@ from __future__ import annotations
 
 import contextlib
 import functools
+import inspect
 import math
+import sys
 from typing import Any, Callable, Mapping
 
 import jax
@@ -468,6 +470,22 @@ def _close_iter(it) -> None:
     close()
 
 
+def _supports_skip(make_batches) -> bool:
+  """Does ``make_batches`` accept an explicit ``skip`` keyword?
+
+  Only a NAMED parameter counts — a bare ``**kwargs`` that silently
+  swallows ``skip`` would yield the wrong stream (no seek happened) and
+  break the bit-exact resume contract, so it routes to the replay path.
+  """
+  try:
+    params = inspect.signature(make_batches).parameters
+  except (TypeError, ValueError):  # builtins / C callables: no signature
+    return False
+  p = params.get("skip")
+  return p is not None and p.kind in (
+      inspect.Parameter.POSITIONAL_OR_KEYWORD, inspect.Parameter.KEYWORD_ONLY)
+
+
 def fit_resumable(state: TrainState, epochs: int, make_batches, store, *,
                   step=None, save_every: int = 0, meta: Mapping | None = None,
                   resume: str = "auto", nan_guard=None, watchdog=None,
@@ -522,7 +540,12 @@ def fit_resumable(state: TrainState, epochs: int, make_batches, store, *,
       restored, except for its structure, which must match).
     epochs: total epoch count (the resume cursor counts toward it).
     make_batches: ``epoch -> iterable of batches`` (pure per epoch).
-    store: a ``ckpt.CheckpointStore``.
+      May additionally accept an explicit ``skip`` keyword — then a
+      resume seeks straight to its data cursor (``make_batches(e,
+      skip=b)`` must yield exactly the stream ``make_batches(e)`` yields
+      after ``b`` batches) instead of replaying ``b`` dead batches.
+    store: a ``ckpt.CheckpointStore`` (or ``ckpt.BackgroundSaver`` for
+      background-thread serialization; the loop flushes it on exit).
     step: the ``(state, batch) -> (state, metrics)`` step; default
       ``make_train_step()``.
     save_every: additional save cadence in optimizer steps.
@@ -603,6 +626,18 @@ def fit_resumable(state: TrainState, epochs: int, make_batches, store, *,
     return (watchdog.suspended() if watchdog is not None
             else contextlib.nullcontext())
 
+  def finish_report(preempted: bool):
+    # A BackgroundSaver may still be writing the save this report must
+    # count (preempt save, final epoch save): join it BEFORE reading the
+    # store's accounting, or report["saves"] undercounts what lands on
+    # disk. The finally-block flush stays as the exception-path net.
+    flush = getattr(store, "flush", None)
+    if flush is not None:
+      with wd_quiet():
+        flush()
+    return _report(losses, state, resumed_from, store, nan_guard,
+                   rollback_steps, preempted=preempted)
+
   def save(reason: str) -> None:
     cur_meta = {"cursor": {"epoch": e, "batch": b}, "reason": reason,
                 **user_meta}
@@ -630,16 +665,27 @@ def fit_resumable(state: TrainState, epochs: int, make_batches, store, *,
   try:
     while e < epochs:
       epoch_loss_start.setdefault(e, len(losses))
+      # Skip-ahead cursor seek: a make_batches that takes ``skip``
+      # (e.g. data/realestate.iterate_batches) jumps straight to the
+      # cursor in O(1) host work instead of materializing b dead
+      # batches — pinned bit-exact against the replay path in tests. A
+      # cursor past the stream's end simply yields an empty epoch, the
+      # same close-out the replay path's StopIteration handler does.
+      skip_ahead = b > 0 and _supports_skip(make_batches)
       with wd_quiet():
         # Building the epoch's data pipeline (scene walk, dataset
         # construction) is host work between beats, same family as
         # checkpoint I/O: it may legitimately exceed the stall timeout.
-        it = iter(make_batches(e))
+        it = iter(make_batches(e, skip=b) if skip_ahead
+                  else make_batches(e))
+      if skip_ahead:
+        say(f"ckpt: skip-ahead to cursor batch {b} of epoch {e}")
       try:
-        for _ in range(b):  # replay the data stream up to the cursor
-          next(it)
-          if watchdog is not None:
-            watchdog.beat()  # host-side replay progress, not a hang
+        if not skip_ahead:
+          for _ in range(b):  # replay the data stream up to the cursor
+            next(it)
+            if watchdog is not None:
+              watchdog.beat()  # host-side replay progress, not a hang
       except StopIteration:
         # The epoch is shorter than the cursor (dataset shrank between
         # runs): close the epoch out rather than crash on the skip.
@@ -656,8 +702,7 @@ def fit_resumable(state: TrainState, epochs: int, make_batches, store, *,
           save("preempt")
           say(f"ckpt: preempted at step {int(state.step)}; saved")
           _close_iter(it)
-          return state, _report(losses, state, resumed_from, store,
-                                nan_guard, rollback_steps, preempted=True)
+          return state, finish_report(preempted=True)
         new_state, metrics = step(state, batch)
         loss = float(metrics["loss"])
         if not math.isfinite(loss):
@@ -757,10 +802,23 @@ def fit_resumable(state: TrainState, epochs: int, make_batches, store, *,
           # stall timeout.
           on_epoch(state, finished, losses[start:])
   finally:
+    flush = getattr(store, "flush", None)
+    if flush is not None:
+      # A BackgroundSaver may still be writing (preempt save, final
+      # epoch save): the caller must find every save published on
+      # return. During an exception unwind a flush failure is logged,
+      # not raised — it must not mask the original error.
+      unwinding = sys.exc_info()[1] is not None
+      try:
+        with wd_quiet():
+          flush()
+      except BaseException as fe:  # noqa: BLE001 - see above
+        if not unwinding:
+          raise
+        say(f"ckpt: background save failed during unwind: {fe!r}")
     if watchdog is not None:
       watchdog.stop()
-  return state, _report(losses, state, resumed_from, store, nan_guard,
-                        rollback_steps, preempted=False)
+  return state, finish_report(preempted=False)
 
 
 def _report(losses, state, resumed_from, store, nan_guard, rollback_steps,

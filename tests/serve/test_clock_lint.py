@@ -1,6 +1,10 @@
 """Lint: serve/ (cluster/ included), obs/, ckpt/, and the hardened train
 loop read time only through injectable clocks.
 
+The PR-7 streaming rebuild (serve/engine.py + serve/scheduler.py, the
+ckpt background saver) is explicitly in the coverage self-check below —
+the pipeline's gap/latency/deadline math all rides the injected clocks.
+
 Every latency, deadline, span edge, stall measurement, and manifest
 timestamp must come from a clock the caller can inject — that is what
 makes the breaker, scheduler, tracer, metrics, checkpoint store, and
@@ -63,8 +67,9 @@ def test_lint_covers_the_ckpt_package_and_train_loop():
   # test exists to prevent.
   rel = {"/".join(p.parts[-2:]) for p in _linted_sources()}
   assert {"ckpt/store.py", "ckpt/guards.py", "ckpt/faultinject.py",
-          "ckpt/watch.py", "serve/faultinject.py", "train/loop.py",
-          "cluster/router.py", "cluster/ring.py",
+          "ckpt/watch.py", "ckpt/background.py", "serve/faultinject.py",
+          "serve/engine.py", "serve/scheduler.py", "serve/metrics.py",
+          "train/loop.py", "cluster/router.py", "cluster/ring.py",
           "cluster/pool.py"} <= rel
 
 

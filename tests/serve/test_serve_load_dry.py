@@ -46,6 +46,16 @@ def test_serve_load_dry_emits_headline_json():
   assert 0 <= out["cache_hit_rate"] <= 1
   assert out["requests"] >= out["batches"] >= 1
   assert out["chaos"] is False
+  # Pipeline accounting rides every run: the window, the device-idle
+  # gap metric, out-of-order/abandoned counters, per-scene breakdown.
+  assert out["inflight"] >= 1
+  assert set(out["dispatch_gap"]) == {"count", "total_s", "mean_ms",
+                                      "max_ms"}
+  assert out["abandoned_batches"] == 0
+  assert out["out_of_order_completions"] >= 0
+  assert out["per_scene"]  # hot-scene breakdown present
+  for entry in out["per_scene"].values():
+    assert entry["requests"] > 0 and entry["p50_ms"] > 0
   # Outage accounting rides EVERY run (trend across BENCH rounds): the
   # error/resilience counters and breaker state, zeros and all.
   assert set(out["errors"]) == {"transient", "permanent", "deadline"}
@@ -67,6 +77,28 @@ def test_serve_load_trace_dry_smoke():
   assert trace["slowest_ms"] and trace["slowest_ms"] > 0
   assert {"queue_wait", "batch_assembly", "dispatch", "attempt", "bake",
           "h2d", "compute", "readback"} <= set(trace["span_names"])
+
+
+def test_serve_load_ab_dry_smoke():
+  """The pipelined-vs-blocking A/B smoke: one process, two measured
+  arms, one JSON line. Pins the contract (both arms' headline fields +
+  the gap metric that proves/disproves device idle), NOT a dry-mode
+  speedup — on 32-px toy scenes per-dispatch host overhead dominates
+  and the win only shows at real sizes (recorded per BENCH round)."""
+  out = _run_dry(["--ab"])
+  assert out["metric"] == "serve_load_ab" and out["dry"] is True
+  assert out["device"] == "cpu"
+  assert out["speedup"] and out["speedup"] > 0
+  pipelined, blocking = out["pipelined"], out["blocking"]
+  assert pipelined["inflight"] >= 2 and blocking["inflight"] == 1
+  for arm in (pipelined, blocking):
+    assert arm["renders_per_sec"] > 0 and arm["p50_ms"] > 0
+    assert set(arm["dispatch_gap"]) == {"count", "total_s", "mean_ms",
+                                        "max_ms"}
+  # Blocking serializes: every post-completion launch finds the device
+  # idle, so its gap metric must have fired.
+  assert blocking["dispatch_gap"]["count"] >= 1
+  assert blocking["out_of_order_completions"] == 0
 
 
 def test_serve_load_cluster_dry_smoke():

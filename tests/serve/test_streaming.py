@@ -1,0 +1,276 @@
+"""Streaming render pipeline tests: async engine API, overlap bookkeeping,
+out-of-order completion, and pipelined-vs-blocking parity.
+
+The tentpole invariants of the PR-7 rebuild:
+
+  * the streaming path (``submit``/``wait``, pipeline window > 1) is
+    **bit-identical** to the blocking path (window 1) and to unbatched
+    renders — pipelining must be invisible in the pixels;
+  * completions are **out of dispatch order** under a straggler: a slow
+    flight does not hold up the flights dispatched after it (pinned both
+    in-process and over HTTP);
+  * the engine's in-flight window is bounded, released on wait AND on
+    abandon (a hung device must not wedge later submits);
+  * the dispatch-gap metric reports device idle between flights (the
+    blocking mode shows real gaps; the metric is how BENCH rounds prove
+    the pipelined device never waits on the host).
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mpi_vision_tpu.serve import (
+    Fault,
+    FaultyEngine,
+    RenderEngine,
+    RenderService,
+    ResilienceConfig,
+    make_http_server,
+    synthetic_scene,
+)
+from mpi_vision_tpu.serve.cache import bake_scene
+
+H = W = 16
+P = 4
+
+
+def _pose(tx=0.0, tz=0.0):
+  pose = np.eye(4, dtype=np.float32)
+  pose[0, 3], pose[2, 3] = tx, tz
+  return pose
+
+
+def _scene(sid="s", seed=0):
+  return bake_scene(sid, *synthetic_scene(sid, H, W, P, seed=seed))
+
+
+# --- engine streaming API ------------------------------------------------
+
+
+def test_submit_poll_wait_matches_blocking():
+  eng = RenderEngine(use_mesh=False, max_inflight=4)
+  scene = _scene()
+  poses = np.stack([_pose(0.01 * i) for i in range(3)])
+  handle = eng.submit(scene, poses)
+  deadline = time.monotonic() + 60
+  while not eng.poll(handle) and time.monotonic() < deadline:
+    time.sleep(0.002)
+  out = eng.wait(handle)
+  assert out.shape == (3, H, W, 3)
+  assert handle.timings is not None
+  assert set(handle.timings) == {"h2d_s", "compute_s", "readback_s"}
+  np.testing.assert_array_equal(out, eng.render_batch(scene, poses))
+  assert eng.inflight == 0  # every slot released
+
+
+def test_engine_window_bounds_inflight_and_abandon_releases():
+  eng = RenderEngine(use_mesh=False, max_inflight=1)
+  scene = _scene()
+  h1 = eng.submit(scene, _pose()[None])
+  assert eng.inflight == 1
+  submitted = threading.Event()
+
+  def second():
+    h = eng.submit(scene, _pose(0.01)[None])  # blocks until a slot frees
+    submitted.set()
+    eng.wait(h)
+
+  t = threading.Thread(target=second, daemon=True)
+  t.start()
+  assert not submitted.wait(0.3)  # window of 1 really backpressures
+  # Abandon frees the slot WITHOUT waiting on the result...
+  h1.abandon()
+  assert submitted.wait(30)
+  t.join(30)
+  assert eng.abandoned == 1
+  # ...and the abandoned handle's late wait is still safe (idempotent
+  # slot release, result intact).
+  out = eng.wait(h1)
+  assert out.shape == (1, H, W, 3)
+  assert eng.inflight == 0
+
+
+def test_overlapped_submits_are_bit_identical_to_solo():
+  """Three batches in flight at once read back exactly what three
+  back-to-back blocking renders produce — the streaming engine's parity
+  contract."""
+  eng = RenderEngine(use_mesh=False, max_inflight=4)
+  scene = _scene()
+  all_poses = [np.stack([_pose(0.01 * i, -0.005 * j) for i in range(2)])
+               for j in range(3)]
+  handles = [eng.submit(scene, p) for p in all_poses]
+  outs = [eng.wait(h) for h in handles]
+  for poses, out in zip(all_poses, outs):
+    np.testing.assert_array_equal(out, eng.render_batch(scene, poses))
+
+
+# --- service: pipelined vs blocking parity -------------------------------
+
+
+def test_pipelined_service_matches_blocking_service_bitwise():
+  pose_list = [_pose(0.01 * i, 0.002 * i) for i in range(5)]
+  results = {}
+  for label, window in (("pipelined", 4), ("blocking", 1)):
+    svc = RenderService(max_batch=4, max_wait_ms=5.0, max_inflight=window,
+                        use_mesh=False)
+    svc.add_synthetic_scenes(1, height=H, width=W, planes=P)
+    try:
+      futs = [svc.render_async("scene_000", p) for p in pose_list]
+      results[label] = [f.result(120) for f in futs]
+    finally:
+      svc.close()
+  for a, b in zip(results["pipelined"], results["blocking"]):
+    np.testing.assert_array_equal(a, b)
+
+
+# --- out-of-order completion under a straggler ---------------------------
+
+
+def _straggler_service(max_inflight=4):
+  """A pipelined service over a FaultyEngine (no faults queued yet);
+  max_batch=1 so each request is its own flight."""
+  eng = FaultyEngine(RenderEngine(use_mesh=False, max_inflight=8))
+  svc = RenderService(engine=eng, max_batch=1, max_wait_ms=0.0,
+                      max_inflight=max_inflight, use_mesh=False,
+                      resilience=ResilienceConfig(
+                          max_retries=0, watchdog_s=60.0,
+                          breaker_threshold=100))
+  svc.add_synthetic_scenes(1, height=H, width=W, planes=P)
+  svc.warmup()
+  return svc, eng
+
+
+def test_futures_complete_out_of_dispatch_order_under_straggler():
+  svc, eng = _straggler_service()
+  try:
+    baseline = svc.render("scene_000", _pose(0.01))
+    eng.inject(Fault("slow", seconds=1.5))  # the NEXT dispatch straggles
+    slow = svc.render_async("scene_000", _pose(0.01))
+    # Wait until the straggler is actually in flight (claimed by its
+    # completion worker) so the fast one is provably dispatched AFTER.
+    deadline = time.monotonic() + 30
+    while svc.stats()["pipeline"]["inflight"] == 0 \
+        and time.monotonic() < deadline:
+      time.sleep(0.005)
+    fast = svc.render_async("scene_000", _pose(0.02))
+    out_fast = fast.result(30)
+    assert not slow.done()  # the later dispatch completed FIRST
+    out_slow = slow.result(30)
+    np.testing.assert_array_equal(out_slow, baseline)
+    assert out_fast.shape == (H, W, 3)
+    assert svc.stats()["pipeline"]["out_of_order_completions"] >= 1
+  finally:
+    eng.release.set()
+    svc.close()
+
+
+def test_http_completions_out_of_dispatch_order_under_straggler():
+  """The acceptance pin: two HTTP clients, the first request straggles,
+  the second response arrives first."""
+  svc, eng = _straggler_service()
+  httpd = make_http_server(svc, port=0)
+  threading.Thread(target=httpd.serve_forever, daemon=True).start()
+  base = f"http://127.0.0.1:{httpd.server_address[1]}"
+  completions = []
+  lock = threading.Lock()
+
+  def post(tag, tx):
+    body = json.dumps({"scene_id": "scene_000",
+                       "pose": _pose(tx).tolist()}).encode()
+    req = urllib.request.Request(base + "/render", data=body)
+    with urllib.request.urlopen(req, timeout=60) as resp:
+      assert resp.status == 200
+    with lock:
+      completions.append(tag)
+
+  try:
+    eng.inject(Fault("slow", seconds=1.5))
+    t_slow = threading.Thread(target=post, args=("slow", 0.01), daemon=True)
+    t_slow.start()
+    deadline = time.monotonic() + 30
+    while svc.stats()["pipeline"]["inflight"] == 0 \
+        and time.monotonic() < deadline:
+      time.sleep(0.005)
+    t_fast = threading.Thread(target=post, args=("fast", 0.02), daemon=True)
+    t_fast.start()
+    t_fast.join(30)
+    t_slow.join(30)
+    assert completions == ["fast", "slow"]
+  finally:
+    eng.release.set()
+    httpd.shutdown()
+    svc.close()
+
+
+# --- dispatch-gap metric -------------------------------------------------
+
+
+def test_blocking_mode_reports_dispatch_gaps():
+  """With a window of 1, every launch after a completion finds the
+  device idle — the gap metric must record it (the A/B baseline's
+  signature; the pipelined arm's gaps collapse toward zero under
+  saturation, proven per BENCH round by serve_load --ab)."""
+  svc = RenderService(max_batch=2, max_wait_ms=0.0, max_inflight=1,
+                      use_mesh=False)
+  svc.add_synthetic_scenes(1, height=H, width=W, planes=P)
+  try:
+    for i in range(3):
+      svc.render("scene_000", _pose(0.01 * i))
+    gap = svc.stats()["pipeline"]["dispatch_gap"]
+    assert gap["count"] >= 2
+    assert gap["total_s"] > 0 and gap["max_ms"] > 0
+  finally:
+    svc.close()
+
+
+def test_stats_pipeline_and_per_scene_blocks():
+  svc = RenderService(max_batch=2, max_wait_ms=1.0, max_inflight=3,
+                      use_mesh=False)
+  svc.add_synthetic_scenes(2, height=H, width=W, planes=P)
+  try:
+    svc.render("scene_000", _pose(0.01))
+    svc.render("scene_001", _pose(0.02))
+    stats = svc.stats()
+    assert json.loads(json.dumps(stats)) == stats  # JSON-clean
+    pipe = stats["pipeline"]
+    assert pipe["max_inflight"] == 3 and pipe["inflight"] == 0
+    assert pipe["abandoned_batches"] == 0
+    assert set(pipe["dispatch_gap"]) == {"count", "total_s", "mean_ms",
+                                         "max_ms"}
+    per_scene = stats["per_scene"]
+    assert set(per_scene) == {"scene_000", "scene_001"}
+    for entry in per_scene.values():
+      assert entry["requests"] == 1
+      assert entry["p50_ms"] > 0 and entry["max_ms"] >= entry["p50_ms"]
+  finally:
+    svc.close()
+
+
+def test_abandoned_flight_is_counted_and_engine_slot_freed():
+  """A flight whose every attempt trips the watchdog is abandoned: its
+  futures fail, abandoned_batches increments, and the engine window is
+  released so the NEXT request still dispatches."""
+  eng = FaultyEngine(RenderEngine(use_mesh=False, max_inflight=8))
+  svc = RenderService(engine=eng, max_batch=1, max_wait_ms=0.0,
+                      max_inflight=2, use_mesh=False,
+                      resilience=ResilienceConfig(
+                          max_retries=0, watchdog_s=0.5,
+                          breaker_threshold=100))
+  svc.add_synthetic_scenes(1, height=H, width=W, planes=P)
+  try:
+    svc.warmup()
+    eng.inject(Fault("hang", seconds=60.0))
+    with pytest.raises(Exception, match="deadline|abandoned"):
+      svc.render("scene_000", _pose(0.01), timeout=10.0)
+    assert svc.stats()["pipeline"]["abandoned_batches"] == 1
+    # The pipeline is still live: a clean request serves normally.
+    out = svc.render("scene_000", _pose(0.01), timeout=30.0)
+    assert out.shape == (H, W, 3)
+  finally:
+    eng.release.set()
+    svc.close()

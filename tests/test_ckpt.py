@@ -18,6 +18,7 @@ import numpy as np
 import pytest
 
 from mpi_vision_tpu.ckpt import (
+    BackgroundSaver,
     CheckpointStore,
     CorruptCheckpointError,
     NanGuard,
@@ -543,6 +544,151 @@ class TestFitResumable:
         on_epoch=lambda *a: time.sleep(0.2))
     assert report["final_step"] == 4
     assert dog.stalls == 0 and not fired
+
+
+class TestBackgroundSaver:
+  """ckpt/background.py: background-thread serialization that the step
+  loop never waits on — byte-identical publishes, surfaced failures,
+  flush-first reads, and the bit-exact fit_resumable contract intact."""
+
+  def test_publishes_byte_identical_checkpoint(self, rng, tmp_path):
+    tree = _tree(rng)
+    sync = CheckpointStore(str(tmp_path / "sync"))
+    sync.save(7, tree, meta={"cursor": {"epoch": 1, "batch": 2}})
+    bg = BackgroundSaver(CheckpointStore(str(tmp_path / "bg")))
+    bg.save(7, tree, meta={"cursor": {"epoch": 1, "batch": 2}})
+    bg.flush()
+    a = sync.restore(template=tree)
+    b = bg.restore(template=tree)
+    assert b.step == 7 and b.meta == a.meta
+    # Identical content hashes: the background path serializes the same
+    # bytes the synchronous path does.
+    assert ({k: v["sha256"] for k, v in a.manifest["arrays"].items()}
+            == {k: v["sha256"] for k, v in b.manifest["arrays"].items()})
+    assert bg.saves == 1
+
+  def test_latest_step_counts_pending_save(self, rng, tmp_path):
+    import threading
+
+    store = CheckpointStore(str(tmp_path))
+    gate = threading.Event()
+    real_save = store.save
+    store.save = lambda *a, **kw: (gate.wait(30), real_save(*a, **kw))[1]
+    bg = BackgroundSaver(store)
+    bg.save(5, _tree(rng))
+    # The save is still in flight (gated) but the dedupe check must see
+    # it — fit_resumable's epoch boundary would double-save otherwise.
+    assert bg.latest_step() == 5
+    assert store.latest_step() is None
+    gate.set()
+    bg.flush()
+    assert store.latest_step() == 5
+
+  def test_failed_save_surfaces_at_next_touch(self, rng, tmp_path):
+    store = CheckpointStore(str(tmp_path))
+    store.save = lambda *a, **kw: (_ for _ in ()).throw(
+        RuntimeError("disk full"))
+    bg = BackgroundSaver(store)
+    bg.save(1, _tree(rng))
+    with pytest.raises(RuntimeError, match="disk full"):
+      bg.flush()
+    # The parked error is consumed: the saver is reusable afterwards.
+    bg.flush()
+
+  def test_reads_flush_first(self, rng, tmp_path):
+    import threading
+
+    store = CheckpointStore(str(tmp_path))
+    gate = threading.Event()
+    real_save = store.save
+    store.save = lambda *a, **kw: (gate.wait(30), real_save(*a, **kw))[1]
+    bg = BackgroundSaver(store)
+    tree = _tree(rng)
+    bg.save(3, tree)
+    threading.Timer(0.1, gate.set).start()
+    # restore() must block for the in-flight save — a rollback has to be
+    # able to land on the checkpoint that was mid-write.
+    restored = bg.restore(template=tree)
+    assert restored is not None and restored.step == 3
+
+  def test_fit_resumable_with_background_saver_is_bit_exact(
+      self, tiny, tmp_path):
+    state, step = tiny
+    clean, r_clean = tloop.fit_resumable(
+        state, 2, _epoch, CheckpointStore(str(tmp_path / "sync")),
+        step=step, save_every=2, resume="never")
+    bg = BackgroundSaver(CheckpointStore(str(tmp_path / "bg")))
+    out, report = tloop.fit_resumable(
+        state, 2, _epoch, bg, step=step, save_every=2, resume="never")
+    _params_equal(clean.params, out.params)
+    assert report["losses"] == r_clean["losses"]
+    assert report["saves"] == r_clean["saves"]
+    # The loop's exit flush published everything: both stores hold the
+    # same final step.
+    assert (CheckpointStore(str(tmp_path / "bg")).latest_step()
+            == CheckpointStore(str(tmp_path / "sync")).latest_step())
+
+
+class TestSkipAheadResume:
+  """The skip-ahead data-cursor restore: a make_batches that accepts
+  ``skip`` seeks straight to the cursor, bit-exact against both the
+  replay path and the uninterrupted run."""
+
+  def test_skip_ahead_resume_matches_replay_and_clean(self, tiny, tmp_path):
+    state, step = tiny
+    clean, _ = tloop.fit_resumable(
+        state, 3, _epoch, CheckpointStore(str(tmp_path / "clean")),
+        step=step, save_every=2, resume="never")
+
+    def crash_then_resume(root, make_batches):
+      faults = TrainFaultSource().at_step(7, TrainFault("crash"))
+      store = CheckpointStore(str(root), fault_hook=faults.store_hook)
+      with pytest.raises(SimulatedCrash):
+        tloop.fit_resumable(state, 3, make_batches, store, step=step,
+                            save_every=2, resume="never",
+                            fault_source=faults)
+      return tloop.fit_resumable(
+          state, 3, make_batches, CheckpointStore(str(root)), step=step,
+          save_every=2, resume="auto")
+
+    skip_calls = []
+
+    def epoch_with_skip(e, skip=0):
+      skip_calls.append((e, skip))
+      return _epoch(e)[skip:]
+
+    replayed, r_replay = crash_then_resume(tmp_path / "replay", _epoch)
+    skipped, r_skip = crash_then_resume(tmp_path / "skip", epoch_with_skip)
+    assert r_replay["resumed_from"] == r_skip["resumed_from"] == 6
+    # The seek really happened: the resumed epoch was requested with a
+    # non-zero cursor skip.
+    assert any(s > 0 for _, s in skip_calls)
+    _params_equal(clean.params, replayed.params)
+    _params_equal(clean.params, skipped.params)
+    _params_equal(replayed.opt_state, skipped.opt_state)
+
+  def test_kwargs_only_callables_route_to_replay(self, tiny, tmp_path):
+    # A bare **kwargs would swallow ``skip`` without seeking — the loop
+    # must treat it as skip-incapable and replay instead.
+    state, step = tiny
+    calls = []
+
+    def sneaky(e, **kwargs):
+      calls.append(kwargs)
+      return _epoch(e)
+
+    faults = TrainFaultSource().at_step(5, TrainFault("preempt"))
+    store = CheckpointStore(str(tmp_path))
+    tloop.fit_resumable(state, 2, sneaky, store, step=step,
+                        resume="never", fault_source=faults)
+    out, report = tloop.fit_resumable(
+        state, 2, sneaky, CheckpointStore(str(tmp_path)), step=step,
+        resume="auto")
+    assert all(kw == {} for kw in calls)  # never called with skip=
+    clean, _ = tloop.fit_resumable(
+        state, 2, _epoch, CheckpointStore(str(tmp_path / "clean")),
+        step=step, resume="never")
+    _params_equal(clean.params, out.params)
 
 
 # -- checkpoint -> serve bridge -------------------------------------------

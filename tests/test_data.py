@@ -94,6 +94,35 @@ class TestExamples:
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0]
 
+  def test_iterate_batches_skip_matches_replay_train_split(
+      self, dataset_root):
+    """The skip-ahead cursor seek must yield the EXACT stream that
+    iterating past the skipped batches yields — including on the train
+    split, whose triplets draw from a stateful RNG per access
+    (skip_example consumes the draws without the frame IO)."""
+    def stream(skip):
+      ds = mvdata.RealEstateDataset(dataset_root, is_valid=False,
+                                    img_size=32, num_planes=4,
+                                    rng=np.random.default_rng(7))
+      return list(mvdata.iterate_batches(
+          ds, batch_size=1, rng=np.random.default_rng(3), skip=skip))
+
+    full = stream(0)
+    tail = stream(1)
+    assert len(tail) == len(full) - 1
+    for a, b in zip(full[1:], tail):
+      for key in a:
+        np.testing.assert_array_equal(np.asarray(a[key]),
+                                      np.asarray(b[key]))
+
+  def test_iterate_batches_skip_past_end_is_empty(self, dataset_root):
+    ds = mvdata.RealEstateDataset(dataset_root, is_valid=True,
+                                  img_size=32, num_planes=4)
+    assert list(mvdata.iterate_batches(ds, batch_size=1, shuffle=False,
+                                       skip=99)) == []
+    with pytest.raises(ValueError, match="skip"):
+      list(mvdata.iterate_batches(ds, batch_size=1, skip=-1))
+
   def test_train_split_randomizes(self, dataset_root):
     ds = mvdata.RealEstateDataset(dataset_root, is_valid=False, img_size=32,
                                   num_planes=4,

@@ -149,6 +149,51 @@ def chaos_schedule(seed: int, error_rate: float, slow_rate: float,
   return schedule
 
 
+def slo_window_config(duration: float):
+  """Objectives sized to the measured window so the verdict block judges
+  THIS run: the fast window reacts inside the load window (alerts can
+  fire and clear during a chaos phase) and the slow window spans the
+  whole measurement (the report card covers every request)."""
+  from mpi_vision_tpu.obs import SloConfig
+
+  fast = max(duration / 4.0, 0.5)
+  return SloConfig(fast_window_s=fast,
+                   slow_window_s=max(2.0 * duration, fast),
+                   bucket_s=max(fast / 8.0, 0.1))
+
+
+def cluster_slo_verdict(router_stats: dict) -> dict | None:
+  """The fleet-level pass/fail block from the router's aggregated view
+  (pool-weighted slow-window attainment vs the backends' targets)."""
+  fleet = router_stats.get("slo") or {}
+  attainment = fleet.get("attainment") or {}
+  targets = None
+  for st in router_stats.get("backends", {}).values():
+    slo = st.get("slo") if isinstance(st, dict) else None
+    if isinstance(slo, dict) and "objectives" in slo:
+      targets = {n: o["target"] for n, o in slo["objectives"].items()}
+      break
+  if not targets or not attainment:
+    return None
+  out = {"objectives": {},
+         "alerts_firing": dict(fleet.get("alerts_firing", {}))}
+  ok, scored = True, False
+  for name, tot in sorted(attainment.items()):
+    target = targets.get(name)
+    attained = tot["attained"]
+    passed = (None if attained is None or target is None
+              else attained >= target)
+    out["objectives"][name] = {
+        "target": target, "attained": attained,
+        "requests": tot["requests"], "pass": passed,
+    }
+    if passed is not None:
+      scored = True
+      ok = ok and passed
+  out["pass"] = ok if scored else None
+  return out
+
+
 def random_pose(rng: np.random.Generator) -> np.ndarray:
   """A small random truck/dolly/pedestal move (typical MPI viewing)."""
   pose = np.eye(4, dtype=np.float32)
@@ -235,6 +280,7 @@ def cluster_main(args) -> int:
       raise SystemExit("serve_load: no requests completed in the window")
     snap = router.metrics.snapshot()
     health = router.healthz()
+    rstats = router.stats()  # one fan-out: backend slo blocks + summary
     breakers = {b: snap["state"] for b, snap in health["breakers"].items()}
     rps = total / elapsed
     record = {
@@ -258,7 +304,13 @@ def cluster_main(args) -> int:
             "breakers": breakers,
             "health": health["status"],
             "failed_requests": dict(sorted(failure_counts.items())),
+            # Fleet SLO state as the router aggregates it (firing
+            # alerts per backend, hottest burns, pooled attainment).
+            "slo": rstats.get("slo"),
         },
+        # The same verdict block the in-process runs carry, judged from
+        # the pool-weighted slow-window attainment.
+        "slo": cluster_slo_verdict(rstats),
     }
     print(json.dumps(record))
     return 0
@@ -270,6 +322,7 @@ def inprocess_run(args, inflight: int) -> dict:
   """One measured in-process load window at the given pipeline window;
   returns the headline JSON record (the single-run mode prints exactly
   this; ``--ab`` calls it twice)."""
+  from mpi_vision_tpu.obs import slo as slo_mod
   from mpi_vision_tpu.serve import (
       FaultyEngine,
       RenderEngine,
@@ -297,7 +350,8 @@ def inprocess_run(args, inflight: int) -> dict:
       cache_bytes=args.cache_mb << 20, max_batch=args.max_batch,
       max_wait_ms=args.max_wait_ms, max_inflight=inflight,
       method=args.method, use_mesh=use_mesh,
-      engine=engine, resilience=resilience, tracer=tracer)
+      engine=engine, resilience=resilience, tracer=tracer,
+      slo=slo_window_config(args.duration))
   ids = svc.add_synthetic_scenes(
       args.scenes, height=args.img_size, width=args.img_size,
       planes=args.num_planes, seed=args.seed)
@@ -405,6 +459,10 @@ def inprocess_run(args, inflight: int) -> dict:
       "resilience": stats["resilience"],
       "breaker_state": (stats["breaker"]["state"]
                         if "breaker" in stats else None),
+      # The SLO verdict block: objectives vs slow-window attainment,
+      # burn rates, and whether alerts fired — BENCH lines now trend
+      # against explicit objectives instead of raw percentiles.
+      "slo": slo_mod.verdict(stats.get("slo")),
   }
   if args.chaos:
     record["chaos_injected"] = stats["engine"]["fault_injection"]

@@ -146,3 +146,15 @@ class BackgroundSaver:
   @property
   def quarantined(self):
     return self.store.quarantined
+
+  @property
+  def last_save_s(self):
+    """Cost of the newest PUBLISHED save. Deliberately not flushed: the
+    step-loop telemetry reads this every save, and with background
+    serialization it reports the previous completed save's cost — the
+    honest async number (the loop never waited on the current one)."""
+    return self.store.last_save_s
+
+  @property
+  def last_save_bytes(self):
+    return self.store.last_save_bytes

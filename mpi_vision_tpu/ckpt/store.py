@@ -219,17 +219,27 @@ class CheckpointStore:
       stage ``"pre_rename"`` (staging dir fully written and fsynced) and
       ``"post_rename"`` (checkpoint published). ``TrainFaultSource``
       plugs in here to simulate kill-mid-save and corrupt-after-write.
+    events: optional ``obs.events.EventLog`` — checkpoint lifecycle
+      (save / restore / quarantine) is exactly the record an incident
+      review greps for, so the store emits it at the source instead of
+      every caller remembering to.
   """
 
   def __init__(self, root: str, keep: int = 3,
                clock: Callable[[], float] = time.time,
-               fault_hook: Callable[[str, str], None] | None = None):
+               fault_hook: Callable[[str, str], None] | None = None,
+               events=None):
     if keep < 1:
       raise ValueError(f"keep must be >= 1, got {keep}")
     self.root = os.path.abspath(root)
     self.keep = int(keep)
     self._clock = clock
     self._fault_hook = fault_hook
+    self.events = events
+    # Cost of the newest PUBLISHED save (telemetry reads these; wall
+    # clock, same base as the manifest timestamps).
+    self.last_save_s = 0.0
+    self.last_save_bytes = 0
     self._seq = 0
     # Writer identity for working-dir names: pid alone is ambiguous
     # after a reboot (recycled pids), so append the process start time
@@ -319,6 +329,7 @@ class CheckpointStore:
     step = int(step)
     if step < 0:
       raise ValueError(f"step must be >= 0, got {step}")
+    t_save = self._clock()
     arrays = flatten_arrays(jax.device_get(tree))
     self._seq += 1
     final = self._step_dir(step)
@@ -383,6 +394,13 @@ class CheckpointStore:
         os.rename(aside, final)
       raise
     self.saves += 1
+    self.last_save_s = max(self._clock() - t_save, 0.0)
+    self.last_save_bytes = sum(a.nbytes for a in arrays.values())
+    if self.events is not None:
+      self.events.emit("ckpt_save", step=step,
+                       bytes=self.last_save_bytes,
+                       seconds=round(self.last_save_s, 6),
+                       reason=str((meta or {}).get("reason", "")))
     if self._fault_hook is not None:
       self._fault_hook("post_rename", final)
     self.gc()
@@ -530,6 +548,8 @@ class CheckpointStore:
     os.rename(src, dst)
     _fsync_dir(self.root)
     self.quarantined += 1
+    if self.events is not None:
+      self.events.emit("ckpt_quarantine", step=int(step), reason=reason)
     return dst
 
   def restore(self, step: int | None = None, template=None,
@@ -578,5 +598,7 @@ class CheckpointStore:
                           manifest=manifest, path=path)
       if template is not None:
         restored.tree(template)  # raises KeyError on structure mismatch
+      if self.events is not None:
+        self.events.emit("ckpt_restore", step=restored.step)
       return restored
     return None

@@ -73,10 +73,17 @@ def cmd_train(args: argparse.Namespace) -> dict:
         ("--keep", args.keep is not None),
         ("--nan-guard/--no-nan-guard", args.nan_guard is not None),
         ("--async-save", args.async_save),
-        ("--stall-timeout-s", args.stall_timeout_s > 0)) if on]
+        ("--stall-timeout-s", args.stall_timeout_s > 0),
+        ("--metrics-port", args.metrics_port is not None),
+        ("--metrics-log", bool(args.metrics_log)),
+        ("--event-log", bool(args.event_log))) if on]
     if wants_ckpt:
       raise SystemExit(
           f"{', '.join(wants_ckpt)} require(s) --ckpt <dir>")
+  if args.metrics_port_file and args.metrics_port is None:
+    # The port file is only ever written by the metrics listener; a
+    # supervisor waiting on it would hang forever.
+    raise SystemExit("--metrics-port-file requires --metrics-port")
 
   root = args.dataset
   if args.synthetic:
@@ -187,6 +194,8 @@ def cmd_train(args: argparse.Namespace) -> dict:
   t0 = time.time()
   all_losses, valid_losses = [], []
   ckpt_report = None
+  telemetry = None
+  metrics_port = None
 
   def log_epoch(epoch_state, epoch, losses):
     if not losses:
@@ -233,29 +242,69 @@ def cmd_train(args: argparse.Namespace) -> dict:
           epoch_ds, batch_size=cfg.data.batch_size,
           rng=np.random.default_rng([args.seed, 202, epoch]), skip=skip))
 
+    # Training telemetry (PR 8): the run exports mpi_train_* exactly
+    # like a serve backend — a stdlib /metrics listener plus an optional
+    # JSONL sink — and lifecycle events (saves, rollbacks, preemptions)
+    # land in a bounded event log served at /debug/events.
+    ev, metrics_httpd = None, None
+    if args.metrics_port is not None or args.metrics_log:
+      from mpi_vision_tpu.train import telemetry as telemetry_mod
+
+      sink = (telemetry_mod.file_metrics_sink(args.metrics_log)
+              if args.metrics_log else None)
+      telemetry = telemetry_mod.TrainMetrics(sink=sink)
+    if args.event_log or args.metrics_port is not None:
+      from mpi_vision_tpu.obs import events as events_mod
+
+      ev = events_mod.EventLog(
+          sink=events_mod.file_sink(args.event_log)
+          if args.event_log else None)
+
     store = CheckpointStore(
         os.path.abspath(args.ckpt),
-        keep=args.keep if args.keep is not None else 3)
+        keep=args.keep if args.keep is not None else 3, events=ev)
     if args.async_save:
       # Background-thread serialization: the step loop keeps training
       # while the previous state hashes/serializes/fsyncs; the loop
       # flushes on exit so every save is published by the time the
       # summary prints.
       store = BackgroundSaver(store, log=_log)
-    watchdog = (StallWatchdog(args.stall_timeout_s,
-                              on_stall=lambda idle: _log(
-                                  f"train: WATCHDOG no step completed in "
-                                  f"{idle:.0f}s (device hang?)"))
+
+    def on_stall(idle):
+      _log(f"train: WATCHDOG no step completed in {idle:.0f}s "
+           "(device hang?)")
+      if ev is not None:
+        ev.emit("stall", idle_s=round(idle, 3))
+
+    watchdog = (StallWatchdog(args.stall_timeout_s, on_stall=on_stall)
                 if args.stall_timeout_s > 0 else None)
-    with PreemptionGuard() as preemption:
-      state, ckpt_report = train_loop.fit_resumable(
-          state, cfg.epochs, make_batches, store, step=step,
-          save_every=args.save_every,
-          meta={"model": cfg.model_meta(), "seed": args.seed},
-          resume="auto" if args.resume else "never",
-          nan_guard=None if args.nan_guard is False else NanGuard(),
-          watchdog=watchdog, preemption=preemption,
-          on_epoch=log_epoch, log=_log)
+    if args.metrics_port is not None:
+      import threading
+
+      from mpi_vision_tpu.train.telemetry import make_train_metrics_server
+
+      metrics_httpd = make_train_metrics_server(
+          telemetry, events=ev, host="127.0.0.1", port=args.metrics_port)
+      metrics_port = metrics_httpd.server_address[1]
+      if args.metrics_port_file:
+        _write_port_file(args.metrics_port_file, metrics_port)
+      threading.Thread(target=metrics_httpd.serve_forever,
+                       daemon=True).start()
+      _log(f"train: metrics on http://127.0.0.1:{metrics_port} "
+           "(/metrics, /stats, /healthz, /debug/events)")
+    try:
+      with PreemptionGuard() as preemption:
+        state, ckpt_report = train_loop.fit_resumable(
+            state, cfg.epochs, make_batches, store, step=step,
+            save_every=args.save_every,
+            meta={"model": cfg.model_meta(), "seed": args.seed},
+            resume="auto" if args.resume else "never",
+            nan_guard=None if args.nan_guard is False else NanGuard(),
+            watchdog=watchdog, preemption=preemption,
+            on_epoch=log_epoch, telemetry=telemetry, events=ev, log=_log)
+    finally:
+      if metrics_httpd is not None:
+        metrics_httpd.shutdown()
     if args.resume and ckpt_report["resumed_from"] is not None:
       # Bit-exact resume restored the WHOLE optimizer state, including
       # the checkpointed learning rate — an explicit --lr only seeds
@@ -318,6 +367,12 @@ def cmd_train(args: argparse.Namespace) -> dict:
           "nan_rollbacks": ckpt_report["nan_rollbacks"],
           "quarantined": ckpt_report["quarantined"],
       }} if ckpt_report is not None else {}),
+      **({"telemetry": {
+          "steps": telemetry.snapshot()["steps"],
+          "examples_per_sec": telemetry.snapshot()["examples_per_sec"],
+          **({"metrics_port": metrics_port}
+             if metrics_port is not None else {}),
+      }} if telemetry is not None else {}),
       "seconds": round(time.time() - t0, 1),
   }
 
@@ -363,6 +418,9 @@ def cmd_serve(args: argparse.Namespace) -> dict:
     # 0 would come up "healthy" serving no checkpoint scenes at all
     # (every /render 404s unless --mpi-dir supplied others).
     raise SystemExit(f"--ckpt-scenes must be >= 1, got {args.ckpt_scenes}")
+  if args.profile_hook and not args.profile_dir:
+    # A hook with no captures to hand it is a silently-dead knob.
+    raise SystemExit("--profile-hook requires --profile-dir")
 
   use_mesh = {"auto": None, "on": True, "off": False}[args.sharded]
   resilience = None
@@ -378,13 +436,44 @@ def cmd_serve(args: argparse.Namespace) -> dict:
   if args.trace:
     tracer = Tracer(ring=args.trace_ring,
                     emit=_log if args.trace_log else None)
+  # SLO judgment layer: objectives + burn-rate alerting over the request
+  # stream, folded into /healthz and exported as mpi_slo_* (obs/slo.py).
+  slo = None
+  if args.slo:
+    from mpi_vision_tpu.obs import SloConfig
+
+    slo = SloConfig(
+        availability_target=args.slo_availability,
+        latency_threshold_s=args.slo_latency_ms / 1e3,
+        latency_target=args.slo_latency_target,
+        fast_window_s=args.slo_fast_window_s,
+        slow_window_s=args.slo_slow_window_s,
+        burn_threshold=args.slo_burn_threshold)
+  events = None
+  if args.event_log:
+    from mpi_vision_tpu.obs import events as events_mod
+
+    events = events_mod.EventLog(sink=events_mod.file_sink(args.event_log))
+  profile_hook = None
+  if args.profile_hook:
+    import shlex
+    import subprocess
+
+    hook_argv = shlex.split(args.profile_hook)
+
+    def profile_hook(capture_dir, _argv=hook_argv):
+      # The finished capture dir rides as the last argv element; any
+      # failure surfaces as a counted, non-fatal hook error.
+      subprocess.run([*_argv, capture_dir], check=True, timeout=600)
+
   svc = RenderService(
       cache_bytes=args.cache_mb << 20, max_batch=args.max_batch,
       max_wait_ms=args.max_wait_ms, max_inflight=args.max_inflight,
       method=args.method, use_mesh=use_mesh,
       max_queue=args.max_queue, resilience=resilience,
       cpu_fallback=args.cpu_fallback, tracer=tracer,
-      profile_dir=args.profile_dir or None,
+      profile_dir=args.profile_dir or None, profile_hook=profile_hook,
+      slo=slo, events=events,
       metrics_ttl_s=args.metrics_ttl_ms / 1e3)
   if args.mpi_dir:
     from mpi_vision_tpu.core.camera import intrinsics_matrix, inv_depths
@@ -492,7 +581,8 @@ def cmd_serve(args: argparse.Namespace) -> dict:
   thread = threading.Thread(target=httpd.serve_forever, daemon=True)
   thread.start()
   _log(f"serve: listening on http://{args.host}:{port} "
-       f"(/render, /healthz, /stats, /metrics, /debug/traces"
+       f"(/render, /healthz, /stats, /metrics, /debug/traces, "
+       f"/debug/events"
        f"{', /debug/profile' if svc.profiler is not None else ''}); "
        f"engine {svc.engine.describe()}")
 
@@ -527,6 +617,13 @@ def cmd_serve(args: argparse.Namespace) -> dict:
       "rejected": stats["rejected"],
       "resilience": stats["resilience"],
       "pipeline": stats["pipeline"],
+      **({"slo": {
+          "alerts_firing": stats["slo"]["alerts_firing"],
+          "alerts_fired": {
+              name: obj["alert"]["fired"]
+              for name, obj in stats["slo"]["objectives"].items()},
+      }} if "slo" in stats else {}),
+      "events_emitted": stats["events"]["emitted"],
       **({"traces": svc.tracer.finished} if args.trace else {}),
       **({"ckpt_step": ckpt_info["step"],
           "ckpt_params_digest": ckpt_info["params_digest"][:16]}
@@ -705,6 +802,22 @@ def build_parser() -> argparse.ArgumentParser:
   t.add_argument("--stall-timeout-s", type=float, default=0.0,
                  help="warn when no step completes for this long "
                       "(<= 0 disables the stall watchdog)")
+  t.add_argument("--metrics-port", type=int, default=None,
+                 help="export live training telemetry on this HTTP port "
+                      "(0 = ephemeral, logged on stderr): /metrics "
+                      "(mpi_train_* Prometheus families), /stats, "
+                      "/healthz, /debug/events — scrape a training run "
+                      "exactly like a serve backend; requires --ckpt")
+  t.add_argument("--metrics-port-file", default="",
+                 help="write the bound metrics port here (atomic "
+                      "tmp+rename) once listening")
+  t.add_argument("--metrics-log", default="",
+                 help="append one JSON line per training step and "
+                      "checkpoint save to this file; requires --ckpt")
+  t.add_argument("--event-log", default="",
+                 help="append one JSON line per lifecycle event (saves, "
+                      "restores, quarantines, NaN rollbacks, preemption, "
+                      "stalls) to this file; requires --ckpt")
   t.add_argument("--export-html", default="",
                  help="write a viewer HTML of a validation MPI here")
   t.set_defaults(fn=cmd_train)
@@ -818,6 +931,38 @@ def build_parser() -> argparse.ArgumentParser:
   s.add_argument("--profile-dir", default="",
                  help="enable /debug/profile?seconds=N device captures "
                       "(jax.profiler) into this TensorBoard logdir")
+  s.add_argument("--profile-hook", default="",
+                 help="run this command with each finished capture's "
+                      "directory appended to its argv (artifact upload); "
+                      "failures are counted and reported, never fatal; "
+                      "requires --profile-dir")
+  s.add_argument("--event-log", default="",
+                 help="append one JSON line per lifecycle event (breaker "
+                      "transitions, scene swaps, SLO alert edges) to "
+                      "this file; /debug/events serves the bounded ring "
+                      "either way")
+  s.add_argument("--slo", action=argparse.BooleanOptionalAction,
+                 default=True,
+                 help="track availability + latency SLOs with "
+                      "multi-window burn-rate alerting (obs/slo.py): "
+                      "an slo block in /stats, mpi_slo_* in /metrics, "
+                      "firing alerts fold into /healthz as degraded")
+  s.add_argument("--slo-availability", type=float, default=0.99,
+                 help="availability objective (good-request fraction)")
+  s.add_argument("--slo-latency-ms", type=float, default=1000.0,
+                 help="latency objective threshold: a completed request "
+                      "is good when it finishes under this")
+  s.add_argument("--slo-latency-target", type=float, default=0.95,
+                 help="fraction of completed requests that must beat "
+                      "--slo-latency-ms")
+  s.add_argument("--slo-fast-window-s", type=float, default=60.0,
+                 help="fast burn-rate window (alert edges: fire needs "
+                      "both windows hot, clear needs only this one cool)")
+  s.add_argument("--slo-slow-window-s", type=float, default=600.0,
+                 help="slow burn-rate window (the report-card window)")
+  s.add_argument("--slo-burn-threshold", type=float, default=10.0,
+                 help="error-budget burn rate (x sustainable) at which "
+                      "the alert fires")
   s.add_argument("--metrics-ttl-ms", type=float, default=250.0,
                  help="memoize the /metrics exposition string this long "
                       "(scrape storms cost one snapshot render per "

@@ -19,8 +19,17 @@ per-stage accounting, not a single end-to-end number:
   * ``profile`` — on-demand device profiling: a concurrency-guarded
     wrapper over ``jax.profiler`` (via ``debug.trace``) capturing live
     traffic for N seconds (``/debug/profile``, ``serve --profile-dir``).
+  * ``slo`` — the judgment layer over the raw counters: sliding-window
+    availability + latency objectives with multi-window burn-rate
+    alerting (``SloTracker``), surfaced in ``/stats``, ``/metrics``
+    (``mpi_slo_*``), and the ``/healthz`` state machine.
+  * ``events`` — a bounded structured lifecycle event log (breaker
+    transitions, failovers, scene swaps, checkpoint lifecycle, NaN
+    rollbacks, alert fire/clear) served at ``/debug/events`` with an
+    optional JSONL file sink.
 """
 
+from mpi_vision_tpu.obs.events import NULL_EVENTS, EventLog, file_sink
 from mpi_vision_tpu.obs.profile import DeviceProfiler, ProfileBusyError
 from mpi_vision_tpu.obs.prom import (
     ExpositionCache,
@@ -31,6 +40,7 @@ from mpi_vision_tpu.obs.prom import (
     render_serve_metrics,
     serve_registry,
 )
+from mpi_vision_tpu.obs.slo import SloConfig, SloTracker
 from mpi_vision_tpu.obs.trace import (
     NULL_TRACE,
     NULL_TRACER,

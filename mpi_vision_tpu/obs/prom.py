@@ -294,7 +294,8 @@ class ExpositionCache:
       self._text = None
 
 
-def aggregate_metrics_texts(texts, extra: "Registry | None" = None) -> str:
+def aggregate_metrics_texts(texts, extra: "Registry | None" = None,
+                            drop=frozenset()) -> str:
   """Sum several Prometheus expositions into one (the cluster /metrics).
 
   Every sample with the same ``(family, sample name, labels)`` key is
@@ -305,6 +306,14 @@ def aggregate_metrics_texts(texts, extra: "Registry | None" = None) -> str:
   first-seen order and HELP/TYPE text; ``extra`` (e.g. the router's own
   registry) is appended verbatim after the aggregated families.
 
+  ``drop`` names families to OMIT from the aggregate: ratio/config
+  gauges (SLO targets, attainment ratios, burn rates) are meaningless
+  summed — 3 backends' 0.99 target would read 2.97, and one idle
+  backend's NaN attainment would poison the whole fleet's sample. Those
+  stay per-backend surfaces (``/stats``'s fan-out carries them); the
+  summable slices (window request/bad counts, alert-firing one-hots,
+  edge counters) still aggregate.
+
   Dead backends simply contribute nothing — aggregated counters dip when
   a backend is lost, which is itself the signal (the router's
   ``mpi_cluster_backend_up`` gauge says which one).
@@ -313,6 +322,8 @@ def aggregate_metrics_texts(texts, extra: "Registry | None" = None) -> str:
   fams: dict[str, dict] = {}
   for text in texts:
     for name, fam in parse_metrics_text(text).items():
+      if name in drop:
+        continue
       agg = fams.get(name)
       if agg is None:
         agg = fams[name] = {"type": fam["type"], "help": fam["help"],

@@ -1,0 +1,444 @@
+"""SLO engine: sliding-window objectives + multi-window burn-rate alerts.
+
+The judgment layer on top of the raw telemetry (PR 3's counters say what
+happened; this module says whether the fleet is *meeting objectives*).
+Two objectives over the serve request stream, both expressed as "fraction
+of good events":
+
+  * **availability** — a request is good when it completed without an
+    error (errors, queue sheds, and breaker fast-fails are bad events:
+    the user saw a failure either way).
+  * **latency** — a *completed* request is good when its end-to-end
+    latency is under ``latency_threshold_s`` (FastNeRF's 200 FPS target
+    is only meaningful against exactly this kind of tracked bound).
+
+Alerting follows the SRE-workbook multi-window burn-rate scheme: the
+**burn rate** is ``(1 - attainment) / (1 - target)`` — 1.0 means the
+error budget is being consumed exactly at the sustainable rate, 10x
+means ten times too fast. An alert fires when the burn rate exceeds
+``burn_threshold`` over BOTH the slow window (the problem is material)
+and the fast window (the problem is happening *now*, not a stale spike
+still inside the long window), and clears as soon as the fast window's
+burn drops back under the threshold — recovery is visible within
+``fast_window_s`` instead of lingering for the whole slow window.
+
+Implementation is a ring of coarse time buckets (O(1) record, O(buckets)
+snapshot, bounded memory regardless of traffic), driven entirely by an
+injectable clock so every rotation/alert edge is testable with fake time
+(``tests/serve/test_slo.py``; clock-lint covers this file).
+
+``SloTracker.registry()`` renders the state as ``mpi_slo_*`` Prometheus
+families; ``verdict()`` turns a snapshot into the pass/fail block
+``bench/serve_load.py`` embeds in its JSON so BENCH lines trend against
+explicit objectives.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import threading
+import time
+
+from mpi_vision_tpu.obs import prom
+
+PREFIX = "mpi_slo_"
+
+# Families a pool aggregator must NOT sum across backends: targets,
+# ratios, and thresholds are per-backend statements (3 x 0.99 targets
+# summed would read 2.97, and an idle backend's NaN attainment would
+# poison the fleet sample). The cluster router drops these from its
+# summed exposition; the per-backend values stay reachable through the
+# /stats fan-out. Everything else mpi_slo_* exports sums meaningfully
+# (window counts add; alert_firing becomes "firing backends").
+NON_ADDITIVE_FAMILIES = frozenset({
+    PREFIX + "objective_target",
+    PREFIX + "attainment_ratio",
+    PREFIX + "burn_rate",
+    PREFIX + "latency_threshold_seconds",
+    PREFIX + "burn_threshold",
+})
+
+_OBJECTIVES = ("availability", "latency")
+
+
+@dataclasses.dataclass(frozen=True)
+class SloConfig:
+  """Objectives + alerting knobs (the ``serve`` CLI flags map 1:1).
+
+  Defaults suit a serving demo fleet: 99% availability, 95% of requests
+  under 1 s, alert at 10x budget burn confirmed over a 60 s fast / 600 s
+  slow window pair. ``min_requests`` keeps a single bad request on an
+  idle service from paging.
+  """
+
+  availability_target: float = 0.99
+  latency_threshold_s: float = 1.0
+  latency_target: float = 0.95
+  fast_window_s: float = 60.0
+  slow_window_s: float = 600.0
+  burn_threshold: float = 10.0
+  bucket_s: float | None = None  # None: fast_window_s / 12, floored 0.25
+  min_requests: int = 10
+
+  def __post_init__(self):
+    for name in ("availability_target", "latency_target"):
+      v = getattr(self, name)
+      if not 0.0 < v < 1.0:
+        raise ValueError(f"{name} must be in (0, 1), got {v}")
+    if self.latency_threshold_s <= 0:
+      raise ValueError(
+          f"latency_threshold_s must be > 0, got {self.latency_threshold_s}")
+    if not 0 < self.fast_window_s <= self.slow_window_s:
+      raise ValueError(
+          f"need 0 < fast_window_s <= slow_window_s, got "
+          f"{self.fast_window_s} / {self.slow_window_s}")
+    if self.burn_threshold <= 0:
+      raise ValueError(
+          f"burn_threshold must be > 0, got {self.burn_threshold}")
+    if self.bucket_s is not None and not (
+        0 < self.bucket_s <= self.fast_window_s):
+      raise ValueError(
+          f"bucket_s must be in (0, fast_window_s], got {self.bucket_s}")
+
+  def resolved_bucket_s(self) -> float:
+    if self.bucket_s is not None:
+      return float(self.bucket_s)
+    return max(self.fast_window_s / 12.0, 0.25)
+
+  def target(self, objective: str) -> float:
+    return (self.availability_target if objective == "availability"
+            else self.latency_target)
+
+
+class _Alert:
+  """One objective's fire/clear state machine (single-threaded under the
+  tracker's lock)."""
+
+  __slots__ = ("firing", "fired", "cleared", "since")
+
+  def __init__(self):
+    self.firing = False
+    self.fired = 0
+    self.cleared = 0
+    self.since: float | None = None  # tracker-clock time of last fire
+
+
+def burn_rate(bad: int, total: int, target: float) -> float:
+  """Error-budget consumption rate over one window (0 when idle)."""
+  if total <= 0:
+    return 0.0
+  return (bad / total) / (1.0 - target)
+
+
+class SloTracker:
+  """Sliding-window SLO accounting + burn-rate alerting over requests.
+
+  Args:
+    config: objectives + alert knobs.
+    clock: injectable monotonic clock driving bucket rotation and alert
+      edges (share with the serving stack's other clocks).
+    on_alert: optional ``(objective, firing, details) -> None`` callback
+      fired on every alert transition (the serving layer routes it into
+      the event log). Exceptions are swallowed and counted — alerting
+      must not be able to fail the request path.
+  """
+
+  def __init__(self, config: SloConfig | None = None, clock=time.monotonic,
+               on_alert=None):
+    self.config = config if config is not None else SloConfig()
+    self._clock = clock
+    self.on_alert = on_alert
+    self._bucket_s = self.config.resolved_bucket_s()
+    # +1: the current (partial) bucket rides along with a full slow
+    # window of closed ones.
+    self._ring_len = int(math.ceil(
+        self.config.slow_window_s / self._bucket_s)) + 1
+    self._lock = threading.Lock()
+    self.alert_errors = 0
+    self.reset()
+
+  def reset(self) -> None:
+    """Drop all window state and alert history (load generators call
+    this after warm-up, mirroring ``ServeMetrics.reset``)."""
+    with self._lock:
+      # Ring of [bucket_index, total, bad, lat_total, lat_bad].
+      self._buckets: list[list] = []
+      self._alerts = {name: _Alert() for name in _OBJECTIVES}
+      self.total = 0
+      self.bad = 0
+
+  # -- recording -----------------------------------------------------------
+
+  def _bucket_locked(self, now: float) -> tuple[list, bool]:
+    """The current bucket, plus whether it was freshly opened."""
+    idx = int(now // self._bucket_s)
+    rotated = not self._buckets or self._buckets[-1][0] < idx
+    if rotated:
+      self._buckets.append([idx, 0, 0, 0, 0])
+      floor = idx - self._ring_len + 1
+      while self._buckets and self._buckets[0][0] < floor:
+        self._buckets.pop(0)
+    return self._buckets[-1], rotated
+
+  def record(self, ok: bool, latency_s: float | None = None,
+             count: int = 1) -> None:
+    """Account ``count`` request outcomes.
+
+    ``ok=False`` consumes availability budget; ``latency_s`` (completed
+    requests only) additionally scores the latency objective.
+    """
+    with self._lock:
+      bucket, rotated = self._bucket_locked(self._clock())
+      bucket[1] += count
+      self.total += count
+      bad = not ok
+      if bad:
+        bucket[2] += count
+        self.bad += count
+      if latency_s is not None:
+        bucket[3] += count
+        if latency_s > self.config.latency_threshold_s:
+          bucket[4] += count
+          bad = True
+      # The full alert evaluation walks the whole bucket ring; this is
+      # the serving hot path (every completed request lands here), so
+      # only run it when an edge is actually possible: a bad event can
+      # FIRE, any event can CLEAR a firing alert (good traffic dilutes
+      # the fast burn), and a bucket rotation ages bad history out.
+      # Healthy steady state — good events, nothing firing — pays one
+      # scan per bucket_s instead of one per request; snapshot()/
+      # alerts_firing() still re-check on every scrape.
+      need_check = (bad or rotated
+                    or any(a.firing for a in self._alerts.values()))
+    if need_check:
+      self.check()
+
+  def record_bad(self, count: int = 1) -> None:
+    """Shorthand for failures with no latency sample (errors, sheds)."""
+    self.record(ok=False, count=count)
+
+  # -- window math ---------------------------------------------------------
+
+  def _window_locked(self, now: float, window_s: float) -> tuple:
+    """(total, bad, lat_total, lat_bad) over the trailing window."""
+    floor = int(now // self._bucket_s) - int(
+        math.ceil(window_s / self._bucket_s)) + 1
+    total = bad = lat_total = lat_bad = 0
+    for idx, t, b, lt, lb in self._buckets:
+      if idx >= floor:
+        total += t
+        bad += b
+        lat_total += lt
+        lat_bad += lb
+    return total, bad, lat_total, lat_bad
+
+  def _burns_locked(self, now: float) -> dict:
+    """Per-objective per-window (total, bad, burn) triples."""
+    out = {}
+    for wname, wsec in (("fast", self.config.fast_window_s),
+                        ("slow", self.config.slow_window_s)):
+      total, bad, lat_total, lat_bad = self._window_locked(now, wsec)
+      out.setdefault("availability", {})[wname] = (
+          total, bad,
+          burn_rate(bad, total, self.config.availability_target))
+      out.setdefault("latency", {})[wname] = (
+          lat_total, lat_bad,
+          burn_rate(lat_bad, lat_total, self.config.latency_target))
+    return out
+
+  # -- alerting ------------------------------------------------------------
+
+  def check(self) -> list[str]:
+    """Evaluate alert transitions; returns objectives that CHANGED state.
+
+    Called from every ``record`` and every ``snapshot`` (so a scrape of
+    an idle service still clears a stale alert once the fast window
+    drains).
+    """
+    transitions = []
+    callbacks = []
+    with self._lock:
+      now = self._clock()
+      burns = self._burns_locked(now)
+      thr = self.config.burn_threshold
+      for name in _OBJECTIVES:
+        fast_total, _, fast_burn = burns[name]["fast"]
+        slow_total, _, slow_burn = burns[name]["slow"]
+        alert = self._alerts[name]
+        if not alert.firing:
+          # Fire: budget burning too fast over BOTH windows (the fast
+          # window confirms the problem is current), with enough traffic
+          # in the fast window to mean anything.
+          if (fast_total >= self.config.min_requests
+              and fast_burn >= thr and slow_burn >= thr):
+            alert.firing = True
+            alert.fired += 1
+            alert.since = now
+            transitions.append(name)
+            callbacks.append((name, True, {
+                "fast_burn": round(fast_burn, 3),
+                "slow_burn": round(slow_burn, 3),
+                "threshold": thr}))
+        elif fast_burn < thr:
+          # Clear: the fast window says the bleeding stopped (the slow
+          # window may stay elevated for its whole width — that is
+          # history, not an ongoing incident).
+          alert.firing = False
+          alert.cleared += 1
+          alert.since = None
+          transitions.append(name)
+          callbacks.append((name, False, {
+              "fast_burn": round(fast_burn, 3), "threshold": thr}))
+    for name, firing, details in callbacks:
+      if self.on_alert is not None:
+        try:
+          self.on_alert(name, firing, details)
+        except Exception:  # noqa: BLE001 - alerting must not fail requests
+          with self._lock:
+            self.alert_errors += 1
+    return transitions
+
+  def alerts_firing(self) -> list[str]:
+    self.check()
+    with self._lock:
+      return [n for n in _OBJECTIVES if self._alerts[n].firing]
+
+  # -- export --------------------------------------------------------------
+
+  def snapshot(self) -> dict:
+    """The ``/stats`` ``slo`` block (JSON-ready)."""
+    self.check()
+    with self._lock:
+      now = self._clock()
+      burns = self._burns_locked(now)
+      cfg = self.config
+      out = {
+          "config": {
+              "availability_target": cfg.availability_target,
+              "latency_threshold_ms": round(cfg.latency_threshold_s * 1e3, 3),
+              "latency_target": cfg.latency_target,
+              "fast_window_s": cfg.fast_window_s,
+              "slow_window_s": cfg.slow_window_s,
+              "burn_threshold": cfg.burn_threshold,
+              "min_requests": cfg.min_requests,
+          },
+          "objectives": {},
+          "alerts_firing": [],
+          "alert_errors": self.alert_errors,
+      }
+      for name in _OBJECTIVES:
+        alert = self._alerts[name]
+        windows = {}
+        for wname, wsec in (("fast", cfg.fast_window_s),
+                            ("slow", cfg.slow_window_s)):
+          total, bad, burn = burns[name][wname]
+          windows[wname] = {
+              "window_s": wsec,
+              "requests": total,
+              "bad": bad,
+              "attained": (round(1.0 - bad / total, 6) if total else None),
+              "burn_rate": round(burn, 4),
+          }
+        entry = {
+            "target": cfg.target(name),
+            "fast": windows["fast"],
+            "slow": windows["slow"],
+            "alert": {
+                "firing": alert.firing,
+                "fired": alert.fired,
+                "cleared": alert.cleared,
+            },
+        }
+        if alert.since is not None:
+          entry["alert"]["for_s"] = round(now - alert.since, 3)
+        if name == "latency":
+          entry["threshold_ms"] = round(cfg.latency_threshold_s * 1e3, 3)
+        out["objectives"][name] = entry
+        if alert.firing:
+          out["alerts_firing"].append(name)
+      return out
+
+  def registry(self, snapshot: dict | None = None) -> prom.Registry:
+    """The ``mpi_slo_*`` Prometheus families for one snapshot.
+
+    Pool-aggregation note (``obs.prom.aggregate_metrics_texts`` sums
+    samples): ``mpi_slo_alert_firing`` summed across a cluster counts
+    FIRING BACKENDS — exactly the fleet-level signal the router wants.
+    """
+    snap = snapshot if snapshot is not None else self.snapshot()
+    reg = prom.Registry()
+    p = PREFIX
+    objective = reg.gauge(p + "objective_target",
+                          "Configured SLO target (good-event fraction).")
+    attained = reg.gauge(
+        p + "attainment_ratio",
+        "Good-event fraction over the window (NaN while idle).")
+    requests = reg.gauge(p + "window_requests",
+                         "Events scored in the window.")
+    bad = reg.gauge(p + "window_bad", "Bad events in the window.")
+    burn = reg.gauge(
+        p + "burn_rate",
+        "Error-budget consumption rate over the window (1.0 = exactly "
+        "sustainable).")
+    firing = reg.gauge(p + "alert_firing",
+                       "1 while the objective's burn-rate alert fires.")
+    fired = reg.counter(p + "alerts_fired_total",
+                        "Alert fire transitions.")
+    cleared = reg.counter(p + "alerts_cleared_total",
+                          "Alert clear transitions.")
+    for name, entry in snap["objectives"].items():
+      labels = {"slo": name}
+      objective.sample(entry["target"], labels)
+      for wname in ("fast", "slow"):
+        wlabels = {"slo": name, "window": wname}
+        w = entry[wname]
+        attained.sample(w["attained"], wlabels)
+        requests.sample(w["requests"], wlabels)
+        bad.sample(w["bad"], wlabels)
+        burn.sample(w["burn_rate"], wlabels)
+      firing.sample(1 if entry["alert"]["firing"] else 0, labels)
+      fired.sample(entry["alert"]["fired"], labels)
+      cleared.sample(entry["alert"]["cleared"], labels)
+    reg.gauge(p + "latency_threshold_seconds",
+              "The latency objective's good-request bound.",
+              snap["config"]["latency_threshold_ms"] / 1e3)
+    reg.gauge(p + "burn_threshold",
+              "Burn rate at which the alert fires (both windows).",
+              snap["config"]["burn_threshold"])
+    return reg
+
+  def metrics_text(self) -> str:
+    return self.registry().render()
+
+
+def verdict(snapshot: dict | None) -> dict | None:
+  """The bench-side pass/fail block for one ``SloTracker.snapshot()``.
+
+  Attainment over the SLOW window is the score (the fast window is for
+  alert edges, not report cards). ``pass`` is None while the window saw
+  no traffic. Returns None for services running without SLO tracking.
+  """
+  if not snapshot:
+    return None
+  out = {"objectives": {}, "alerts_firing": list(snapshot["alerts_firing"])}
+  ok = True
+  scored = False
+  for name, entry in snapshot["objectives"].items():
+    slow = entry["slow"]
+    attained = slow["attained"]
+    passed = None if attained is None else attained >= entry["target"]
+    out["objectives"][name] = {
+        "target": entry["target"],
+        "attained": attained,
+        "requests": slow["requests"],
+        "burn_fast": entry["fast"]["burn_rate"],
+        "burn_slow": slow["burn_rate"],
+        "alerts_fired": entry["alert"]["fired"],
+        "pass": passed,
+    }
+    if passed is not None:
+      scored = True
+      ok = ok and passed
+  out["pass"] = ok if scored else None
+  return out

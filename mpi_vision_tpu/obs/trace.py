@@ -251,6 +251,23 @@ class Tracer:
           "slowest": [r for _, _, r in reversed(self._slowest)],
       }
 
+  def find(self, trace_id: str) -> list[dict]:
+    """Every retained finished-trace record carrying ``trace_id``.
+
+    Searches the ring AND the slowest-N exemplars (an incident trace
+    evicted from the ring is exactly the one being searched for) and
+    de-duplicates records living in both. The ``/debug/traces?id=``
+    endpoint serves this; the cluster router fans the same query out to
+    every backend so one id yields the stitched cross-process tree.
+    """
+    with self._lock:
+      out, seen = [], set()
+      for rec in list(self._ring) + [r for _, _, r in self._slowest]:
+        if rec.get("trace_id") == trace_id and id(rec) not in seen:
+          seen.add(id(rec))
+          out.append(rec)
+      return out
+
   def reset(self) -> None:
     """Drop recorded traces and counters (load generators call this after
     warm-up, mirroring ``ServeMetrics.reset``)."""

@@ -30,7 +30,14 @@ The multi-host tier lives in the ``cluster`` subpackage (imported as
 these serve processes — ``python -m mpi_vision_tpu cluster``.
 """
 
-from mpi_vision_tpu.obs import DeviceProfiler, ProfileBusyError, Tracer
+from mpi_vision_tpu.obs import (
+    DeviceProfiler,
+    EventLog,
+    ProfileBusyError,
+    SloConfig,
+    SloTracker,
+    Tracer,
+)
 
 from mpi_vision_tpu.serve.cache import BakedScene, SceneCache, bake_scene
 from mpi_vision_tpu.serve.engine import InFlightBatch, RenderEngine

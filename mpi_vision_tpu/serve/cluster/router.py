@@ -36,6 +36,7 @@ import math
 import threading
 import time
 import urllib.error
+import urllib.parse
 import urllib.request
 import uuid
 
@@ -43,6 +44,7 @@ import functools
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from mpi_vision_tpu.obs import prom
+from mpi_vision_tpu.obs.events import EventLog
 from mpi_vision_tpu.obs.trace import NULL_TRACE, NULL_TRACER, Tracer
 from mpi_vision_tpu.serve.resilience import CircuitBreaker
 from mpi_vision_tpu.serve.cluster.ring import HashRing
@@ -220,6 +222,9 @@ class Router:
     tracer: optional ``obs.Tracer``; router traces use 32-hex W3C trace
       ids so the SAME id appears in the backend's recorded trace.
     transport: injectable request transport (tests); default urllib.
+    events: lifecycle event log (``obs.events.EventLog``; a private one
+      is made if omitted) — per-backend breaker transitions and
+      failovers, served at ``/debug/events`` next to the backends'.
     clock: one injectable monotonic base for breakers, metrics, and the
       exposition cache.
   """
@@ -229,7 +234,7 @@ class Router:
                render_timeout_s: float = 120.0,
                health_timeout_s: float = 2.0, metrics_ttl_s: float = 0.25,
                tracer: Tracer | None = None, transport=None,
-               clock=time.monotonic):
+               events: EventLog | None = None, clock=time.monotonic):
     self.replication = int(replication)
     self.breaker_threshold = int(breaker_threshold)
     self.breaker_reset_s = float(breaker_reset_s)
@@ -237,6 +242,7 @@ class Router:
     self.health_timeout_s = float(health_timeout_s)
     self.tracer = tracer if tracer is not None else NULL_TRACER
     self.transport = transport if transport is not None else HttpTransport()
+    self.events = events if events is not None else EventLog()
     self._clock = clock
     self.metrics = RouterMetrics(clock=clock)
     self._lock = threading.Lock()
@@ -258,12 +264,15 @@ class Router:
     with self._lock:
       if backend_id in self._backends:
         raise ValueError(f"backend {backend_id!r} already registered")
+      def on_transition(old, new, _backend=backend_id):
+        if new == CircuitBreaker.OPEN:
+          self.metrics.record_breaker_open()
+        self.events.emit("breaker", backend=_backend, old=old, new=new)
+
       breaker = CircuitBreaker(
           failure_threshold=self.breaker_threshold,
           reset_after_s=self.breaker_reset_s, clock=self._clock,
-          on_transition=lambda old, new: (
-              self.metrics.record_breaker_open()
-              if new == CircuitBreaker.OPEN else None))
+          on_transition=on_transition)
       self._backends[backend_id] = _Backend(backend_id, address, breaker)
       self._ring.add(backend_id)
 
@@ -327,6 +336,8 @@ class Router:
         continue
       if tried_any:
         self.metrics.record_failover()
+        self.events.emit("failover", scene_id=str(scene_id),
+                         to_backend=backend.backend_id)
       tried_any = True
       span = trace.start_span("forward", backend=backend.backend_id,
                               address=backend.address)
@@ -505,7 +516,8 @@ class Router:
 
   def stats(self) -> dict:
     """Aggregated ``/stats``: the router's own counters + every
-    backend's snapshot (or its fan-out error)."""
+    backend's snapshot (or its fan-out error), plus the fleet-level SLO
+    summary distilled from the backends' ``slo`` blocks."""
     per_backend = self._fan_out_get("/stats", self.health_timeout_s)
     with self._lock:
       backends = {b: be.snapshot() for b, be in self._backends.items()}
@@ -513,6 +525,86 @@ class Router:
         "router": self.metrics.snapshot(),
         "backend_info": {b: backends[b] for b in sorted(backends)},
         "backends": {b: per_backend[b] for b in sorted(per_backend)},
+        "slo": self._slo_summary(per_backend),
+    }
+
+  @staticmethod
+  def _slo_summary(per_backend_stats: dict) -> dict:
+    """Fleet SLO judgment from the backends' own ``slo`` blocks: which
+    backends have alerts firing, the hottest fast-window burn per
+    objective, and the pool-weighted slow-window attainment (total good
+    over total scored — the number a fleet report card quotes)."""
+    firing: dict[str, list[str]] = {}
+    worst: dict[str, dict] = {}
+    totals: dict[str, list[int]] = {}
+    reporting = 0
+    for backend_id in sorted(per_backend_stats):
+      st = per_backend_stats[backend_id]
+      slo = st.get("slo") if isinstance(st, dict) else None
+      if not isinstance(slo, dict) or "objectives" not in slo:
+        continue
+      reporting += 1
+      for name in slo.get("alerts_firing", []):
+        firing.setdefault(backend_id, []).append(name)
+      for name, obj in slo["objectives"].items():
+        burn = obj["fast"]["burn_rate"]
+        if name not in worst or burn > worst[name]["fast_burn"]:
+          worst[name] = {"backend": backend_id,
+                         "fast_burn": burn,
+                         "slow_burn": obj["slow"]["burn_rate"]}
+        tot = totals.setdefault(name, [0, 0])
+        tot[0] += obj["slow"]["requests"]
+        tot[1] += obj["slow"]["bad"]
+    return {
+        "backends_reporting": reporting,
+        "alerts_firing": firing,
+        "worst": worst,
+        "attainment": {
+            name: {"requests": tot[0], "bad": tot[1],
+                   "attained": (round(1.0 - tot[1] / tot[0], 6)
+                                if tot[0] else None)}
+            for name, tot in sorted(totals.items())
+        },
+    }
+
+  def events_snapshot(self, recent: int = 128) -> dict:
+    """The aggregated ``/debug/events``: the router's own lifecycle log
+    plus every backend's (one fan-out; a dead backend contributes its
+    error entry) — the single place an incident review starts."""
+    per_backend = self._fan_out_get(
+        f"/debug/events?recent={int(recent)}", self.health_timeout_s)
+    return {
+        "router": self.events.snapshot(recent=recent),
+        "backends": {b: per_backend[b] for b in sorted(per_backend)},
+    }
+
+  def find_trace(self, trace_id: str) -> dict:
+    """One trace id -> the stitched cross-process span view.
+
+    The router's outbound ``traceparent`` puts the SAME 32-hex id on its
+    own recorded trace and on every backend that served a forward, so a
+    single fan-out of ``/debug/traces?id=`` reassembles the distributed
+    tree from one endpoint — no grepping N hosts.
+    """
+    per_backend = self._fan_out_get(
+        f"/debug/traces?id={urllib.parse.quote(trace_id)}",
+        self.health_timeout_s)
+    backends = {}
+    spans = 0
+    for backend_id in sorted(per_backend):
+      payload = per_backend[backend_id]
+      traces = payload.get("traces") if isinstance(payload, dict) else None
+      if traces:
+        backends[backend_id] = traces
+        spans += sum(len(t.get("spans", [])) for t in traces)
+    router_traces = self.tracer.find(trace_id)
+    spans += sum(len(t.get("spans", [])) for t in router_traces)
+    return {
+        "trace_id": trace_id,
+        "router": router_traces,
+        "backends": backends,
+        "processes": (1 if router_traces else 0) + len(backends),
+        "spans_total": spans,
     }
 
   def _cluster_registry(self) -> prom.Registry:
@@ -562,7 +654,14 @@ class Router:
           texts.append(body.decode("utf-8", "replace"))
       except ConnectionError:
         continue  # a dead backend contributes nothing (backend_up says so)
-    return prom.aggregate_metrics_texts(texts, extra=self._cluster_registry())
+    from mpi_vision_tpu.obs import slo as slo_mod
+
+    # Ratio/target SLO gauges are per-backend statements — summing them
+    # exports garbage (and one idle backend's NaN poisons the sample);
+    # the summable mpi_slo_* slices still aggregate.
+    return prom.aggregate_metrics_texts(
+        texts, extra=self._cluster_registry(),
+        drop=slo_mod.NON_ADDITIVE_FAMILIES)
 
   def _snapshot_backends(self) -> list[_Backend]:
     with self._lock:
@@ -622,18 +721,33 @@ class _RouterHandler(BaseHTTPRequestHandler):
                      extra_headers=extra_headers)
 
   def do_GET(self):  # noqa: N802 - stdlib name
-    if self.path == "/healthz":
+    parsed = urllib.parse.urlsplit(self.path)
+    if parsed.path == "/healthz":
       health = self.router.healthz()
       self._send_json(health,
                       status=503 if health["status"] == "unhealthy" else 200)
-    elif self.path == "/stats":
+    elif parsed.path == "/stats":
       self._send_json(self.router.stats())
-    elif self.path == "/metrics":
+    elif parsed.path == "/metrics":
       self._send_bytes(
           self.router.metrics_text().encode(),
           content_type="text/plain; version=0.0.4; charset=utf-8")
-    elif self.path == "/debug/traces":
-      self._send_json(self.router.tracer.snapshot())
+    elif parsed.path == "/debug/traces":
+      # ?id= fans the search out to every backend and returns the
+      # stitched cross-process view; without it, the router's own ring.
+      tid = urllib.parse.parse_qs(parsed.query).get("id", [None])[0]
+      if tid:
+        self._send_json(self.router.find_trace(tid))
+      else:
+        self._send_json(self.router.tracer.snapshot())
+    elif parsed.path == "/debug/events":
+      try:
+        recent = int(urllib.parse.parse_qs(parsed.query)
+                     .get("recent", ["128"])[0])
+      except ValueError:
+        self._send_json({"error": "recent must be an integer"}, status=400)
+        return
+      self._send_json(self.router.events_snapshot(recent=recent))
     else:
       self._send_json({"error": f"unknown path {self.path}"}, status=404)
 

@@ -178,13 +178,14 @@ class RenderEngine:
       render_fn = lambda mpi, poses, depths, k: render.render_views(  # noqa: E731
           mpi, poses, depths, k,
           convention=self.convention, method=self.method)
-    # Donate the pose buffer to the dispatch where the backend supports
-    # donation (TPU/GPU): each batch's pose array is freshly transferred
-    # and never read again on the host, so the executable can reuse its
-    # bytes — one fewer live buffer per in-flight batch. The CPU backend
-    # does not implement donation and would log a warning per compile, so
-    # it keeps the plain jit (poses are tiny there anyway).
-    if self.devices[0].platform in ("tpu", "gpu"):
+    # Donate the pose buffer to the dispatch on every non-CPU backend:
+    # each batch's pose array is freshly transferred and never read
+    # again on the host, so the executable can reuse its bytes — one
+    # fewer live buffer per in-flight batch. The CPU backend does not
+    # implement donation and would log a warning per compile (noise in
+    # every tier-1/bench pipelined run), so it keeps the plain jit
+    # (poses are tiny there anyway).
+    if self.devices[0].platform != "cpu":
       self._render_jit = jax.jit(render_fn, donate_argnums=(1,))
     else:
       self._render_jit = jax.jit(render_fn)

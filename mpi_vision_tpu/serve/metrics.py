@@ -47,6 +47,11 @@ class ServeMetrics:
     self._clock = clock
     self._lock = threading.Lock()
     self._window = window
+    # Optional obs.slo.SloTracker fed by record_request/record_error/
+    # record_rejected/record_breaker_fastfail (set by RenderService).
+    # Called OUTSIDE this object's lock: the tracker locks itself, and
+    # its alert callback may fan out to the event log.
+    self.slo = None
     self.reset()
 
   def reset(self) -> None:
@@ -92,6 +97,8 @@ class ServeMetrics:
       # Per-scene latency breakdown (hot-scene regression hunting):
       # scene -> [count, sum_s, max_s, deque(recent latencies)].
       self._per_scene: dict = {}
+    if self.slo is not None:
+      self.slo.reset()
 
   def record_request(self, latency_s: float, scene_id: str | None = None) -> None:
     """One request completed, queue-to-response latency.
@@ -121,6 +128,8 @@ class ServeMetrics:
         entry[1] += latency_s
         entry[2] = max(entry[2], latency_s)
         entry[3].append(latency_s)
+    if self.slo is not None:
+      self.slo.record(ok=True, latency_s=latency_s)
 
   def record_error(self, kind: str, count: int = 1) -> None:
     """``count`` requests failed with a ``kind``-class error.
@@ -137,11 +146,16 @@ class ServeMetrics:
         self.errors_deadline += count
       else:
         self.errors_permanent += count
+    if self.slo is not None:
+      self.slo.record_bad(count)
 
   def record_rejected(self) -> None:
-    """One submission shed at the door (queue full)."""
+    """One submission shed at the door (queue full) — an SLO bad event:
+    the caller saw a 503 whatever the queue's reasons were."""
     with self._lock:
       self.rejected += 1
+    if self.slo is not None:
+      self.slo.record_bad()
 
   def record_retry(self) -> None:
     with self._lock:
@@ -161,9 +175,12 @@ class ServeMetrics:
       self.breaker_opens += 1
 
   def record_breaker_fastfail(self) -> None:
-    """One request fast-failed against an open circuit (HTTP 503)."""
+    """One request fast-failed against an open circuit (HTTP 503) — an
+    SLO bad event like a queue shed."""
     with self._lock:
       self.breaker_fastfails += 1
+    if self.slo is not None:
+      self.slo.record_bad()
 
   def record_client_disconnect(self) -> None:
     """The client hung up mid-response (BrokenPipe/ConnectionReset)."""

@@ -325,9 +325,13 @@ class ResilientExecutor:
   """
 
   def __init__(self, config: ResilienceConfig | None = None,
-               metrics=None, clock=time.monotonic, sleep=time.sleep):
+               metrics=None, events=None, clock=time.monotonic,
+               sleep=time.sleep):
     self.config = config if config is not None else ResilienceConfig()
     self.metrics = metrics
+    # Optional obs.events.EventLog: breaker transitions and watchdog
+    # trips are exactly the lifecycle moments /debug/events exists for.
+    self.events = events
     self._clock = clock
     self._sleep = sleep
     self._policy = self.config.retry_policy()
@@ -340,6 +344,8 @@ class ResilientExecutor:
   def _on_breaker_transition(self, old: str, new: str) -> None:
     if self.metrics is not None and new == CircuitBreaker.OPEN:
       self.metrics.record_breaker_open()
+    if self.events is not None:
+      self.events.emit("breaker", old=old, new=new)
 
   def check_fastfail(self, have_fallback: bool) -> None:
     """Submit-time guard: raise ``CircuitOpenError`` when a request could
@@ -414,8 +420,13 @@ class ResilientExecutor:
             and timeout < self.config.watchdog_s)
         if deadline_capped:
           e.deadline_capped = True  # upper layers label it overload (504)
-        if isinstance(e, DispatchTimeoutError) and self.metrics is not None:
-          self.metrics.record_watchdog_trip()
+        if isinstance(e, DispatchTimeoutError):
+          if self.metrics is not None:
+            self.metrics.record_watchdog_trip()
+          if self.events is not None:
+            self.events.emit("watchdog_trip", attempt=attempt,
+                             fallback=use_fallback,
+                             deadline_capped=deadline_capped)
         if not use_fallback:
           if deadline_capped:
             if holds_probe:

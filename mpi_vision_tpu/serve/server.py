@@ -11,9 +11,14 @@ stdlib ``http.server`` front end:
   GET  /metrics -> Prometheus text exposition of the same counters
                    (obs/prom.py; scrape with a stock Prometheus)
   GET  /debug/traces  -> recent + slowest-N finished request traces
+                   (?id=<trace_id> returns just that id's records)
+  GET  /debug/events  -> the bounded structured lifecycle event log
+                   (breaker transitions, scene swaps, SLO alert edges;
+                   ?kind= filters, ?recent=N bounds)
   GET  /debug/profile?seconds=N -> capture a device profile of live
                    traffic (409 while one is in flight; 503 unless the
-                   service was built with a profile dir)
+                   service was built with a profile dir); a configured
+                   profile hook receives the finished capture dir
   POST /render  -> body {"scene_id": str, "pose": [[...4x4...]]} ->
                    {"scene_id", "shape", "dtype", "image_b64"} — raw
                    little-endian f32 pixels, base64 (shape [H, W, 3]).
@@ -60,7 +65,9 @@ import numpy as np
 from mpi_vision_tpu.core import camera
 from mpi_vision_tpu.core.camera import inv_depths
 from mpi_vision_tpu.obs import prom
+from mpi_vision_tpu.obs.events import EventLog
 from mpi_vision_tpu.obs.profile import DeviceProfiler, ProfileBusyError
+from mpi_vision_tpu.obs.slo import SloConfig, SloTracker
 from mpi_vision_tpu.obs.trace import (
     NULL_TRACE,
     NULL_TRACER,
@@ -134,6 +141,21 @@ class RenderService:
       (``obs.profile.DeviceProfiler`` over ``jax.profiler``).
     profiler: explicit profiler override (tests inject fake trace
       contexts); wins over ``profile_dir``.
+    profile_hook: optional callable invoked with each finished capture's
+      directory (``serve --profile-hook CMD`` wraps a user command) —
+      the artifact-upload seam. Hook failures are counted
+      (``profile_hook_failures``) and reported in the capture response,
+      never raised: an upload problem must not fail the capture.
+    slo: SLO tracking (``obs.slo``). The default ``SloConfig()`` tracks
+      99% availability + 95%-under-1s latency with multi-window
+      burn-rate alerting; pass a custom ``SloConfig``, a pre-built
+      ``SloTracker`` (tests inject fake clocks), or None to disable.
+      Surfaced as the ``slo`` block in ``/stats``, ``mpi_slo_*``
+      families in ``/metrics``, and folded into ``/healthz`` (a firing
+      alert reports ``degraded`` with the reason).
+    events: the lifecycle event log (``obs.events.EventLog``; a private
+      one is made if omitted) serving ``/debug/events`` — breaker
+      transitions, watchdog trips, scene swaps, SLO alert edges.
     metrics_ttl_s: ``/metrics`` exposition-string cache TTL
       (``obs.prom.ExpositionCache``) — scrape storms on the aggregated
       cluster endpoint cost one snapshot render per window instead of
@@ -151,6 +173,9 @@ class RenderService:
                cpu_fallback: str = "auto", fallback_engine=None,
                tracer: Tracer | None = None, profile_dir: str | None = None,
                profiler: DeviceProfiler | None = None,
+               profile_hook=None,
+               slo: "SloConfig | SloTracker | None" = SloConfig(),
+               events: EventLog | None = None,
                metrics_ttl_s: float = 0.25, clock=time.monotonic):
     if cpu_fallback not in ("auto", "on", "off"):
       raise ValueError(
@@ -168,13 +193,28 @@ class RenderService:
         max_inflight=max(8, 2 * max_inflight))
     self.cache = cache_mod.SceneCache(byte_budget=cache_bytes)
     self.metrics = ServeMetrics()
+    self.events = events if events is not None else EventLog()
+    # SLO judgment layer: alert edges land in the event log, request
+    # outcomes feed the tracker via ServeMetrics (one recording point).
+    if isinstance(slo, SloTracker):
+      self.slo = slo
+    elif slo is not None:
+      self.slo = SloTracker(slo, clock=clock)
+    else:
+      self.slo = None
+    if self.slo is not None:
+      if self.slo.on_alert is None:
+        self.slo.on_alert = self._on_slo_alert
+      self.metrics.slo = self.slo
     self.tracer = tracer if tracer is not None else NULL_TRACER
     if profiler is not None:
       self.profiler = profiler
     else:
       self.profiler = (DeviceProfiler(profile_dir) if profile_dir else None)
+    self.profile_hook = profile_hook
+    self.profile_hook_failures = 0
     self.resilient = None if resilience is None else ResilientExecutor(
-        resilience, metrics=self.metrics)
+        resilience, metrics=self.metrics, events=self.events)
     self.fallback_engine = fallback_engine
     if (self.fallback_engine is None and self.resilient is not None
         and (cpu_fallback == "on"
@@ -198,6 +238,9 @@ class RenderService:
     self._metrics_cache = prom.ExpositionCache(
         self._render_metrics_text, ttl_s=metrics_ttl_s, clock=clock)
     self._closed = False
+
+  def _on_slo_alert(self, name: str, firing: bool, details: dict) -> None:
+    self.events.emit("slo_alert", slo=name, firing=firing, **details)
 
   # -- scenes -------------------------------------------------------------
 
@@ -281,7 +324,9 @@ class RenderService:
     if prebake:
       for sid in entries:
         self._get_scene(sid)
-    return sorted(entries)
+    swapped = sorted(entries)
+    self.events.emit("scene_swap", scenes=swapped, prebake=bool(prebake))
+    return swapped
 
   def prebake_fallback(self, k: int | None = None,
                        scene_ids=None) -> list[str]:
@@ -341,8 +386,11 @@ class RenderService:
   # -- observability ------------------------------------------------------
 
   def _render_metrics_text(self) -> str:
-    return prom.render_serve_metrics(self.stats(),
+    text = prom.render_serve_metrics(self.stats(),
                                      self.metrics.latency_histogram())
+    if self.slo is not None:
+      text += self.slo.metrics_text()
+    return text
 
   def metrics_text(self) -> str:
     """The ``/metrics`` body: Prometheus text exposition of ``stats()``,
@@ -350,12 +398,27 @@ class RenderService:
     return self._metrics_cache.get()
 
   def profile(self, seconds: float) -> dict:
-    """Capture a device profile of live traffic (``/debug/profile``)."""
+    """Capture a device profile of live traffic (``/debug/profile``).
+
+    With a ``profile_hook``, the finished capture's directory is handed
+    to it (artifact upload); a failing hook is counted and reported in
+    the response — never fatal, the capture on disk is still good.
+    """
     if self.profiler is None:
       raise RuntimeError(
           "profiling disabled: construct RenderService with profile_dir "
           "(serve --profile-dir)")
-    return self.profiler.capture(seconds)
+    result = self.profiler.capture(seconds)
+    if self.profile_hook is not None:
+      try:
+        self.profile_hook(result["logdir"])
+        result["hook"] = "ok"
+      except Exception as e:  # noqa: BLE001 - upload failure is not capture failure
+        self.profile_hook_failures += 1
+        result["hook"] = f"failed: {e}"
+        self.events.emit("profile_hook_failed", logdir=result["logdir"],
+                         error=repr(e))
+    return result
 
   def stats(self) -> dict:
     out = self.metrics.snapshot(cache_stats=self.cache.stats())
@@ -364,6 +427,14 @@ class RenderService:
     out["engine"] = self.engine.describe()
     if self.resilient is not None:
       out["breaker"] = self.resilient.breaker.snapshot()
+    if self.slo is not None:
+      out["slo"] = self.slo.snapshot()
+    out["events"] = {"emitted": self.events.emitted,
+                     "dropped": self.events.dropped,
+                     "sink_errors": self.events.sink_errors}
+    if self.profiler is not None:
+      out["profile"] = {"captures": self.profiler.captures,
+                        "hook_failures": self.profile_hook_failures}
     return out
 
   def healthz(self) -> dict:
@@ -371,9 +442,12 @@ class RenderService:
 
     ``degraded`` means the service still answers but not at full
     fidelity: the breaker has given up on the primary device and
-    requests either ride the CPU fallback or fast-fail 503. A wedged or
-    dead dispatcher is ``unhealthy`` — before the watchdog existed,
-    exactly that state kept reporting ``ok`` forever.
+    requests either ride the CPU fallback or fast-fail 503 — or an SLO
+    burn-rate alert is firing (the service answers, but it is failing
+    its objectives fast enough to page; the ``reason`` says which
+    objective and how hot the burn). A wedged or dead dispatcher is
+    ``unhealthy`` — before the watchdog existed, exactly that state kept
+    reporting ``ok`` forever.
     """
     out = {
         "devices": len(self.engine.devices),
@@ -382,6 +456,17 @@ class RenderService:
     }
     breaker = self.resilient.breaker if self.resilient is not None else None
     snap = breaker.snapshot() if breaker is not None else None
+    slo_firing = self.slo.alerts_firing() if self.slo is not None else []
+    slo_reason = None
+    if slo_firing:
+      snap_slo = self.slo.snapshot()
+      parts = []
+      for name in slo_firing:
+        obj = snap_slo["objectives"][name]
+        parts.append(f"{name} burning at {obj['fast']['burn_rate']:g}x "
+                     f"(>= {snap_slo['config']['burn_threshold']:g}x "
+                     f"of a {obj['target']:g} target)")
+      slo_reason = "SLO alert firing: " + "; ".join(parts)
     if self._closed:
       status, reason = "unhealthy", "service closed"
     elif not self.scheduler.dispatcher_alive():
@@ -394,11 +479,21 @@ class RenderService:
       reason += ("rendering on CPU fallback"
                  if self.fallback_engine is not None
                  else "fast-failing renders (503)")
+      if slo_reason is not None:
+        reason += "; " + slo_reason
+    elif slo_firing:
+      # A firing burn-rate alert is degraded, not unhealthy: the service
+      # still answers (killing it over a latency regression would turn a
+      # partial failure into a total one), but probes and the cluster
+      # router must see that objectives are being missed.
+      status, reason = "degraded", slo_reason
     else:
       status, reason = "ok", None
     out["status"] = status
     if reason is not None:
       out["reason"] = reason
+    if self.slo is not None:
+      out["slo_alerts_firing"] = slo_firing
     if snap is not None:
       out["breaker"] = snap
       out["fallback_active"] = (
@@ -506,7 +601,26 @@ class _Handler(BaseHTTPRequestHandler):
           self.service.metrics_text().encode(),
           content_type="text/plain; version=0.0.4; charset=utf-8")
     elif parsed.path == "/debug/traces":
-      self._send_json(self.service.tracer.snapshot())
+      # ?id=<trace_id> searches the retained traces for one id (ring +
+      # slowest exemplars) — the single-trace view the cluster router
+      # fans out to stitch cross-process trees.
+      query = urllib.parse.parse_qs(parsed.query)
+      tid = query.get("id", [None])[0]
+      if tid:
+        self._send_json({"trace_id": tid,
+                         "traces": self.service.tracer.find(tid)})
+      else:
+        self._send_json(self.service.tracer.snapshot())
+    elif parsed.path == "/debug/events":
+      query = urllib.parse.parse_qs(parsed.query)
+      kind = query.get("kind", [None])[0]
+      try:
+        recent = int(query.get("recent", ["128"])[0])
+      except ValueError:
+        self._send_json({"error": "recent must be an integer"}, status=400)
+        return
+      self._send_json(self.service.events.snapshot(recent=recent,
+                                                   kind=kind))
     elif parsed.path == "/debug/profile":
       self._do_profile(parsed.query)
     else:

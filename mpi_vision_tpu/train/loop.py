@@ -239,7 +239,13 @@ def shard_train_step(mesh: Mesh, vgg_params: Any | None = None,
                                      vgg_dtype=vgg_dtype))
   repl = NamedSharding(mesh, P())
 
-  @functools.partial(jax.jit, donate_argnums=(0,))
+  # Donating the carried state only pays (and only works quietly) on
+  # backends that implement buffer donation; the CPU mesh used by tier-1
+  # and the multichip dryrun would emit a donation warning per compile.
+  _donate = {} if any(d.platform == "cpu" for d in mesh.devices.flat) \
+      else {"donate_argnums": (0,)}
+
+  @functools.partial(jax.jit, **_donate)
   def step(state: TrainState, batch: Batch):
     batch = jax.lax.with_sharding_constraint(
         batch, jax.tree.map(
@@ -470,6 +476,17 @@ def _close_iter(it) -> None:
     close()
 
 
+def _batch_examples(batch) -> int:
+  """Examples in one batch (first leaf's leading dim; 1 when unknowable)
+  — feeds the telemetry's examples/s gauge, never correctness."""
+  try:
+    leaves = jax.tree_util.tree_leaves(batch)
+    shape = jnp.shape(leaves[0])
+    return int(shape[0]) if shape else 1
+  except Exception:  # noqa: BLE001 - telemetry must not fail the step
+    return 1
+
+
 def _supports_skip(make_batches) -> bool:
   """Does ``make_batches`` accept an explicit ``skip`` keyword?
 
@@ -490,7 +507,7 @@ def fit_resumable(state: TrainState, epochs: int, make_batches, store, *,
                   step=None, save_every: int = 0, meta: Mapping | None = None,
                   resume: str = "auto", nan_guard=None, watchdog=None,
                   preemption=None, fault_source=None, on_epoch=None,
-                  log=None):
+                  telemetry=None, events=None, log=None):
   """Crash-safe epoch driver: periodic atomic checkpoints, bit-exact
   resume, NaN rollback, stall watchdog, preemption saves.
 
@@ -559,6 +576,15 @@ def fit_resumable(state: TrainState, epochs: int, make_batches, store, *,
       after each epoch-boundary save, at most once per epoch — a NaN
       rollback that re-finishes a reported epoch does not re-fire it
       (the CLI's valid-loss column stays one entry per epoch).
+    telemetry: optional ``train.telemetry.TrainMetrics`` — the loop
+      records per-step wall time / loss / LR / examples, checkpoint
+      save duration+bytes (via the store's ``last_save_*``; with a
+      ``BackgroundSaver`` these report the previously completed save),
+      and the rollback / preemption / restore counters, so a ``train
+      --metrics-port`` scrape sees the run live.
+    events: optional ``obs.events.EventLog`` for loop-level lifecycle
+      events (``nan_rollback``, ``preempt``); the store emits its own
+      save / restore / quarantine events when built with one.
     log: optional ``str -> None`` diagnostics sink.
 
   Returns:
@@ -610,6 +636,8 @@ def fit_resumable(state: TrainState, epochs: int, make_batches, store, *,
       cursor = restored.meta.get("cursor", {})
       e, b = int(cursor.get("epoch", 0)), int(cursor.get("batch", 0))
       resumed_from = restored.step
+      if telemetry is not None:
+        telemetry.record_restore(restored.step)
       say(f"ckpt: resumed from step {restored.step} "
           f"(epoch {e}, batch {b})")
 
@@ -646,6 +674,14 @@ def fit_resumable(state: TrainState, epochs: int, make_batches, store, *,
       cur_meta["learning_rate"] = lr
     with wd_quiet():
       store.save(int(state.step), _ckpt_tree(state), meta=cur_meta)
+    if telemetry is not None:
+      # The store stamps the published save's cost; a BackgroundSaver
+      # reports the previously completed one (the honest async number —
+      # this loop never waited on the current write).
+      telemetry.record_save(int(state.step),
+                            getattr(store, "last_save_s", 0.0),
+                            getattr(store, "last_save_bytes", 0),
+                            reason=reason)
 
   if store.latest_step() is None:
     save("initial")  # the rollback anchor for fresh runs
@@ -700,9 +736,14 @@ def fit_resumable(state: TrainState, epochs: int, make_batches, store, *,
           batch = fault_source.poison_batch(batch)
         if preempt.requested.is_set():
           save("preempt")
+          if telemetry is not None:
+            telemetry.record_preemption(int(state.step))
+          if events is not None:
+            events.emit("preempt", step=int(state.step))
           say(f"ckpt: preempted at step {int(state.step)}; saved")
           _close_iter(it)
           return state, finish_report(preempted=True)
+        t_step = telemetry.clock() if telemetry is not None else 0.0
         new_state, metrics = step(state, batch)
         loss = float(metrics["loss"])
         if not math.isfinite(loss):
@@ -736,6 +777,11 @@ def fit_resumable(state: TrainState, epochs: int, make_batches, store, *,
             raise NonFiniteLossError(
                 int(state.step), loss, "no checkpoint left to roll back to")
           rollback_steps.append(restored.step)
+          if telemetry is not None:
+            telemetry.record_rollback(restored.step)
+          if events is not None:
+            events.emit("nan_rollback", to_step=restored.step,
+                        at_step=int(state.step), loss=repr(loss))
           with wd_quiet():
             tree = restored.tree(template)
           state = state.replace(params=tree["params"],
@@ -769,6 +815,13 @@ def fit_resumable(state: TrainState, epochs: int, make_batches, store, *,
         state = new_state
         losses.append(loss)
         b += 1
+        if telemetry is not None:
+          # The loss fetch above already synced the host, so the step
+          # window [t_step, now] covers dispatch + device work honestly.
+          telemetry.record_step(int(state.step), loss,
+                                telemetry.clock() - t_step,
+                                examples=_batch_examples(batch),
+                                lr=current_learning_rate(state))
         if watchdog is not None:
           watchdog.beat()
         if save_every and int(state.step) % save_every == 0:
@@ -782,6 +835,8 @@ def fit_resumable(state: TrainState, epochs: int, make_batches, store, *,
         continue
       finished = e
       e, b = e + 1, 0
+      if telemetry is not None:
+        telemetry.record_epoch(finished)
       if store.latest_step() != int(state.step):
         # Skipped when a periodic save already landed on this exact
         # step: the re-save would rewrite identical arrays (the two

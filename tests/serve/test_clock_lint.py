@@ -31,6 +31,7 @@ import mpi_vision_tpu.obs
 import mpi_vision_tpu.serve
 import mpi_vision_tpu.serve.cluster
 import mpi_vision_tpu.train.loop
+import mpi_vision_tpu.train.telemetry
 
 _CLOCK_CALL = re.compile(r"\btime\.(time|monotonic|perf_counter)\s*\(")
 
@@ -45,6 +46,7 @@ def _linted_sources():
               mpi_vision_tpu.obs, mpi_vision_tpu.ckpt):
     yield from _package_sources(pkg)
   yield pathlib.Path(mpi_vision_tpu.train.loop.__file__)
+  yield pathlib.Path(mpi_vision_tpu.train.telemetry.__file__)
 
 
 def test_no_bare_clock_calls_in_serve_obs_ckpt_train():
@@ -69,8 +71,10 @@ def test_lint_covers_the_ckpt_package_and_train_loop():
   assert {"ckpt/store.py", "ckpt/guards.py", "ckpt/faultinject.py",
           "ckpt/watch.py", "ckpt/background.py", "serve/faultinject.py",
           "serve/engine.py", "serve/scheduler.py", "serve/metrics.py",
-          "train/loop.py", "cluster/router.py", "cluster/ring.py",
-          "cluster/pool.py"} <= rel
+          "train/loop.py", "train/telemetry.py", "cluster/router.py",
+          "cluster/ring.py", "cluster/pool.py",
+          "obs/slo.py", "obs/events.py", "obs/trace.py",
+          "obs/prom.py"} <= rel
 
 
 def test_lint_actually_catches_calls():

@@ -63,6 +63,16 @@ def test_serve_load_dry_emits_headline_json():
   assert set(out["resilience"]) >= {"retries", "watchdog_trips",
                                     "fallback_renders", "breaker_opens"}
   assert out["breaker_state"] == "closed"
+  # The SLO verdict block rides every run: objectives judged against
+  # slow-window attainment. A clean dry run must PASS availability
+  # outright (no errors => attainment 1.0).
+  slo = out["slo"]
+  assert set(slo["objectives"]) == {"availability", "latency"}
+  avail = slo["objectives"]["availability"]
+  assert avail["target"] == 0.99 and avail["attained"] == 1.0
+  assert avail["requests"] >= out["requests"]
+  assert avail["pass"] is True and avail["burn_slow"] == 0.0
+  assert slo["alerts_firing"] == []
 
 
 def test_serve_load_trace_dry_smoke():
@@ -124,6 +134,12 @@ def test_serve_load_cluster_dry_smoke():
   assert cluster["health"] == "degraded"
   # Work landed on more than one backend: the ring really shards.
   assert len(cluster["forwards"]) >= 2
+  # Fleet SLO view: the surviving backends report their slo blocks
+  # through the router's aggregation, and the run carries the same
+  # verdict shape as the in-process path.
+  assert cluster["slo"]["backends_reporting"] >= 2
+  if out["slo"] is not None:
+    assert "availability" in out["slo"]["objectives"]
 
 
 def test_serve_load_chaos_dry_smoke():
@@ -142,3 +158,10 @@ def test_serve_load_chaos_dry_smoke():
   assert out["breaker_state"] in ("closed", "open", "half_open")
   assert set(out["errors"]) == {"transient", "permanent", "deadline"}
   assert out["chaos_failed_requests"] is not None
+  # The verdict block judges the chaos window too (objective, attained,
+  # burn rates, pass/fail — whether the fleet RODE OUT the faults).
+  slo = out["slo"]
+  for obj in slo["objectives"].values():
+    assert {"target", "attained", "burn_fast", "burn_slow",
+            "pass"} <= set(obj)
+  assert slo["objectives"]["availability"]["requests"] >= out["requests"]

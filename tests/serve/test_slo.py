@@ -1,0 +1,655 @@
+"""SLO engine + event log tests.
+
+The acceptance pin for PR 8 lives here: an end-to-end,
+``serve_load --chaos --dry``-style run in which an injected fault window
+makes the availability burn-rate alert FIRE — visible simultaneously in
+``/healthz`` (degraded with the SLO reason), ``/stats`` (the ``slo``
+block), ``/metrics`` (``mpi_slo_alert_firing`` = 1), and the
+``serve_load`` JSON verdict block — and then CLEAR after recovery, with
+all four surfaces agreeing again. Plus the burn-rate unit math (window
+rotation, fast/slow fire+clear edges) on fake clocks, the
+``/debug/events`` + ``/debug/traces?id=`` endpoints, the router's
+cross-process aggregation of all three, and the ``/debug/profile``
+artifact-upload hook.
+"""
+
+import contextlib
+import json
+import urllib.parse
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mpi_vision_tpu.obs import DeviceProfiler, parse_metrics_text
+from mpi_vision_tpu.obs.events import EventLog, file_sink
+from mpi_vision_tpu.obs.slo import SloConfig, SloTracker, verdict
+from mpi_vision_tpu.obs.trace import Tracer
+from mpi_vision_tpu.serve import (
+    FaultyEngine,
+    RenderEngine,
+    RenderService,
+    make_http_server,
+)
+from mpi_vision_tpu.serve.cluster.router import Router
+
+H = W = 16
+P = 4
+
+
+class FakeClock:
+  def __init__(self, t=1000.0):
+    self.t = t
+
+  def __call__(self):
+    return self.t
+
+  def advance(self, dt):
+    self.t += dt
+    return self.t
+
+
+def _pose(tx=0.0):
+  pose = np.eye(4, dtype=np.float32)
+  pose[0, 3] = tx
+  return pose
+
+
+def _cfg(**kw):
+  base = dict(fast_window_s=10.0, slow_window_s=60.0, bucket_s=1.0,
+              burn_threshold=10.0, min_requests=5)
+  base.update(kw)
+  return SloConfig(**base)
+
+
+# --- burn-rate math -------------------------------------------------------
+
+
+class TestSloTracker:
+
+  def test_idle_tracker_is_quiet(self):
+    t = SloTracker(_cfg(), clock=FakeClock())
+    snap = t.snapshot()
+    assert snap["alerts_firing"] == []
+    for obj in snap["objectives"].values():
+      assert obj["fast"]["requests"] == 0
+      assert obj["fast"]["burn_rate"] == 0.0
+      assert obj["fast"]["attained"] is None
+
+  def test_window_rotation_ages_out_bad_events(self):
+    clock = FakeClock()
+    t = SloTracker(_cfg(), clock=clock)
+    for _ in range(8):
+      t.record(ok=False)
+    snap = t.snapshot()["objectives"]["availability"]
+    assert snap["fast"]["bad"] == 8 and snap["slow"]["bad"] == 8
+    clock.advance(11)  # past the fast window, inside the slow one
+    snap = t.snapshot()["objectives"]["availability"]
+    assert snap["fast"]["bad"] == 0
+    assert snap["slow"]["bad"] == 8
+    clock.advance(60)  # past the slow window too
+    snap = t.snapshot()["objectives"]["availability"]
+    assert snap["slow"]["requests"] == 0 and snap["slow"]["bad"] == 0
+
+  def test_availability_alert_fires_and_clears_on_fast_window(self):
+    clock = FakeClock()
+    alerts = []
+    t = SloTracker(_cfg(), clock=clock,
+                   on_alert=lambda n, f, d: alerts.append((n, f, d)))
+    # Healthy traffic: no alert.
+    for _ in range(20):
+      t.record(ok=True, latency_s=0.01)
+    assert t.alerts_firing() == []
+    # Fault window: burn far above threshold in BOTH windows.
+    for _ in range(10):
+      t.record(ok=False)
+    assert t.alerts_firing() == ["availability"]
+    fire = [a for a in alerts if a[1]]
+    assert fire and fire[0][0] == "availability"
+    assert fire[0][2]["fast_burn"] >= 10.0
+    snap = t.snapshot()["objectives"]["availability"]["alert"]
+    assert snap["firing"] is True and snap["fired"] == 1
+    assert snap["for_s"] >= 0
+    # Recovery: the bad events age out of the fast window (the slow
+    # window still carries them) -> the alert clears on the fast edge.
+    clock.advance(11)
+    for _ in range(5):
+      t.record(ok=True, latency_s=0.01)
+    assert t.alerts_firing() == []
+    slow_burn = t.snapshot()["objectives"]["availability"]["slow"]
+    assert slow_burn["bad"] == 10  # history retained; alert cleared anyway
+    clear = [a for a in alerts if not a[1]]
+    assert clear and clear[0][0] == "availability"
+    snap = t.snapshot()["objectives"]["availability"]["alert"]
+    assert snap["firing"] is False and snap["cleared"] == 1
+
+  def test_latency_objective_scores_only_completed_requests(self):
+    clock = FakeClock()
+    t = SloTracker(_cfg(latency_threshold_s=0.1), clock=clock)
+    for _ in range(6):
+      t.record(ok=True, latency_s=0.5)   # completed but slow
+    for _ in range(4):
+      t.record(ok=False)                 # errors: availability only
+    snap = t.snapshot()["objectives"]
+    assert snap["latency"]["fast"]["requests"] == 6
+    assert snap["latency"]["fast"]["bad"] == 6
+    assert snap["availability"]["fast"]["requests"] == 10
+    assert snap["availability"]["fast"]["bad"] == 4
+    assert "latency" in t.alerts_firing()
+
+  def test_min_requests_guards_idle_spikes(self):
+    t = SloTracker(_cfg(min_requests=50), clock=FakeClock())
+    for _ in range(10):
+      t.record(ok=False)
+    assert t.alerts_firing() == []  # 10 < min_requests: no page
+
+  def test_slow_window_must_confirm_the_fast_one(self):
+    # A fresh burst after a long good history: fast window is hot but
+    # the slow window's burn stays under threshold -> no alert.
+    clock = FakeClock()
+    t = SloTracker(_cfg(), clock=clock)
+    for _ in range(5000):
+      t.record(ok=True, latency_s=0.01)
+    clock.advance(20)  # history leaves the fast window, stays in the slow
+    for _ in range(6):
+      t.record(ok=False)
+    snap = t.snapshot()["objectives"]["availability"]
+    assert snap["fast"]["burn_rate"] >= 10.0
+    assert snap["slow"]["burn_rate"] < 10.0
+    assert t.alerts_firing() == []
+
+  def test_registry_agrees_with_snapshot(self):
+    clock = FakeClock()
+    t = SloTracker(_cfg(), clock=clock)
+    for i in range(30):
+      t.record(ok=i % 3 != 0, latency_s=0.01)
+    snap = t.snapshot()
+    families = parse_metrics_text(t.registry(snap).render())
+
+    def val(name, labels):
+      return families[name]["samples"][(name, tuple(sorted(labels)))]
+
+    for slo in ("availability", "latency"):
+      obj = snap["objectives"][slo]
+      assert val("mpi_slo_objective_target",
+                 [("slo", slo)]) == obj["target"]
+      for window in ("fast", "slow"):
+        labels = [("slo", slo), ("window", window)]
+        assert val("mpi_slo_window_requests", labels) \
+            == obj[window]["requests"]
+        assert val("mpi_slo_window_bad", labels) == obj[window]["bad"]
+        assert val("mpi_slo_burn_rate", labels) \
+            == pytest.approx(obj[window]["burn_rate"])
+      assert val("mpi_slo_alert_firing", [("slo", slo)]) \
+          == (1 if obj["alert"]["firing"] else 0)
+      assert val("mpi_slo_alerts_fired_total", [("slo", slo)]) \
+          == obj["alert"]["fired"]
+    assert families["mpi_slo_burn_rate"]["type"] == "gauge"
+    assert families["mpi_slo_alerts_fired_total"]["type"] == "counter"
+
+  def test_verdict_block_shape(self):
+    t = SloTracker(_cfg(), clock=FakeClock())
+    for _ in range(20):
+      t.record(ok=True, latency_s=0.01)
+    v = verdict(t.snapshot())
+    assert v["pass"] is True and v["alerts_firing"] == []
+    for obj in v["objectives"].values():
+      assert {"target", "attained", "requests", "burn_fast", "burn_slow",
+              "alerts_fired", "pass"} <= set(obj)
+    assert verdict(None) is None  # SLO-disabled services
+
+
+# --- event log ------------------------------------------------------------
+
+
+class TestEventLog:
+
+  def test_ring_bounds_and_counts(self):
+    clock = FakeClock()
+    log = EventLog(capacity=4, clock=clock)
+    for i in range(7):
+      log.emit("tick", i=i)
+    snap = log.snapshot()
+    assert snap["emitted"] == 7 and snap["dropped"] == 3
+    assert [e["i"] for e in snap["events"]] == [3, 4, 5, 6]
+    assert snap["by_kind"] == {"tick": 7}
+    assert all(e["ts_unix_s"] == pytest.approx(clock.t)
+               for e in snap["events"])
+    assert log.count("tick") == 7 and log.count("nope") == 0
+
+  def test_kind_filter_and_recent_bound(self):
+    log = EventLog(clock=FakeClock())
+    log.emit("a", x=1)
+    log.emit("b", x=2)
+    log.emit("a", x=3)
+    snap = log.snapshot(kind="a")
+    assert [e["x"] for e in snap["events"]] == [1, 3]
+    assert len(log.snapshot(recent=1)["events"]) == 1
+
+  def test_file_sink_appends_jsonl(self, tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    sink = file_sink(path)
+    log = EventLog(clock=FakeClock(), sink=sink)
+    log.emit("breaker", old="closed", new="open")
+    log.emit("breaker", old="open", new="half_open")
+    sink.close()
+    lines = [json.loads(l) for l in open(path).read().splitlines()]
+    assert [l["new"] for l in lines] == ["open", "half_open"]
+    assert all(l["kind"] == "breaker" for l in lines)
+
+  def test_failing_sink_is_counted_never_raised(self):
+    def bad_sink(line):
+      raise OSError("disk full")
+
+    log = EventLog(clock=FakeClock(), sink=bad_sink)
+    log.emit("tick")
+    assert log.sink_errors == 1 and log.emitted == 1
+
+
+# --- end-to-end: fault window -> alert -> recovery (the acceptance pin) ---
+
+
+def _get(port, path):
+  with urllib.request.urlopen(
+      f"http://127.0.0.1:{port}{path}", timeout=30) as resp:
+    return resp.status, resp.read()
+
+
+def _get_json(port, path):
+  status, body = _get(port, path)
+  return status, json.loads(body)
+
+
+@pytest.fixture
+def faulty_slo_service():
+  """A serve_load --chaos --dry style rig: real service + scheduler over
+  a FaultyEngine, SLO tracker on an injectable clock so window edges are
+  deterministic."""
+  clock = FakeClock()
+  tracker = SloTracker(_cfg(), clock=clock)
+  engine = FaultyEngine(RenderEngine(use_mesh=False))
+  svc = RenderService(engine=engine, resilience=None, max_batch=2,
+                      max_wait_ms=1.0, slo=tracker, tracer=Tracer(),
+                      metrics_ttl_s=0.0)
+  svc.add_synthetic_scenes(1, height=H, width=W, planes=P)
+  svc.warmup()
+  yield svc, engine, tracker, clock
+  svc.close()
+
+
+def test_slo_alert_fires_and_clears_across_all_surfaces(faulty_slo_service):
+  svc, engine, tracker, clock = faulty_slo_service
+  httpd = make_http_server(svc)
+  port = httpd.server_address[1]
+  import threading
+
+  threading.Thread(target=httpd.serve_forever, daemon=True).start()
+  try:
+    # Phase 1 — healthy traffic: ok everywhere.
+    for i in range(8):
+      svc.render("scene_000", _pose(0.001 * i), timeout=60)
+    status, health = _get_json(port, "/healthz")
+    assert status == 200 and health["status"] == "ok"
+    assert health["slo_alerts_firing"] == []
+
+    # Phase 2 — the injected fault window: every dispatch fails, the
+    # availability burn crosses threshold in both windows.
+    for i in range(10):
+      engine.fail_next(1)
+      with pytest.raises(Exception, match="UNAVAILABLE"):
+        svc.render("scene_000", _pose(0.001 * i), timeout=60)
+    assert tracker.alerts_firing() == ["availability"]
+
+    _, health = _get_json(port, "/healthz")
+    assert health["status"] == "degraded"
+    assert "SLO alert firing" in health["reason"]
+    assert "availability" in health["reason"]
+    assert health["slo_alerts_firing"] == ["availability"]
+
+    _, stats = _get_json(port, "/stats")
+    slo = stats["slo"]
+    assert slo["alerts_firing"] == ["availability"]
+    avail = slo["objectives"]["availability"]
+    assert avail["alert"]["firing"] is True and avail["alert"]["fired"] == 1
+    assert avail["fast"]["burn_rate"] >= 10.0
+
+    _, body = _get(port, "/metrics")
+    families = parse_metrics_text(body.decode())
+    firing = families["mpi_slo_alert_firing"]["samples"]
+    assert firing[("mpi_slo_alert_firing",
+                   (("slo", "availability"),))] == 1
+    # /metrics agrees with /stats on the new families (the PR-3 pin,
+    # extended to mpi_slo_*).
+    assert families["mpi_slo_window_bad"]["samples"][
+        ("mpi_slo_window_bad",
+         (("slo", "availability"), ("window", "fast")))] \
+        == avail["fast"]["bad"]
+
+    # The serve_load JSON slo verdict block judges the same state.
+    v = verdict(slo)
+    assert v["alerts_firing"] == ["availability"]
+    assert v["objectives"]["availability"]["pass"] is False
+    assert v["pass"] is False
+
+    # Phase 3 — recovery: faults stop, the fast window drains, good
+    # traffic resumes; the alert clears on every surface.
+    clock.advance(11)
+    for i in range(8):
+      svc.render("scene_000", _pose(0.001 * i), timeout=60)
+    assert tracker.alerts_firing() == []
+    _, health = _get_json(port, "/healthz")
+    assert health["status"] == "ok"
+    assert health["slo_alerts_firing"] == []
+    _, stats = _get_json(port, "/stats")
+    alert = stats["slo"]["objectives"]["availability"]["alert"]
+    assert alert["firing"] is False
+    assert alert["fired"] == 1 and alert["cleared"] == 1
+    _, body = _get(port, "/metrics")
+    families = parse_metrics_text(body.decode())
+    assert families["mpi_slo_alert_firing"]["samples"][
+        ("mpi_slo_alert_firing", (("slo", "availability"),))] == 0
+
+    # The lifecycle record: fire AND clear landed in /debug/events.
+    _, events = _get_json(port, "/debug/events?kind=slo_alert")
+    edges = [(e["slo"], e["firing"]) for e in events["events"]]
+    assert ("availability", True) in edges
+    assert ("availability", False) in edges
+  finally:
+    httpd.shutdown()
+
+
+def test_healthz_appends_slo_reason_to_breaker_degradation():
+  # Breaker-degraded AND SLO-firing must both show up in the reason.
+  clock = FakeClock()
+  tracker = SloTracker(_cfg(), clock=clock)
+  svc = RenderService(use_mesh=False, slo=tracker, metrics_ttl_s=0.0)
+  try:
+    for _ in range(20):
+      tracker.record(ok=False)
+    assert tracker.alerts_firing() == ["availability"]
+    health = svc.healthz()
+    assert health["status"] == "degraded"
+    assert "SLO alert firing" in health["reason"]
+  finally:
+    svc.close()
+
+
+# --- /debug endpoints -----------------------------------------------------
+
+
+def test_debug_traces_id_filter_returns_one_trace():
+  svc = RenderService(use_mesh=False, tracer=Tracer(), metrics_ttl_s=0.0)
+  svc.add_synthetic_scenes(1, height=H, width=W, planes=P)
+  httpd = make_http_server(svc)
+  port = httpd.server_address[1]
+  import threading
+
+  threading.Thread(target=httpd.serve_forever, daemon=True).start()
+  try:
+    _, tid = svc.render_traced("scene_000", _pose())
+    svc.render_traced("scene_000", _pose(0.01))  # a second, different trace
+    _, found = _get_json(port, f"/debug/traces?id={tid}")
+    assert found["trace_id"] == tid
+    assert len(found["traces"]) == 1
+    assert found["traces"][0]["trace_id"] == tid
+    assert any(s["name"] == "dispatch" for s in found["traces"][0]["spans"])
+    _, missing = _get_json(port, "/debug/traces?id=deadbeefdeadbeef")
+    assert missing["traces"] == []
+  finally:
+    httpd.shutdown()
+    svc.close()
+
+
+def test_scene_swap_and_breaker_events_reach_debug_events(tmp_path):
+  from mpi_vision_tpu.serve.server import synthetic_scene
+
+  svc = RenderService(use_mesh=False, metrics_ttl_s=0.0)
+  httpd = make_http_server(svc)
+  port = httpd.server_address[1]
+  import threading
+
+  threading.Thread(target=httpd.serve_forever, daemon=True).start()
+  try:
+    svc.add_synthetic_scenes(1, height=H, width=W, planes=P)
+    svc.swap_scenes({"scene_000": synthetic_scene("scene_000", H, W, P,
+                                                  seed=7)})
+    _, events = _get_json(port, "/debug/events")
+    kinds = [e["kind"] for e in events["events"]]
+    assert "scene_swap" in kinds
+    swap = next(e for e in events["events"] if e["kind"] == "scene_swap")
+    assert swap["scenes"] == ["scene_000"]
+    assert events["emitted"] >= 1
+    # recent must be validated, not crash the handler.
+    status, _ = _get(port, "/debug/events?recent=2")
+    assert status == 200
+  finally:
+    httpd.shutdown()
+    svc.close()
+
+
+def test_breaker_transitions_emit_events():
+  engine = FaultyEngine(RenderEngine(use_mesh=False))
+  from mpi_vision_tpu.serve import ResilienceConfig
+
+  svc = RenderService(
+      engine=engine, max_batch=1, max_wait_ms=0.5, metrics_ttl_s=0.0,
+      resilience=ResilienceConfig(max_retries=0, breaker_threshold=2,
+                                  breaker_reset_s=60.0, watchdog_s=None),
+      cpu_fallback="off")
+  svc.add_synthetic_scenes(1, height=H, width=W, planes=P)
+  try:
+    svc.warmup()
+    engine.fail_next(2)
+    for _ in range(2):
+      with pytest.raises(Exception):  # noqa: B017 - any transient error
+        svc.render("scene_000", _pose(), timeout=30)
+    snap = svc.events.snapshot(kind="breaker")
+    assert [(e["old"], e["new"]) for e in snap["events"]] \
+        == [("closed", "open")]
+  finally:
+    engine.release.set()
+    svc.close()
+
+
+# --- profile artifact-upload hook -----------------------------------------
+
+
+def _fake_profiler(tmp_path):
+  return DeviceProfiler(str(tmp_path), trace_ctx=lambda d: contextlib.nullcontext(),
+                        clock=FakeClock(), sleep=lambda s: None)
+
+
+def test_profile_hook_receives_capture_dir(tmp_path):
+  seen = []
+  svc = RenderService(use_mesh=False, profiler=_fake_profiler(tmp_path),
+                      profile_hook=seen.append, metrics_ttl_s=0.0)
+  try:
+    result = svc.profile(0.5)
+    assert result["hook"] == "ok"
+    assert seen == [result["logdir"]]
+    assert svc.profile_hook_failures == 0
+    assert svc.stats()["profile"] == {"captures": 1, "hook_failures": 0}
+  finally:
+    svc.close()
+
+
+def test_profile_hook_failure_is_counted_never_fatal(tmp_path):
+  def bad_hook(path):
+    raise RuntimeError("upload refused")
+
+  svc = RenderService(use_mesh=False, profiler=_fake_profiler(tmp_path),
+                      profile_hook=bad_hook, metrics_ttl_s=0.0)
+  try:
+    result = svc.profile(0.5)  # must NOT raise
+    assert result["hook"].startswith("failed:")
+    assert svc.profile_hook_failures == 1
+    assert svc.stats()["profile"]["hook_failures"] == 1
+    assert svc.events.count("profile_hook_failed") == 1
+    # The capture machinery is intact for the next call.
+    assert svc.profile(0.5)["capture"] == 2
+  finally:
+    svc.close()
+
+
+# --- router aggregation (fake transport, no sockets) ----------------------
+
+
+class FakeBackendTransport:
+  """Canned per-backend GET responses keyed by (address, path)."""
+
+  def __init__(self, responses):
+    self.responses = responses  # {address: {path: payload-dict}}
+
+  def request(self, method, url, body=None, headers=None, timeout=30.0):
+    parsed = urllib.parse.urlsplit(url)
+    address = parsed.netloc
+    path = parsed.path + ("?" + parsed.query if parsed.query else "")
+    backend = self.responses.get(address)
+    if backend is None:
+      raise ConnectionError("refused")
+    payload = backend.get(path)
+    if payload is None:
+      payload = {"error": f"unknown path {path}"}
+    return 200, {"Content-Type": "application/json"}, \
+        json.dumps(payload).encode()
+
+
+def _backend_slo_block(firing, bad, total):
+  attained = None if total == 0 else round(1.0 - bad / total, 6)
+  def win():
+    return {"window_s": 60.0, "requests": total, "bad": bad,
+            "attained": attained, "burn_rate": 0.0 if not total
+            else round((bad / total) / 0.01, 4)}
+  return {
+      "config": {"burn_threshold": 10.0},
+      "objectives": {
+          "availability": {
+              "target": 0.99, "fast": win(), "slow": win(),
+              "alert": {"firing": firing, "fired": int(firing),
+                        "cleared": 0}},
+          "latency": {
+              "target": 0.95,
+              "fast": {"window_s": 60.0, "requests": total, "bad": 0,
+                       "attained": 1.0 if total else None,
+                       "burn_rate": 0.0},
+              "slow": {"window_s": 600.0, "requests": total, "bad": 0,
+                       "attained": 1.0 if total else None,
+                       "burn_rate": 0.0},
+              "alert": {"firing": False, "fired": 0, "cleared": 0}},
+      },
+      "alerts_firing": ["availability"] if firing else [],
+      "alert_errors": 0,
+  }
+
+
+def test_router_aggregates_slo_state_across_backends():
+  transport = FakeBackendTransport({
+      "h1:1": {"/stats": {"requests": 10,
+                          "slo": _backend_slo_block(True, 50, 100)}},
+      "h2:2": {"/stats": {"requests": 10,
+                          "slo": _backend_slo_block(False, 0, 100)}},
+  })
+  router = Router({"b1": "h1:1", "b2": "h2:2"}, transport=transport)
+  slo = router.stats()["slo"]
+  assert slo["backends_reporting"] == 2
+  assert slo["alerts_firing"] == {"b1": ["availability"]}
+  assert slo["worst"]["availability"]["backend"] == "b1"
+  att = slo["attainment"]["availability"]
+  assert att["requests"] == 200 and att["bad"] == 50
+  assert att["attained"] == pytest.approx(0.75)
+
+
+def test_router_debug_events_merges_router_and_backends():
+  transport = FakeBackendTransport({
+      "h1:1": {"/debug/events?recent=128": {
+          "emitted": 2, "dropped": 0, "sink_errors": 0, "capacity": 512,
+          "by_kind": {"breaker": 2},
+          "events": [{"seq": 1, "kind": "breaker"}]}},
+  })
+  router = Router({"b1": "h1:1"}, transport=transport)
+  router.events.emit("failover", scene_id="s", to_backend="b1")
+  snap = router.events_snapshot()
+  assert snap["router"]["by_kind"] == {"failover": 1}
+  assert snap["backends"]["b1"]["emitted"] == 2
+
+
+def test_router_trace_search_stitches_cross_process_tree():
+  tid = "a" * 32
+  backend_trace = {"trace_id": tid, "name": "render", "duration_ms": 5.0,
+                   "error": None,
+                   "spans": [{"id": 1, "parent": 0, "name": "dispatch",
+                              "t0_ms": 0.0, "duration_ms": 4.0}]}
+  transport = FakeBackendTransport({
+      "h1:1": {f"/debug/traces?id={tid}": {"trace_id": tid,
+                                           "traces": [backend_trace]}},
+      "h2:2": {f"/debug/traces?id={tid}": {"trace_id": tid, "traces": []}},
+  })
+  clock = FakeClock()
+  tracer = Tracer(clock=clock)
+  router = Router({"b1": "h1:1", "b2": "h2:2"}, transport=transport,
+                  tracer=tracer, clock=clock)
+  tr = tracer.start_trace("route", trace_id=tid)
+  span = tr.start_span("forward", backend="b1")
+  clock.advance(0.004)
+  tr.end_span(span)
+  tr.finish()
+  stitched = router.find_trace(tid)
+  assert stitched["trace_id"] == tid
+  assert stitched["processes"] == 2         # router + the one backend hit
+  assert len(stitched["router"]) == 1
+  assert stitched["backends"] == {"b1": [backend_trace]}
+  assert stitched["spans_total"] == 2       # router's forward + backend's
+  # An id nobody recorded is an empty, well-formed answer.
+  missing = router.find_trace("b" * 32)
+  assert missing["processes"] == 0 and missing["spans_total"] == 0
+
+
+def test_router_metrics_drop_non_additive_slo_gauges():
+  """Pool-summing a 0.99 target across 3 backends must NOT export 2.97
+  (nor let one idle backend's NaN attainment poison the fleet): the
+  ratio/target/threshold slo gauges are dropped from the aggregate,
+  while the summable slices (window counts, alert one-hots) survive."""
+  tracker = SloTracker(_cfg(), clock=FakeClock())
+  tracker.record(ok=True, latency_s=0.01)
+  text = tracker.metrics_text()
+
+  class MetricsTransport:
+    def request(self, method, url, body=None, headers=None, timeout=30.0):
+      assert url.endswith("/metrics")
+      return 200, {"Content-Type": "text/plain"}, text.encode()
+
+  router = Router({"b1": "h1:1", "b2": "h2:2"},
+                  transport=MetricsTransport(), metrics_ttl_s=0.0)
+  families = parse_metrics_text(router.metrics_text())
+  for dropped in ("mpi_slo_objective_target", "mpi_slo_attainment_ratio",
+                  "mpi_slo_burn_rate", "mpi_slo_burn_threshold",
+                  "mpi_slo_latency_threshold_seconds"):
+    assert dropped not in families, dropped
+  # Summable slices aggregate across the pool.
+  assert families["mpi_slo_window_requests"]["samples"][
+      ("mpi_slo_window_requests",
+       (("slo", "availability"), ("window", "fast")))] == 2
+  assert families["mpi_slo_alert_firing"]["samples"][
+      ("mpi_slo_alert_firing", (("slo", "availability"),))] == 0
+  assert "mpi_cluster_backends" in families
+
+
+def test_router_failover_emits_event():
+  class FailFirstTransport:
+    def request(self, method, url, body=None, headers=None, timeout=30.0):
+      if "h1:1" in url:
+        raise ConnectionError("dead host")
+      return 200, {"Content-Type": "application/json"}, json.dumps({
+          "scene_id": "s", "shape": [1, 1, 3],
+          "image_b64": "A" * 16}).encode()
+
+  router = Router({"b1": "h1:1", "b2": "h2:2"},
+                  transport=FailFirstTransport())
+  # Force placement order: walk replicas until the dead one is primary.
+  sid = next(s for s in ("s%d" % i for i in range(64))
+             if router.placement(s)[0] == "b1")
+  status, headers, _ = router.forward_render(sid, b"{}")
+  assert status == 200 and headers["X-Backend-Id"] == "b2"
+  snap = router.events.snapshot(kind="failover")
+  assert len(snap["events"]) == 1
+  assert snap["events"][0]["to_backend"] == "b2"

@@ -82,6 +82,9 @@ def test_train_ckpt_overwrite(tmp_path, capsys):
     ["train", "--synthetic", "--resume"],
     ["train", "--synthetic", "--save-every", "5", "--keep", "2"],
     ["train", "--synthetic", "--no-nan-guard"],
+    ["train", "--synthetic", "--metrics-port", "0"],
+    ["train", "--synthetic", "--metrics-log", "/tmp/m.jsonl"],
+    ["train", "--synthetic", "--event-log", "/tmp/e.jsonl"],
     ["serve", "--ckpt-scenes", "3"],
     ["serve", "--ckpt-dataset", "/data/re10k"],
     ["serve", "--reload-ckpt-s", "5"],
@@ -92,6 +95,20 @@ def test_ckpt_flags_without_ckpt_are_rejected(argv):
   instead of the trained MPIs)."""
   with pytest.raises(SystemExit, match=r"require\(s\) --ckpt"):
     cli.main(argv)
+
+
+def test_profile_hook_without_profile_dir_rejected():
+  """A hook with no captures to hand it is a silently-dead knob."""
+  with pytest.raises(SystemExit, match="--profile-hook requires"):
+    cli.main(["serve", "--profile-hook", "echo", "--duration", "0.1"])
+
+
+def test_metrics_port_file_without_metrics_port_rejected(tmp_path):
+  """The port file is only written by the metrics listener; dangling it
+  would hang a supervisor waiting on the file."""
+  with pytest.raises(SystemExit, match="--metrics-port-file requires"):
+    cli.main(["train", "--synthetic", "--ckpt", str(tmp_path),
+              "--metrics-port-file", str(tmp_path / "p")])
 
 
 @pytest.mark.parametrize("argv", [

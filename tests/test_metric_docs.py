@@ -1,0 +1,97 @@
+"""Metric-name drift self-check: README's metric reference vs reality.
+
+Every ``mpi_*`` family any of this repo's registries can expose — serve
+backend (``obs.prom.serve_registry``), SLO engine
+(``obs.slo.SloTracker.registry``), cluster router
+(``Router._cluster_registry``), training telemetry
+(``train.telemetry.TrainMetrics.registry``) — must appear as a
+backticked full name in README.md, and vice versa: a backticked
+``mpi_*`` token in the README that no registry exposes is a doc for a
+metric that does not exist. Either direction failing means the metric
+reference rotted silently — exactly what this tier-1 pin exists to
+prevent.
+
+Prefix mentions (backticked tokens ending in ``_``, e.g. ``mpi_serve_``)
+and wildcard patterns (``mpi_slo_*`` — the ``*`` breaks the token match)
+are deliberately NOT counted as family names.
+"""
+
+import pathlib
+import re
+
+from mpi_vision_tpu.obs import prom
+from mpi_vision_tpu.obs.slo import SloConfig, SloTracker
+from mpi_vision_tpu.serve.cluster.router import Router
+from mpi_vision_tpu.serve.metrics import ServeMetrics
+from mpi_vision_tpu.train.telemetry import TrainMetrics
+
+README = pathlib.Path(__file__).parent.parent / "README.md"
+
+# A full family name is the ENTIRE backticked token under one of the
+# exported prefixes (plain `mpi_*` would also catch API names like
+# `mpi_from_net_output`); `mpi_serve_` (prefix mention) ends in '_' and
+# is filtered below; `mpi_slo_*` (wildcard) never matches because '*'
+# precedes the closing backtick.
+_TOKEN = re.compile(r"`(mpi_(?:serve|slo|cluster|train)_[a-z0-9_]+)`")
+
+
+def _serve_families() -> set[str]:
+  m = ServeMetrics()
+  stats = m.snapshot(cache_stats={"hits": 0, "misses": 0, "evictions": 0,
+                                  "bytes": 0, "scenes": 0})
+  stats["breaker"] = {"state": "closed", "consecutive_failures": 0}
+  reg = prom.serve_registry(stats, m.latency_histogram())
+  return {metric.name for metric in reg._metrics}
+
+
+def _slo_families() -> set[str]:
+  tracker = SloTracker(SloConfig(), clock=lambda: 0.0)
+  tracker.record(ok=True, latency_s=0.01)
+  return {metric.name for metric in tracker.registry()._metrics}
+
+
+def _cluster_families() -> set[str]:
+  router = Router(clock=lambda: 0.0)
+  return {metric.name for metric in router._cluster_registry()._metrics}
+
+
+def _train_families() -> set[str]:
+  tm = TrainMetrics(clock=lambda: 0.0)
+  tm.record_step(1, loss=0.1, wall_s=0.01, examples=1, lr=1e-3)
+  return {metric.name for metric in tm.registry()._metrics}
+
+
+def _exposed_families() -> set[str]:
+  return (_serve_families() | _slo_families() | _cluster_families()
+          | _train_families())
+
+
+def _documented_families() -> set[str]:
+  text = README.read_text()
+  return {tok for tok in _TOKEN.findall(text) if not tok.endswith("_")}
+
+
+def test_every_exposed_family_is_documented():
+  missing = _exposed_families() - _documented_families()
+  assert not missing, (
+      "families exposed by /metrics but absent from README's metric "
+      f"reference: {sorted(missing)}")
+
+
+def test_every_documented_family_is_exposed():
+  phantom = _documented_families() - _exposed_families()
+  assert not phantom, (
+      "README documents metric families no registry exposes "
+      f"(doc rot or a typo): {sorted(phantom)}")
+
+
+def test_doc_scan_actually_finds_families():
+  # The regex must really extract names (an empty set x empty set pass
+  # would be meaningless) and really skip prefixes/wildcards.
+  docs = _documented_families()
+  assert "mpi_serve_requests_total" in docs
+  assert "mpi_slo_burn_rate" in docs
+  assert "mpi_train_steps_total" in docs
+  assert "mpi_cluster_backend_up" in docs
+  assert not any(t.endswith("_") for t in docs)
+  assert len(_exposed_families()) > 40

@@ -1,0 +1,298 @@
+"""Training telemetry tests: TrainMetrics accounting, mpi_train_*
+exposition, the JSONL sink, fit_resumable threading, and the
+``train --metrics-port`` smoke — a live HTTP scrape of a RUNNING
+training loop (the acceptance pin: training is scrapeable exactly like
+a serve backend)."""
+
+import dataclasses
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mpi_vision_tpu.ckpt import CheckpointStore, NanGuard, PreemptionGuard
+from mpi_vision_tpu.obs import parse_metrics_text
+from mpi_vision_tpu.obs.events import EventLog
+from mpi_vision_tpu.train import loop as tloop
+from mpi_vision_tpu.train.telemetry import (
+    TrainMetrics,
+    file_metrics_sink,
+    make_train_metrics_server,
+)
+
+
+class FakeClock:
+  def __init__(self, t=100.0):
+    self.t = t
+
+  def __call__(self):
+    return self.t
+
+  def advance(self, dt):
+    self.t += dt
+    return self.t
+
+
+# --- a minimal train-state stand-in (no model compile) --------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class MiniState:
+  params: dict
+  opt_state: tuple
+  step: int
+
+  def replace(self, **kw):
+    return dataclasses.replace(self, **kw)
+
+
+def _mini_state():
+  return MiniState(params={"w": np.zeros(3, np.float32)}, opt_state=(),
+                   step=0)
+
+
+def _mini_step(state, batch):
+  batch = np.asarray(batch, np.float32)
+  new = state.replace(
+      step=state.step + 1,
+      params={"w": state.params["w"] + batch.mean()})
+  return new, {"loss": float(batch.mean())}
+
+
+def _epoch(e):
+  return [np.full((2, 3), 0.1 * (e + 1) + 0.01 * i, np.float32)
+          for i in range(3)]
+
+
+# --- TrainMetrics unit ----------------------------------------------------
+
+
+def test_snapshot_and_registry_agree():
+  clock = FakeClock()
+  tm = TrainMetrics(clock=clock)
+  for i in range(5):
+    tm.record_step(i + 1, loss=0.5 - 0.01 * i, wall_s=0.02, examples=4,
+                   lr=1e-3)
+  tm.record_save(5, seconds=0.3, nbytes=1024, reason="epoch")
+  tm.record_rollback(3)
+  tm.record_preemption(5)
+  tm.record_restore(3)
+  tm.record_epoch(1)
+  clock.advance(2.0)
+  snap = tm.snapshot()
+  assert snap["steps"] == 5 and snap["step"] == 5 and snap["epoch"] == 1
+  assert snap["examples"] == 20
+  assert snap["examples_per_sec"] == pytest.approx(20 / 0.1)
+  assert snap["loss"] == pytest.approx(0.46)
+  assert snap["learning_rate"] == pytest.approx(1e-3)
+  assert snap["ckpt"] == {"saves": 1, "save_seconds": 0.3,
+                          "save_bytes": 1024, "last_save_ms": 300.0,
+                          "last_save_bytes": 1024}
+  assert snap["nan_rollbacks"] == 1 and snap["preemptions"] == 1
+  assert snap["restores"] == 1
+  assert snap["step_ms"]["p50"] == pytest.approx(20.0)
+
+  families = parse_metrics_text(tm.registry(snap).render())
+
+  def val(name):
+    return families[name]["samples"][(name, ())]
+
+  assert val("mpi_train_steps_total") == snap["steps"]
+  assert val("mpi_train_step") == snap["step"]
+  assert val("mpi_train_epoch") == snap["epoch"]
+  assert val("mpi_train_examples_total") == snap["examples"]
+  assert val("mpi_train_step_seconds_total") \
+      == pytest.approx(snap["step_seconds"])
+  assert val("mpi_train_loss") == pytest.approx(snap["loss"])
+  assert val("mpi_train_learning_rate") == pytest.approx(1e-3)
+  assert val("mpi_train_ckpt_saves_total") == 1
+  assert val("mpi_train_ckpt_save_bytes_total") == 1024
+  assert val("mpi_train_nan_rollbacks_total") == 1
+  assert val("mpi_train_preemptions_total") == 1
+  assert val("mpi_train_restores_total") == 1
+  assert families["mpi_train_steps_total"]["type"] == "counter"
+  assert families["mpi_train_loss"]["type"] == "gauge"
+
+
+def test_idle_metrics_render_without_nans_breaking_parse():
+  tm = TrainMetrics(clock=FakeClock())
+  families = parse_metrics_text(tm.metrics_text())
+  assert families["mpi_train_steps_total"]["samples"][
+      ("mpi_train_steps_total", ())] == 0
+  # loss/lr/throughput are NaN while idle — exposition must still parse.
+  assert "mpi_train_loss" in families
+
+
+def test_jsonl_sink_records_steps_and_saves(tmp_path):
+  path = str(tmp_path / "metrics.jsonl")
+  sink = file_metrics_sink(path)
+  tm = TrainMetrics(clock=FakeClock(), sink=sink)
+  tm.record_step(1, loss=0.5, wall_s=0.01, examples=2, lr=2e-4)
+  tm.record_save(1, seconds=0.1, nbytes=64, reason="epoch")
+  sink.close()
+  lines = [json.loads(l) for l in open(path).read().splitlines()]
+  assert [l["event"] for l in lines] == ["train_step", "ckpt_save"]
+  assert lines[0]["step"] == 1 and lines[0]["lr"] == pytest.approx(2e-4)
+  assert lines[1]["bytes"] == 64 and lines[1]["reason"] == "epoch"
+
+
+def test_failing_sink_counted_never_raised():
+  def bad(line):
+    raise OSError("pipe closed")
+
+  tm = TrainMetrics(clock=FakeClock(), sink=bad)
+  tm.record_step(1, loss=0.5, wall_s=0.01)
+  assert tm.sink_errors == 1 and tm.steps == 1
+
+
+# --- fit_resumable threading ----------------------------------------------
+
+
+def test_fit_resumable_records_steps_saves_and_events(tmp_path):
+  tm = TrainMetrics()
+  ev = EventLog(clock=FakeClock())
+  store = CheckpointStore(str(tmp_path), events=ev)
+  state, report = tloop.fit_resumable(
+      _mini_state(), 2, _epoch, store, step=_mini_step, resume="never",
+      telemetry=tm, events=ev)
+  assert report["final_step"] == 6
+  snap = tm.snapshot()
+  assert snap["steps"] == 6 and snap["step"] == 6
+  assert snap["examples"] == 12          # 6 steps x batch of 2
+  assert snap["epoch"] == 1              # last finished epoch index
+  assert snap["loss"] == pytest.approx(report["losses"][-1])
+  # Every save the report counts is in the telemetry, with real cost.
+  assert snap["ckpt"]["saves"] == report["saves"]
+  assert snap["ckpt"]["save_bytes"] > 0
+  # The store emitted its lifecycle into the event log.
+  assert ev.count("ckpt_save") == report["saves"]
+  save = ev.snapshot(kind="ckpt_save")["events"][0]
+  assert save["bytes"] > 0 and save["reason"] == "initial"
+
+
+def test_fit_resumable_restore_and_rollback_telemetry(tmp_path):
+  ev = EventLog(clock=FakeClock())
+  store = CheckpointStore(str(tmp_path), events=ev)
+  tloop.fit_resumable(_mini_state(), 1, _epoch, store, step=_mini_step,
+                      resume="never")
+
+  # Resume: the restore is counted and the event emitted.
+  tm = TrainMetrics()
+  _, report = tloop.fit_resumable(
+      _mini_state(), 2, _epoch, CheckpointStore(str(tmp_path), events=ev),
+      step=_mini_step, resume="auto", telemetry=tm, events=ev)
+  assert report["resumed_from"] == 3
+  assert tm.snapshot()["restores"] == 1
+  assert ev.count("ckpt_restore") >= 1
+
+  # NaN rollback: counter + event with the rollback target.
+  poisoned = []
+
+  def nan_step(state, batch):
+    new, metrics = _mini_step(state, batch)
+    if state.step == 4 and not poisoned:  # one TRANSIENT glitch
+      poisoned.append(True)
+      return new, {"loss": float("nan")}
+    return new, metrics
+
+  tm2 = TrainMetrics()
+  ev2 = EventLog(clock=FakeClock())
+  _, report = tloop.fit_resumable(
+      _mini_state(), 2, _epoch, CheckpointStore(str(tmp_path / "nan"),
+                                                events=ev2),
+      step=nan_step, resume="never", nan_guard=NanGuard(max_rollbacks=3),
+      telemetry=tm2, events=ev2)
+  assert report["nan_rollbacks"] >= 1
+  assert tm2.snapshot()["nan_rollbacks"] == report["nan_rollbacks"]
+  roll = ev2.snapshot(kind="nan_rollback")["events"]
+  assert roll and roll[0]["to_step"] in report["nan_rollback_steps"]
+
+
+def test_fit_resumable_preemption_telemetry(tmp_path):
+  tm = TrainMetrics()
+  ev = EventLog(clock=FakeClock())
+  preempt = PreemptionGuard()
+
+  def step(state, batch):
+    new, metrics = _mini_step(state, batch)
+    if new.step == 2:
+      preempt.request()
+    return new, metrics
+
+  _, report = tloop.fit_resumable(
+      _mini_state(), 2, _epoch, CheckpointStore(str(tmp_path), events=ev),
+      step=step, resume="never", preemption=preempt,
+      telemetry=tm, events=ev)
+  assert report["preempted"] is True
+  assert tm.snapshot()["preemptions"] == 1
+  assert ev.count("preempt") == 1
+
+
+# --- the --metrics-port smoke: scrape a RUNNING loop ----------------------
+
+
+def _scrape(port, path="/metrics"):
+  with urllib.request.urlopen(
+      f"http://127.0.0.1:{port}{path}", timeout=30) as resp:
+    return resp.read().decode()
+
+
+def test_metrics_server_scrapes_live_training_loop(tmp_path):
+  """The acceptance pin: while fit_resumable is mid-run, a stock HTTP
+  scrape of /metrics sees live, increasing mpi_train_* step metrics —
+  then the post-run scrape shows the completed totals."""
+  tm = TrainMetrics()
+  ev = EventLog(clock=FakeClock())
+  httpd = make_train_metrics_server(tm, events=ev)
+  port = httpd.server_address[1]
+  threading.Thread(target=httpd.serve_forever, daemon=True).start()
+
+  reached_step_2 = threading.Event()
+  release = threading.Event()
+
+  def gated_step(state, batch):
+    new, metrics = _mini_step(state, batch)
+    if new.step == 2:
+      # Park the loop mid-epoch with step 1 already recorded, so the
+      # scrape below provably reads a RUNNING training process.
+      reached_step_2.set()
+      assert release.wait(60), "scraper never released the loop"
+    return new, metrics
+
+  result = {}
+
+  def run():
+    _, result["report"] = tloop.fit_resumable(
+        _mini_state(), 2, _epoch, CheckpointStore(str(tmp_path),
+                                                  events=ev),
+        step=gated_step, resume="never", telemetry=tm, events=ev)
+
+  worker = threading.Thread(target=run, daemon=True)
+  worker.start()
+  try:
+    assert reached_step_2.wait(60)
+    live = parse_metrics_text(_scrape(port))
+    steps_live = live["mpi_train_steps_total"]["samples"][
+        ("mpi_train_steps_total", ())]
+    assert steps_live == 1                 # mid-run, not post-run
+    assert live["mpi_train_loss"]["samples"][
+        ("mpi_train_loss", ())] == pytest.approx(0.1)
+    stats = json.loads(_scrape(port, "/stats"))
+    assert stats["steps"] == 1
+    health = json.loads(_scrape(port, "/healthz"))
+    assert health == {"status": "ok", "role": "train", "steps": 1,
+                      "step": 1}
+  finally:
+    release.set()
+    worker.join(120)
+  assert not worker.is_alive()
+  done = parse_metrics_text(_scrape(port))
+  assert done["mpi_train_steps_total"]["samples"][
+      ("mpi_train_steps_total", ())] == 6
+  assert done["mpi_train_ckpt_saves_total"]["samples"][
+      ("mpi_train_ckpt_saves_total", ())] == result["report"]["saves"]
+  events = json.loads(_scrape(port, "/debug/events"))
+  assert events["by_kind"].get("ckpt_save", 0) == result["report"]["saves"]
+  httpd.shutdown()

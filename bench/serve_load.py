@@ -40,7 +40,12 @@ through a ``Router`` (consistent-hash placement, per-backend breakers),
 and — unless ``--no-cluster-kill`` — SIGKILL one backend mid-window as a
 chaos phase, so the JSON records failover behavior (reroutes, breaker
 isolation, post-kill throughput) next to the usual serving numbers.
-``--cluster --dry`` is the tier-1 smoke.
+``--cluster --dry`` is the tier-1 smoke. ``--chaos-crashloop`` swaps the
+single kill for the self-healing drill: the fleet supervisor
+(``serve/cluster/supervisor.py``) runs over the pool and one backend is
+killed every time it comes back up until its ``--restart-budget``
+quarantines it; the JSON then records restarts, containment (the
+quarantine), and post-quarantine throughput.
 
 ``--inflight N`` sets the streaming-pipeline window (concurrent
 in-flight batches; 1 = the legacy blocking dispatch) and the JSON gains
@@ -124,6 +129,16 @@ def build_parser() -> argparse.ArgumentParser:
                   default=True,
                   help="SIGKILL the hottest scene's primary backend at "
                        "half the measured window (--cluster)")
+  ap.add_argument("--chaos-crashloop", action="store_true",
+                  help="crash-loop drill (--cluster): run the fleet "
+                       "supervisor, kill one backend every time it "
+                       "comes back up until its restart budget "
+                       "quarantines it, and report restarts / "
+                       "containment / post-quarantine throughput")
+  ap.add_argument("--restart-budget", type=int, default=2,
+                  help="supervisor restarts allowed before the "
+                       "crash-looping backend is quarantined "
+                       "(--chaos-crashloop)")
   return ap
 
 
@@ -203,16 +218,24 @@ def random_pose(rng: np.random.Generator) -> np.ndarray:
 
 def cluster_main(args) -> int:
   """The --cluster measurement: real backend processes, routed traffic,
-  and a kill-a-backend chaos phase. One JSON line like the in-process
-  path, plus a ``cluster`` block (failovers, breaker isolation,
-  per-backend forwards, post-kill throughput)."""
-  from mpi_vision_tpu.serve.cluster import BackendPool, Router
+  and a chaos phase — either the classic single SIGKILL (failover) or,
+  with ``--chaos-crashloop``, a supervised crash loop: one backend dies
+  every time it comes back up until its restart budget quarantines it.
+  One JSON line like the in-process path, plus a ``cluster`` block
+  (failovers, breaker isolation, per-backend forwards, post-kill /
+  post-quarantine throughput, supervisor accounting)."""
+  from mpi_vision_tpu.serve.cluster import (
+      BackendPool,
+      FleetSupervisor,
+      Router,
+  )
 
   env = dict(os.environ)
   env.setdefault("JAX_PLATFORMS", "cpu")  # N local procs share one box
   pool = BackendPool(
       args.cluster_backends, scenes=args.scenes, img_size=args.img_size,
       planes=args.num_planes, seed=args.seed, env=env, log=_log)
+  supervisor = None
   try:
     _log(f"serve_load: spawning {args.cluster_backends} backend(s) "
          f"[{args.scenes} scenes {args.img_size}x{args.img_size}"
@@ -225,12 +248,23 @@ def cluster_main(args) -> int:
                     breaker_threshold=2, breaker_reset_s=60.0,
                     render_timeout_s=60.0)
     ids = pool.scene_ids()
-    victim = router.placement(ids[0])[0] if args.cluster_kill else None
+    victim = (router.placement(ids[0])[0]
+              if (args.cluster_kill or args.chaos_crashloop) else None)
+    if args.chaos_crashloop:
+      # Fast supervision so the whole detect -> restart -> quarantine
+      # arc lands inside the bench window; the budget window is wide so
+      # every injected crash counts toward containment.
+      supervisor = FleetSupervisor(
+          pool, router=router, events=router.events, probe_s=0.1,
+          restart_budget=args.restart_budget, budget_window_s=600.0,
+          backoff_base_s=0.2, backoff_max_s=1.0, log=_log).start()
 
     stop = threading.Event()
     counts = [0] * args.concurrency
     post_kill_counts = [0] * args.concurrency
+    post_quarantine_counts = [0] * args.concurrency
     killed = threading.Event()
+    quarantined_evt = threading.Event()
     failure_counts: collections.Counter = collections.Counter()
     failure_lock = threading.Lock()
 
@@ -255,13 +289,69 @@ def cluster_main(args) -> int:
         counts[idx] += 1
         if killed.is_set():
           post_kill_counts[idx] += 1
+        if quarantined_evt.is_set():
+          post_quarantine_counts[idx] += 1
 
     threads = [threading.Thread(target=worker, args=(i,), daemon=True)
                for i in range(args.concurrency)]
     t0 = time.perf_counter()
     for t in threads:
       t.start()
-    if victim is not None:
+    crashloop = None
+    if args.chaos_crashloop:
+      time.sleep(args.duration / 4)  # clean phase
+      _log(f"serve_load: crash-looping {victim} (restart budget "
+           f"{args.restart_budget})")
+      kills = 0
+      crash_t0 = time.perf_counter()
+      # The arc is respawn-bound (each restart is a real process spawn),
+      # so the loop runs on its own deadline, not the load window's.
+      # Dry mode (the tier-1 smoke) fails FAST on a containment
+      # regression — a 300 s spin inside the suite would mask the real
+      # failure as a global tier-1 timeout.
+      crash_deadline = crash_t0 + (45.0 if args.dry else 300.0)
+      while time.perf_counter() < crash_deadline:
+        state = supervisor.state(victim)
+        if state == FleetSupervisor.QUARANTINED:
+          break
+        if state in (None, FleetSupervisor.UP) and pool.alive(victim):
+          pool.kill(victim)
+          kills += 1
+          killed.set()
+        time.sleep(0.05)
+      quarantine_after_s = time.perf_counter() - crash_t0
+      contained = supervisor.state(victim) == FleetSupervisor.QUARANTINED
+      if contained:
+        # Only a real quarantine starts the post-quarantine window — a
+        # containment regression must not fabricate a trendable
+        # post-quarantine throughput number.
+        quarantined_evt.set()
+      _log(f"serve_load: {victim} "
+           + (f"quarantined after {kills} kills "
+              f"({quarantine_after_s:.1f}s)" if contained
+              else "NOT quarantined before the drill deadline"))
+      time.sleep(args.duration / 2)  # post-quarantine measured tail
+      sup_snap = supervisor.snapshot()
+      crashloop = {
+          "victim": victim,
+          "kills": kills,
+          "restart_budget": args.restart_budget,
+          "restarts": sup_snap["backends"].get(victim, {}).get(
+              "restarts", 0),
+          "quarantined": contained,
+          "quarantine_after_s": round(quarantine_after_s, 3),
+          "post_quarantine_requests": (sum(post_quarantine_counts)
+                                       if contained else None),
+          "post_quarantine_rps": (round(
+              sum(post_quarantine_counts) / max(args.duration / 2, 1e-9),
+              3) if contained else None),
+          "events": {
+              "backend_restart": router.events.count("backend_restart"),
+              "backend_quarantined":
+                  router.events.count("backend_quarantined"),
+          },
+      }
+    elif victim is not None:
       time.sleep(args.duration / 2)
       pool.kill(victim)
       killed.set()
@@ -274,6 +364,8 @@ def cluster_main(args) -> int:
     for t in threads:
       t.join(60)
     elapsed = time.perf_counter() - t0
+    if supervisor is not None:
+      supervisor.stop()
 
     total = sum(counts)
     if total == 0:
@@ -300,13 +392,18 @@ def cluster_main(args) -> int:
             "failovers": snap["failovers"],
             "replica_exhausted": snap["replica_exhausted"],
             "breaker_fastfails": snap["breaker_fastfails"],
+            "retry_budget_exhausted": snap["retry_budget_exhausted"],
+            "restarts": snap["restarts"],
+            "quarantines": snap["quarantines"],
             "forwards": snap["forwards"],
             "breakers": breakers,
+            "ejected": health["ejected"],
             "health": health["status"],
             "failed_requests": dict(sorted(failure_counts.items())),
             # Fleet SLO state as the router aggregates it (firing
             # alerts per backend, hottest burns, pooled attainment).
             "slo": rstats.get("slo"),
+            **({"crashloop": crashloop} if crashloop is not None else {}),
         },
         # The same verdict block the in-process runs carry, judged from
         # the pool-weighted slow-window attainment.
@@ -315,6 +412,8 @@ def cluster_main(args) -> int:
     print(json.dumps(record))
     return 0
   finally:
+    if supervisor is not None:
+      supervisor.stop()
     pool.close()
 
 
@@ -522,6 +621,9 @@ def main(argv=None) -> int:
     args.cluster_backends = min(args.cluster_backends, 3)
   if args.inflight < 1:
     raise SystemExit(f"--inflight must be >= 1, got {args.inflight}")
+  if args.chaos_crashloop and not args.cluster:
+    raise SystemExit("--chaos-crashloop drills the multi-host tier; "
+                     "add --cluster")
   if args.cluster:
     if args.ab:
       raise SystemExit("--ab measures the in-process pipeline; "

@@ -22,7 +22,10 @@ export cell 18). These commands make the same flow scriptable:
     consistent-hash, replication-aware router over a pool of serve
     backends (``--backends N`` spawns a local pool; ``--join`` fronts
     existing hosts) with per-backend circuit breakers, failover, and
-    aggregated ``/stats`` + ``/metrics`` + ``/healthz``.
+    aggregated ``/stats`` + ``/metrics`` + ``/healthz``. With
+    ``--supervise`` the pool self-heals (crash/wedge detection,
+    budgeted restarts, crash-loop quarantine); ``--rolling-restart``
+    redeploys it under live traffic.
 
 All print a one-line JSON summary on stdout (diagnostics on stderr).
 """
@@ -421,6 +424,10 @@ def cmd_serve(args: argparse.Namespace) -> dict:
   if args.profile_hook and not args.profile_dir:
     # A hook with no captures to hand it is a silently-dead knob.
     raise SystemExit("--profile-hook requires --profile-dir")
+  if args.alert_hook and not args.slo:
+    # Alert edges only exist with SLO tracking on; accepting the hook
+    # without it would silently never deliver a page.
+    raise SystemExit("--alert-hook requires SLO tracking (drop --no-slo)")
 
   use_mesh = {"auto": None, "on": True, "off": False}[args.sharded]
   resilience = None
@@ -466,6 +473,19 @@ def cmd_serve(args: argparse.Namespace) -> dict:
       # failure surfaces as a counted, non-fatal hook error.
       subprocess.run([*_argv, capture_dir], check=True, timeout=600)
 
+  alert_hook = None
+  if args.alert_hook:
+    import shlex
+    import subprocess
+
+    alert_argv = shlex.split(args.alert_hook)
+
+    def alert_hook(record, _argv=alert_argv):
+      # The slo_alert event rides as one JSON argv element (fire AND
+      # clear edges — a pager needs both); failures are counted by the
+      # service, never fatal.
+      subprocess.run([*_argv, json.dumps(record)], check=True, timeout=60)
+
   svc = RenderService(
       cache_bytes=args.cache_mb << 20, max_batch=args.max_batch,
       max_wait_ms=args.max_wait_ms, max_inflight=args.max_inflight,
@@ -473,7 +493,7 @@ def cmd_serve(args: argparse.Namespace) -> dict:
       max_queue=args.max_queue, resilience=resilience,
       cpu_fallback=args.cpu_fallback, tracer=tracer,
       profile_dir=args.profile_dir or None, profile_hook=profile_hook,
-      slo=slo, events=events,
+      alert_hook=alert_hook, slo=slo, events=events,
       metrics_ttl_s=args.metrics_ttl_ms / 1e3)
   if args.mpi_dir:
     from mpi_vision_tpu.core.camera import intrinsics_matrix, inv_depths
@@ -623,6 +643,8 @@ def cmd_serve(args: argparse.Namespace) -> dict:
               name: obj["alert"]["fired"]
               for name, obj in stats["slo"]["objectives"].items()},
       }} if "slo" in stats else {}),
+      **({"alert_hook": stats["alert_hook"]}
+         if "alert_hook" in stats else {}),
       "events_emitted": stats["events"]["emitted"],
       **({"traces": svc.tracer.finished} if args.trace else {}),
       **({"ckpt_step": ckpt_info["step"],
@@ -639,6 +661,7 @@ def cmd_cluster(args: argparse.Namespace) -> dict:
   from mpi_vision_tpu.obs import Tracer
   from mpi_vision_tpu.serve.cluster import (
       BackendPool,
+      FleetSupervisor,
       Router,
       make_router_http_server,
   )
@@ -647,8 +670,25 @@ def cmd_cluster(args: argparse.Namespace) -> dict:
     raise SystemExit(
         "cluster needs exactly one of --backends N (spawn a local pool) "
         "or --join host:port,... (front existing backends)")
+  if (args.supervise or args.rolling_restart) and not args.backends:
+    # Supervision needs process control; --join fronts backends some
+    # other supervisor (k8s, systemd) owns.
+    raise SystemExit(
+        "--supervise/--rolling-restart require --backends (a local pool "
+        "this process can kill and respawn)")
+  if args.restart_budget < 1:
+    raise SystemExit(
+        f"--restart-budget must be >= 1, got {args.restart_budget}")
+  if args.restart_window_s <= 0:
+    raise SystemExit(
+        f"--restart-window-s must be > 0, got {args.restart_window_s}")
+  if args.probe_s <= 0:
+    raise SystemExit(f"--probe-s must be > 0, got {args.probe_s}")
+  if args.wedge_after < 1:
+    raise SystemExit(f"--wedge-after must be >= 1, got {args.wedge_after}")
 
   pool = None
+  supervisor = None
   try:
     if args.backends:
       extra = []
@@ -676,7 +716,27 @@ def cmd_cluster(args: argparse.Namespace) -> dict:
         breaker_reset_s=args.breaker_reset_s,
         render_timeout_s=args.render_timeout_s,
         health_timeout_s=args.health_timeout_s,
+        retry_budget_ratio=args.retry_budget,
+        load_aware=args.load_aware,
         metrics_ttl_s=args.metrics_ttl_ms / 1e3, tracer=tracer)
+    if args.supervise or args.rolling_restart:
+      # Lifecycle decisions share the router's event log so one
+      # /debug/events stream tells the whole fleet story. The monitor
+      # loop runs in BOTH modes: a rolling step whose respawn fails
+      # defers recovery to the monitor, so --rolling-restart without it
+      # would strand that backend down for the rest of the run.
+      supervisor = FleetSupervisor(
+          pool, router=router, events=router.events,
+          probe_s=args.probe_s, wedge_after=args.wedge_after,
+          restart_budget=args.restart_budget,
+          budget_window_s=args.restart_window_s, log=_log)
+      supervisor.start()
+      _log(f"cluster: supervisor on (probe every {args.probe_s:g}s, "
+           f"budget {args.restart_budget} restarts / "
+           f"{args.restart_window_s:g}s, wedge after {args.wedge_after} "
+           "failed probes"
+           + ("" if args.supervise else "; implied by --rolling-restart")
+           + ")")
     httpd = make_router_http_server(router, host=args.host, port=args.port)
     port = httpd.server_address[1]
     if args.port_file:
@@ -709,9 +769,16 @@ def cmd_cluster(args: argparse.Namespace) -> dict:
          + (f"; {placement_note}" if placement_note else ""))
 
     t0 = time.time()
+    rolling_report = None
     try:
+      if args.rolling_restart:
+        # A one-shot drill under whatever traffic the router is taking:
+        # each backend drains, respawns, and rejoins before the next.
+        rolling_report = supervisor.rolling_restart()
       stop_event.wait(args.duration if args.duration > 0 else None)
     finally:
+      if supervisor is not None:
+        supervisor.stop()
       httpd.shutdown()
       router.close()
       for sig, handler in previous_handlers.items():
@@ -727,9 +794,19 @@ def cmd_cluster(args: argparse.Namespace) -> dict:
         "replication": args.replication,
         "seconds": round(time.time() - t0, 1),
         "router": snap,
+        **({"supervisor": supervisor.snapshot()}
+           if supervisor is not None else {}),
+        **({"rolling_restart": rolling_report}
+           if rolling_report is not None else {}),
         **({"traces": tracer.finished} if tracer is not None else {}),
     }
   finally:
+    # The monitor thread must be dead BEFORE the pool closes: a tick
+    # racing pool.close() could respawn a child after close() already
+    # swept it, orphaning a serve process past CLI exit. stop() is
+    # idempotent, so the normal path's earlier stop is harmless here.
+    if supervisor is not None:
+      supervisor.stop()
     if pool is not None:
       pool.close()
       _log("cluster: local backend pool closed")
@@ -941,6 +1018,13 @@ def build_parser() -> argparse.ArgumentParser:
                       "transitions, scene swaps, SLO alert edges) to "
                       "this file; /debug/events serves the bounded ring "
                       "either way")
+  s.add_argument("--alert-hook", default="",
+                 help="run this command on every SLO alert fire/clear "
+                      "edge with the slo_alert event appended to its "
+                      "argv as one JSON element (pager/webhook "
+                      "delivery); runs off the request path, failures "
+                      "are counted and reported, never fatal; requires "
+                      "SLO tracking (the --slo default)")
   s.add_argument("--slo", action=argparse.BooleanOptionalAction,
                  default=True,
                  help="track availability + latency SLOs with "
@@ -1016,6 +1100,41 @@ def build_parser() -> argparse.ArgumentParser:
   c.add_argument("--metrics-ttl-ms", type=float, default=250.0,
                  help="memoize the aggregated /metrics exposition this "
                       "long (one pool fan-out per window)")
+  c.add_argument("--supervise", action="store_true",
+                 help="run the self-healing supervisor over the spawned "
+                      "pool: /healthz probes, crashed/wedged backends "
+                      "respawned on their old port with exponential "
+                      "backoff, crash-loopers quarantined (requires "
+                      "--backends)")
+  c.add_argument("--probe-s", type=float, default=1.0,
+                 help="supervisor health-probe period")
+  c.add_argument("--wedge-after", type=int, default=3,
+                 help="consecutive failed probes (timeout or unhealthy) "
+                      "that declare a live backend wedged and replace it")
+  c.add_argument("--restart-budget", type=int, default=3,
+                 help="per-backend restarts allowed inside "
+                      "--restart-window-s before the backend is "
+                      "quarantined instead of respawned (crash-loop "
+                      "containment)")
+  c.add_argument("--restart-window-s", type=float, default=60.0,
+                 help="the restart-budget window")
+  c.add_argument("--rolling-restart", action="store_true",
+                 help="perform one rolling restart of the pool under "
+                      "live traffic (eject -> drain -> SIGTERM -> "
+                      "respawn -> readmit, one backend at a time), then "
+                      "keep serving; implies the --supervise monitor "
+                      "loop (a failed step's backend must be retried); "
+                      "requires --backends")
+  c.add_argument("--retry-budget", type=float, default=0.1,
+                 help="failover tokens earned per routed request "
+                      "(token-bucket retry budget: a fleet brownout "
+                      "degrades to fast 503s instead of replica-count "
+                      "retry amplification); <= 0 disables")
+  c.add_argument("--load-aware", action=argparse.BooleanOptionalAction,
+                 default=True,
+                 help="demote a scene's primary behind a replica when "
+                      "fresh /stats queue depths show it markedly "
+                      "deeper (replicas render bit-identical pixels)")
   c.add_argument("--trace", action=argparse.BooleanOptionalAction,
                  default=True,
                  help="router-side request traces (W3C trace ids shared "

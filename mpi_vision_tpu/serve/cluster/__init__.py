@@ -5,9 +5,12 @@ process"): ``ring`` places scenes on backends by consistent hashing with
 configurable replication, ``router`` fronts the pool with health-aware
 forwarding, per-backend circuit breakers, failover, outbound W3C
 ``traceparent`` propagation, and aggregated ``/stats`` + ``/metrics`` +
-``/healthz``; ``pool`` supervises local child backends so the whole tier
-is testable and benchable on one CPU box (``python -m mpi_vision_tpu
-cluster``; ``bench/serve_load.py --cluster``). Live checkpoint reload
+``/healthz``; ``pool`` spawns local child backends so the whole tier is
+testable and benchable on one CPU box (``python -m mpi_vision_tpu
+cluster``; ``bench/serve_load.py --cluster``); ``supervisor`` is the
+self-healing layer over both — health probing, crash/wedge detection,
+budgeted restarts with crash-loop quarantine, and rolling restarts under
+live traffic. Live checkpoint reload
 rides the backends themselves (``serve --ckpt --reload-ckpt-s N``,
 ``ckpt.watch.CheckpointWatcher``) — the router needs no coordination to
 benefit: scenes swap in place under the same ids.
@@ -19,20 +22,24 @@ from mpi_vision_tpu.serve.cluster.router import (
     AllReplicasOpenError,
     HttpTransport,
     ReplicasExhaustedError,
+    RetryBudgetExhaustedError,
     Router,
     RouterMetrics,
     make_router_http_server,
     make_traceparent,
     new_trace_id_32,
 )
+from mpi_vision_tpu.serve.cluster.supervisor import FleetSupervisor
 
 __all__ = [
     "AllReplicasOpenError",
     "BackendPool",
     "BackendSpawnError",
+    "FleetSupervisor",
     "HashRing",
     "HttpTransport",
     "ReplicasExhaustedError",
+    "RetryBudgetExhaustedError",
     "Router",
     "RouterMetrics",
     "make_router_http_server",

@@ -2,10 +2,12 @@
 
 ``BackendPool`` runs N real ``python -m mpi_vision_tpu serve`` child
 processes on localhost ephemeral ports — the harness that makes the
-cluster tier testable and benchable on one CPU box. It is deliberately
-a *test/bench* supervisor, not a production one (production runs one
-backend per host under k8s/systemd; the router neither knows nor cares
-who spawned its backends):
+cluster tier testable and benchable on one CPU box. It owns the process
+*primitives* only (spawn, health-gate, kill, respawn-on-same-port); the
+self-healing *policy* — who gets restarted, when, and when to give up —
+lives in ``supervisor.FleetSupervisor``, which drives these primitives
+(production runs one backend per host under k8s/systemd; the router
+neither knows nor cares who spawned its backends):
 
   * each backend writes its bound port to a ``--port-file`` (parsing a
     child's stderr for the listening line is a race, a file rename is
@@ -193,9 +195,14 @@ class BackendPool:
   # -- chaos --------------------------------------------------------------
 
   def kill(self, backend_id: str, sig: int = signal.SIGKILL) -> None:
-    """Deliver ``sig`` (default SIGKILL: a host loss, no drain) and wait
-    for the process to die."""
+    """Deliver ``sig`` (default SIGKILL: a host loss, no drain;
+    SIGTERM: the serve CLI drains in-flight requests first) and wait
+    for the process to die. Idempotent on an already-dead backend — a
+    crash-loop drill's killer thread may race the supervisor's respawn,
+    and double-killing a corpse must be a no-op, not an error."""
     proc = self._procs[backend_id]
+    if proc.popen.poll() is not None:
+      return  # already dead
     proc.popen.send_signal(sig)
     proc.popen.wait(30)
     self._log(f"pool: {backend_id} killed with signal {sig}")
@@ -204,20 +211,45 @@ class BackendPool:
     proc = self._procs.get(backend_id)
     return proc is not None and proc.popen.poll() is None
 
+  def pid(self, backend_id: str) -> int | None:
+    """The backend's current OS pid (None for unknown ids) — how a test
+    proves a rolling restart really replaced every process."""
+    proc = self._procs.get(backend_id)
+    return proc.popen.pid if proc is not None else None
+
   def restart(self, backend_id: str) -> str:
     """Respawn a dead backend on its OLD port (same address, so the
     router's existing breaker re-closes via its half-open probe rather
-    than needing re-registration). Returns the address."""
+    than needing re-registration). Returns the address.
+
+    Refuses on a closed pool — a supervisor tick blocked inside a slow
+    respawn can outlive ``FleetSupervisor.stop()``'s join timeout, and
+    without this guard it would register a fresh child into a pool
+    ``close()`` already swept, orphaning a serve process past exit.
+    """
+    if self._closed:
+      raise RuntimeError(f"pool is closed; not restarting {backend_id}")
     old = self._procs[backend_id]
     if old.popen.poll() is None:
       raise RuntimeError(f"{backend_id} is still running; kill it first")
     _, popen, port_file, log_path = self._spawn(backend_id, port=old.port)
-    port = self._await_port(backend_id, popen, port_file)
-    proc = _Proc(backend_id, popen, port, log_path)
+    # Register BEFORE gating (like start()): close() must always see the
+    # child. If close() raced the spawn itself, reap the child here —
+    # close()'s sweep may have run before the registration landed.
+    proc = _Proc(backend_id, popen, old.port, log_path)
     self._procs[backend_id] = proc
+    if self._closed:
+      popen.terminate()
+      try:
+        popen.wait(10)
+      except subprocess.TimeoutExpired:
+        popen.kill()
+        popen.wait(10)
+      raise RuntimeError(f"pool closed during restart of {backend_id}")
+    proc.port = self._await_port(backend_id, popen, port_file)
     self._await_healthy(proc)
-    self._log(f"pool: {backend_id} resurrected on {self.host}:{port}")
-    return f"{self.host}:{port}"
+    self._log(f"pool: {backend_id} resurrected on {self.host}:{proc.port}")
+    return f"{self.host}:{proc.port}"
 
   # -- teardown / forensics ----------------------------------------------
 

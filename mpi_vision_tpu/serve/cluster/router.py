@@ -41,12 +41,13 @@ import urllib.request
 import uuid
 
 import functools
+from concurrent.futures import ThreadPoolExecutor
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from mpi_vision_tpu.obs import prom
 from mpi_vision_tpu.obs.events import EventLog
 from mpi_vision_tpu.obs.trace import NULL_TRACE, NULL_TRACER, Tracer
-from mpi_vision_tpu.serve.resilience import CircuitBreaker
+from mpi_vision_tpu.serve.resilience import CircuitBreaker, RetryBudget
 from mpi_vision_tpu.serve.cluster.ring import HashRing
 from mpi_vision_tpu.serve.server import _MAX_BODY_BYTES, _inbound_trace_id
 
@@ -84,6 +85,21 @@ class ReplicasExhaustedError(RuntimeError):
     self.attempts = attempts
     super().__init__(
         f"all replicas failed for scene {scene_id!r}: " + "; ".join(attempts))
+
+
+class RetryBudgetExhaustedError(RuntimeError):
+  """The fleet-wide failover budget refused further attempts (HTTP 503).
+
+  Fired mid-brownout: the primary attempt failed and the token bucket
+  says the fleet is already retrying as much as it can afford — fail
+  fast instead of amplifying offered load by another replica walk.
+  """
+
+  def __init__(self, scene_id: str, attempts: list[str]):
+    self.attempts = attempts
+    super().__init__(
+        f"retry budget exhausted for scene {scene_id!r} after: "
+        + "; ".join(attempts))
 
 
 class HttpTransport:
@@ -134,6 +150,10 @@ class RouterMetrics:
     self.breaker_fastfails = 0
     self.breaker_opens = 0
     self.bad_requests = 0
+    self.restarts: dict[str, int] = {}
+    self.quarantines: dict[str, int] = {}
+    self.load_reroutes = 0
+    self.retry_budget_exhausted = 0
 
   def record_request(self) -> None:
     with self._lock:
@@ -167,6 +187,26 @@ class RouterMetrics:
     with self._lock:
       self.bad_requests += 1
 
+  def record_restart(self, backend_id: str) -> None:
+    """A supervisor respawned this backend — crash/wedge recovery, a
+    rolling-restart step, or an operator readmit (one counter for every
+    respawn; /debug/events says which kind each one was)."""
+    with self._lock:
+      self.restarts[backend_id] = self.restarts.get(backend_id, 0) + 1
+
+  def record_quarantine(self, backend_id: str) -> None:
+    """A supervisor gave up restarting this backend (crash loop)."""
+    with self._lock:
+      self.quarantines[backend_id] = self.quarantines.get(backend_id, 0) + 1
+
+  def record_load_reroute(self) -> None:
+    with self._lock:
+      self.load_reroutes += 1
+
+  def record_retry_budget_exhausted(self) -> None:
+    with self._lock:
+      self.retry_budget_exhausted += 1
+
   def snapshot(self) -> dict:
     with self._lock:
       return {
@@ -179,26 +219,44 @@ class RouterMetrics:
           "breaker_fastfails": self.breaker_fastfails,
           "breaker_opens": self.breaker_opens,
           "bad_requests": self.bad_requests,
+          "restarts": dict(sorted(self.restarts.items())),
+          "quarantines": dict(sorted(self.quarantines.items())),
+          "load_reroutes": self.load_reroutes,
+          "retry_budget_exhausted": self.retry_budget_exhausted,
       }
 
 
 class _Backend:
-  """One pool member: address + its own breaker + contact bookkeeping."""
+  """One pool member: address + its own breaker + contact bookkeeping.
+
+  ``ejected`` is the administrative down-flag (supervisor quarantine or a
+  planned rolling-restart step): the forward walk skips the backend
+  without spending an attempt on it, which is what makes a PLANNED
+  restart invisible to clients — no failed probe, no breaker transition,
+  traffic just rides the replica list. The breaker handles UNPLANNED
+  badness; eject handles known badness.
+  """
 
   def __init__(self, backend_id: str, address: str, breaker: CircuitBreaker):
     self.backend_id = backend_id
     self.address = address  # host:port
     self.breaker = breaker
+    self.ejected = False
+    self.eject_reason: str | None = None
 
   @property
   def base_url(self) -> str:
     return f"http://{self.address}"
 
   def snapshot(self) -> dict:
-    return {
+    out = {
         "address": self.address,
         "breaker": self.breaker.snapshot(),
+        "ejected": self.ejected,
     }
+    if self.ejected and self.eject_reason:
+      out["eject_reason"] = self.eject_reason
+    return out
 
 
 class Router:
@@ -223,8 +281,20 @@ class Router:
       ids so the SAME id appears in the backend's recorded trace.
     transport: injectable request transport (tests); default urllib.
     events: lifecycle event log (``obs.events.EventLog``; a private one
-      is made if omitted) — per-backend breaker transitions and
-      failovers, served at ``/debug/events`` next to the backends'.
+      is made if omitted) — per-backend breaker transitions, failovers,
+      eject/readmit edges, served at ``/debug/events`` next to the
+      backends'.
+    retry_budget_ratio: failover tokens earned per routed request
+      (``resilience.RetryBudget``); a brownout that drains the bucket
+      degrades to fast 503s instead of R-fold retry amplification.
+      <= 0 disables the budget (unbounded failover, the PR-5 behavior).
+    load_aware: prefer a measurably less-loaded replica over the
+      primary. Placement order still wins by default (cache locality);
+      the primary is only demoted when fresh ``/stats`` queue depths
+      (``note_backend_load`` / ``refresh_load``, stale after
+      ``load_ttl_s``) show it at least ``load_threshold`` requests
+      deeper than its best replica — safe because replicas render
+      bit-identical pixels.
     clock: one injectable monotonic base for breakers, metrics, and the
       exposition cache.
   """
@@ -234,7 +304,11 @@ class Router:
                render_timeout_s: float = 120.0,
                health_timeout_s: float = 2.0, metrics_ttl_s: float = 0.25,
                tracer: Tracer | None = None, transport=None,
-               events: EventLog | None = None, clock=time.monotonic):
+               events: EventLog | None = None,
+               retry_budget_ratio: float = 0.1,
+               retry_budget_initial: float = 10.0,
+               load_aware: bool = True, load_ttl_s: float = 5.0,
+               load_threshold: int = 4, clock=time.monotonic):
     self.replication = int(replication)
     self.breaker_threshold = int(breaker_threshold)
     self.breaker_reset_s = float(breaker_reset_s)
@@ -243,10 +317,20 @@ class Router:
     self.tracer = tracer if tracer is not None else NULL_TRACER
     self.transport = transport if transport is not None else HttpTransport()
     self.events = events if events is not None else EventLog()
+    self.retry_budget = (
+        RetryBudget(ratio=retry_budget_ratio,
+                    initial=retry_budget_initial,
+                    cap=max(10.0 * retry_budget_initial, 100.0))
+        if retry_budget_ratio > 0 else None)
+    self.load_aware = bool(load_aware)
+    self.load_ttl_s = float(load_ttl_s)
+    self.load_threshold = int(load_threshold)
     self._clock = clock
     self.metrics = RouterMetrics(clock=clock)
     self._lock = threading.Lock()
     self._backends: dict[str, _Backend] = {}
+    self._fanout_pool: ThreadPoolExecutor | None = None  # lazy, reused
+    self._load: dict[str, tuple[float, float]] = {}  # bid -> (depth, at)
     self._ring = HashRing(vnodes=vnodes, replication=replication)
     self._metrics_cache = prom.ExpositionCache(
         self._render_metrics_text, ttl_s=metrics_ttl_s, clock=clock)
@@ -281,9 +365,128 @@ class Router:
       self._backends.pop(str(backend_id), None)
       self._ring.remove(str(backend_id))
 
+  def eject(self, backend_id: str, reason: str = "") -> None:
+    """Administratively stop routing to a backend (supervisor hook).
+
+    Unlike ``remove_backend`` the ring is untouched — placement (and
+    with it every OTHER scene's cache locality) is stable, the backend's
+    slots in each replica list are simply skipped without spending an
+    attempt. The supervisor ejects before a planned kill (rolling
+    restart) and on quarantine; ``readmit`` reverses it. Re-ejecting
+    with a NEW reason updates it and logs the edge (a quarantine must
+    not be masked by the transient crash reason that preceded it);
+    re-ejecting with the same reason is a silent no-op.
+    """
+    with self._lock:
+      backend = self._backends.get(str(backend_id))
+      if backend is None:
+        return
+      unchanged = (backend.ejected
+                   and backend.eject_reason == (reason or None))
+      backend.ejected = True
+      backend.eject_reason = reason or None
+    if unchanged:
+      return
+    self.events.emit("backend_eject", backend=str(backend_id),
+                     reason=reason)
+
+  def readmit(self, backend_id: str) -> None:
+    """Resume routing to an ejected backend (supervisor hook).
+
+    The breaker is left alone on purpose: if it opened from unplanned
+    failures, the standard half-open probe re-closes it — readmit only
+    says "the backend may be probed again", not "the backend is good".
+    """
+    with self._lock:
+      backend = self._backends.get(str(backend_id))
+      if backend is None or not backend.ejected:
+        return
+      backend.ejected = False
+      backend.eject_reason = None
+    self.events.emit("backend_readmit", backend=str(backend_id))
+
+  def ejected(self) -> list[str]:
+    with self._lock:
+      return sorted(b for b, be in self._backends.items() if be.ejected)
+
+  def breaker_state(self, backend_id: str) -> str | None:
+    """The backend's breaker state (None for unknown ids) — what a
+    supervisor polls to confirm a restarted backend re-closed."""
+    with self._lock:
+      backend = self._backends.get(str(backend_id))
+      return backend.breaker.state if backend is not None else None
+
   def backend_ids(self) -> list[str]:
     with self._lock:
       return sorted(self._backends)
+
+  # -- load awareness -----------------------------------------------------
+
+  def note_backend_load(self, backend_id: str, queue_depth: float) -> None:
+    """Record one backend's scheduler queue depth (stamped now; stale
+    after ``load_ttl_s``). Fed by ``stats()``/``refresh_load()``."""
+    with self._lock:
+      if str(backend_id) in self._backends:
+        self._load[str(backend_id)] = (float(queue_depth), self._clock())
+
+  def _feed_load(self, per_backend: dict) -> dict[str, float]:
+    """Record every ``queue_depth`` found in a ``/stats`` fan-out's
+    payloads (non-dicts and error entries contribute nothing)."""
+    out = {}
+    for backend_id, payload in per_backend.items():
+      depth = payload.get("queue_depth") if isinstance(payload, dict) \
+          else None
+      if isinstance(depth, (int, float)):
+        self.note_backend_load(backend_id, depth)
+        out[backend_id] = float(depth)
+    return out
+
+  def refresh_load(self) -> dict[str, float]:
+    """One concurrent ``/stats`` fan-out -> queue depths recorded for
+    load-aware replica choice (the supervisor's monitor loop calls this;
+    any ``stats()`` scrape feeds the same table for free)."""
+    return self._feed_load(
+        self._fan_out_get("/stats", self.health_timeout_s))
+
+  def _load_ordered(self, replicas: list[_Backend]) -> list[_Backend]:
+    """Demote an overloaded primary behind its least-loaded replica.
+
+    Placement order is the default (stable primaries = cache locality);
+    the swap only happens on FRESH load data showing the primary at
+    least ``load_threshold`` requests deeper than the best replica —
+    bit-identical replicas make serving from either one correct.
+    """
+    if not self.load_aware or len(replicas) < 2:
+      return replicas
+    now = self._clock()
+    depths = {}
+    with self._lock:
+      for backend in replicas:
+        entry = self._load.get(backend.backend_id)
+        if entry is not None and now - entry[1] <= self.load_ttl_s:
+          depths[backend.backend_id] = entry[0]
+    primary = replicas[0]
+    if primary.backend_id not in depths:
+      return replicas
+    if primary.ejected or not primary.breaker.would_allow():
+      # The walk skips this primary regardless; "demoting" it would
+      # only inflate the reroute counter during its outage window.
+      return replicas
+    # Only replicas the walk could actually serve from are demotion
+    # candidates: fronting an ejected or breaker-refusing replica on
+    # its pre-outage depth would count a reroute that never happens —
+    # during exactly the supervision windows an operator watches it.
+    candidates = [b for b in replicas[1:]
+                  if b.backend_id in depths and not b.ejected
+                  and b.breaker.would_allow()]
+    if not candidates:
+      return replicas
+    best = min(candidates, key=lambda b: depths[b.backend_id])
+    if depths[primary.backend_id] - depths[best.backend_id] \
+        < self.load_threshold:
+      return replicas
+    self.metrics.record_load_reroute()
+    return [best] + [b for b in replicas if b is not best]
 
   def placement(self, scene_id: str) -> list[str]:
     """The scene's replica set (backend ids, primary first) — a pure
@@ -303,23 +506,33 @@ class Router:
                      trace=NULL_TRACE) -> tuple[int, dict, bytes]:
     """Route one ``/render`` body to the scene's replica set.
 
-    Walks the placement list primary-first, skipping backends whose
-    breaker refuses (an ``allow_primary()`` True from a non-closed
-    breaker IS the half-open probe; its outcome re-closes or re-opens
-    that backend's circuit). Transport failures, 5xx statuses, and
-    malformed response bodies count against the backend's breaker and
-    fail over to the next replica; a backend that *answers* with 4xx is
-    healthy — its response is returned as-is and its breaker resets.
+    Walks the placement list primary-first (load-aware demotion may
+    front a measurably idler replica), skipping ejected backends
+    (administratively down: quarantined or mid-rolling-restart) and
+    backends whose breaker refuses (an ``allow_primary()`` True from a
+    non-closed breaker IS the half-open probe; its outcome re-closes or
+    re-opens that backend's circuit). Transport failures, 5xx statuses,
+    and malformed response bodies count against the backend's breaker
+    and fail over to the next replica — each failover past the first
+    attempt withdraws from the fleet-wide ``RetryBudget``; an empty
+    bucket stops the walk (fast 503, no amplification). A backend that
+    *answers* with 4xx is healthy — its response is returned as-is and
+    its breaker resets.
 
     Returns ``(status, headers, body)`` of the winning response.
     Raises ``AllReplicasOpenError`` (-> 503 + Retry-After) when every
-    breaker refused, ``ReplicasExhaustedError`` (-> 502) when every
-    attempt failed, ``KeyError`` when the ring is empty.
+    replica was ejected or breaker-refused, ``RetryBudgetExhaustedError``
+    (-> 503) when the failover budget ran dry mid-walk,
+    ``ReplicasExhaustedError`` (-> 502) when every attempt failed,
+    ``KeyError`` when the ring is empty.
     """
     self.metrics.record_request()
+    if self.retry_budget is not None:
+      self.retry_budget.deposit()
     replicas = self._replicas(scene_id)
     if not replicas:
       raise KeyError("no backends registered")
+    replicas = self._load_ordered(replicas)
     trace_id = trace_id or new_trace_id_32()
     headers = {
         "Content-Type": "application/json",
@@ -331,10 +544,22 @@ class Router:
     retry_afters: list[float] = []
     tried_any = False
     for backend in replicas:
+      if backend.ejected:
+        retry_afters.append(1.0)  # supervised restarts are seconds-scale
+        continue
       if not backend.breaker.allow_primary():
         retry_afters.append(backend.breaker.retry_after_s())
         continue
       if tried_any:
+        if (self.retry_budget is not None
+            and not self.retry_budget.try_withdraw()):
+          # allow_primary() above may have claimed this backend's
+          # half-open probe slot; a budget refusal says nothing about
+          # the device, so free the slot or the breaker wedges in
+          # HALF_OPEN forever (no other caller feeds it).
+          backend.breaker.release_probe()
+          self.metrics.record_retry_budget_exhausted()
+          raise RetryBudgetExhaustedError(scene_id, attempts)
         self.metrics.record_failover()
         self.events.emit("failover", scene_id=str(scene_id),
                          to_backend=backend.backend_id)
@@ -446,22 +671,73 @@ class Router:
 
   # -- aggregated observability ------------------------------------------
 
-  def _fan_out_get(self, path: str, timeout: float) -> dict[str, dict]:
-    """GET ``path`` from every backend -> ``{backend_id: result}`` where
-    result is the parsed JSON body or ``{"error": ...}``."""
+  def _fan_out_each(self, fn) -> dict[str, object]:
+    """Run ``fn(backend)`` against every backend CONCURRENTLY.
+
+    One slow or timing-out backend must cost its own per-backend
+    timeout, not stall the whole fleet scrape behind it (ROADMAP cluster
+    follow-on: a serial walk made an aggregated ``/healthz`` take
+    ``backends x health_timeout_s`` during a partial outage). Results
+    keep deterministic backend order; a raising ``fn`` yields the
+    exception object as that backend's value.
+    """
     with self._lock:
       backends = list(self._backends.values())
-    out: dict[str, dict] = {}
-    for backend in backends:
+    if not backends:
+      return {}
+    if len(backends) == 1:  # no pool thread for a pool of one
+      backend = backends[0]
       try:
-        _, _, body = self.transport.request(
-            "GET", backend.base_url + path, timeout=timeout)
-        payload = json.loads(body)
-        if not isinstance(payload, dict):
-          raise ValueError(f"non-object JSON ({type(payload).__name__})")
-        out[backend.backend_id] = payload
-      except (ConnectionError, ValueError, UnicodeDecodeError) as e:
-        out[backend.backend_id] = {"error": str(e) or repr(e)}
+        return {backend.backend_id: fn(backend)}
+      except Exception as e:  # noqa: BLE001 - caller classifies
+        return {backend.backend_id: e}
+
+    def safe(backend):
+      try:
+        return fn(backend)
+      except Exception as e:  # noqa: BLE001 - caller classifies
+        return e
+
+    # One long-lived pool, not an executor per scrape: a monitoring
+    # stack polling /healthz + /stats + /metrics at a few Hz (plus the
+    # supervisor's load refresh) must not churn thread create/join for
+    # identical work on every call. A scrape racing close() must not
+    # resurrect a pool on a closed router (leaked threads) or 500 on
+    # the shut-down executor — it degrades to the serial walk instead.
+    with self._lock:
+      if self._fanout_pool is None and not self._closed:
+        self._fanout_pool = ThreadPoolExecutor(
+            max_workers=16, thread_name_prefix="mpi-router-fanout")
+      pool = self._fanout_pool
+    if pool is not None:
+      try:
+        results = list(pool.map(safe, backends))
+        return {b.backend_id: r for b, r in zip(backends, results)}
+      except RuntimeError:  # executor shut down between capture and map
+        pass
+    return {b.backend_id: safe(b) for b in backends}
+
+  def _fan_out_get(self, path: str, timeout: float) -> dict[str, dict]:
+    """GET ``path`` from every backend (concurrently) ->
+    ``{backend_id: result}`` where result is the parsed JSON body or
+    ``{"error": ...}``."""
+    def one(backend):
+      _, _, body = self.transport.request(
+          "GET", backend.base_url + path, timeout=timeout)
+      payload = json.loads(body)
+      if not isinstance(payload, dict):
+        raise ValueError(f"non-object JSON ({type(payload).__name__})")
+      return payload
+
+    out: dict[str, dict] = {}
+    for backend_id, result in self._fan_out_each(one).items():
+      if isinstance(result, dict):
+        out[backend_id] = result
+      elif isinstance(result, (ConnectionError, ValueError,
+                               UnicodeDecodeError)):
+        out[backend_id] = {"error": str(result) or repr(result)}
+      else:
+        raise result  # a router bug, not a backend failure
     return out
 
   def healthz(self) -> dict:
@@ -478,6 +754,7 @@ class Router:
     with self._lock:
       breakers = {b: be.breaker.snapshot()
                   for b, be in self._backends.items()}
+      ejected = sorted(b for b, be in self._backends.items() if be.ejected)
     statuses = {b: h.get("status", "unreachable")
                 for b, h in per_backend.items()}
     reachable = [b for b, h in per_backend.items() if "error" not in h]
@@ -509,6 +786,7 @@ class Router:
         "backends_reachable": len(reachable),
         "replication": self.replication,
         "breakers": {b: breakers[b] for b in sorted(breakers)},
+        "ejected": ejected,
     }
     if reason is not None:
       out["reason"] = reason
@@ -517,16 +795,21 @@ class Router:
   def stats(self) -> dict:
     """Aggregated ``/stats``: the router's own counters + every
     backend's snapshot (or its fan-out error), plus the fleet-level SLO
-    summary distilled from the backends' ``slo`` blocks."""
+    summary distilled from the backends' ``slo`` blocks. The fan-out's
+    queue depths feed the load-aware replica table for free."""
     per_backend = self._fan_out_get("/stats", self.health_timeout_s)
+    self._feed_load(per_backend)
     with self._lock:
       backends = {b: be.snapshot() for b, be in self._backends.items()}
-    return {
+    out = {
         "router": self.metrics.snapshot(),
         "backend_info": {b: backends[b] for b in sorted(backends)},
         "backends": {b: per_backend[b] for b in sorted(per_backend)},
         "slo": self._slo_summary(per_backend),
     }
+    if self.retry_budget is not None:
+      out["retry_budget"] = self.retry_budget.snapshot()
+    return out
 
   @staticmethod
   def _slo_summary(per_backend_stats: dict) -> dict:
@@ -635,25 +918,53 @@ class Router:
     reg.counter(p + "breaker_opens_total",
                 "Per-backend breaker CLOSED->OPEN transitions.",
                 snap["breaker_opens"])
+    restarts = reg.counter(
+        p + "restarts_total",
+        "Supervisor backend respawns (crash/wedge recovery, "
+        "rolling-restart steps, readmits).")
+    for backend_id in sorted(snap["restarts"]):
+      restarts.sample(snap["restarts"][backend_id], {"backend": backend_id})
+    quarantines = reg.counter(
+        p + "quarantines_total",
+        "Backends quarantined after exhausting their restart budget.")
+    for backend_id in sorted(snap["quarantines"]):
+      quarantines.sample(snap["quarantines"][backend_id],
+                         {"backend": backend_id})
+    reg.counter(p + "load_reroutes_total",
+                "Requests routed to a less-loaded replica over the "
+                "primary.", snap["load_reroutes"])
+    reg.counter(p + "retry_budget_exhausted_total",
+                "Failover walks stopped by an empty retry budget (503).",
+                snap["retry_budget_exhausted"])
+    if self.retry_budget is not None:
+      reg.gauge(p + "retry_budget_tokens",
+                "Failover tokens currently in the retry budget.",
+                self.retry_budget.snapshot()["tokens"])
     up = reg.gauge(p + "backend_up",
-                   "1 while the backend's breaker is closed.")
+                   "1 while the backend's breaker is closed and it is "
+                   "not ejected.")
     for backend in sorted(backends, key=lambda b: b.backend_id):
-      up.sample(1 if backend.breaker.state == CircuitBreaker.CLOSED else 0,
+      up.sample(1 if (backend.breaker.state == CircuitBreaker.CLOSED
+                      and not backend.ejected) else 0,
                 {"backend": backend.backend_id})
     return reg
 
   def _render_metrics_text(self) -> str:
+    def one(backend):
+      status, _, body = self.transport.request(
+          "GET", backend.base_url + "/metrics",
+          timeout=self.health_timeout_s)
+      return body.decode("utf-8", "replace") if status == 200 else None
+
+    scraped = self._fan_out_each(one)
     texts = []
-    for backend in sorted(self._snapshot_backends(),
-                          key=lambda b: b.backend_id):
-      try:
-        status, _, body = self.transport.request(
-            "GET", backend.base_url + "/metrics",
-            timeout=self.health_timeout_s)
-        if status == 200:
-          texts.append(body.decode("utf-8", "replace"))
-      except ConnectionError:
-        continue  # a dead backend contributes nothing (backend_up says so)
+    for backend_id in sorted(scraped):
+      result = scraped[backend_id]
+      if isinstance(result, str):
+        texts.append(result)
+      elif isinstance(result, Exception) and not isinstance(
+          result, ConnectionError):
+        raise result  # a dead backend contributes nothing; a bug raises
     from mpi_vision_tpu.obs import slo as slo_mod
 
     # Ratio/target SLO gauges are per-backend statements — summing them
@@ -663,10 +974,6 @@ class Router:
         texts, extra=self._cluster_registry(),
         drop=slo_mod.NON_ADDITIVE_FAMILIES)
 
-  def _snapshot_backends(self) -> list[_Backend]:
-    with self._lock:
-      return list(self._backends.values())
-
   def metrics_text(self) -> str:
     """Aggregated ``/metrics``: pool-summed ``mpi_serve_*`` families plus
     the router's ``mpi_cluster_*`` families, memoized ``metrics_ttl_s``."""
@@ -674,6 +981,10 @@ class Router:
 
   def close(self) -> None:
     self._closed = True
+    with self._lock:
+      pool, self._fanout_pool = self._fanout_pool, None
+    if pool is not None:
+      pool.shutdown(wait=False)
 
   def __enter__(self):
     return self
@@ -796,6 +1107,14 @@ class _RouterHandler(BaseHTTPRequestHandler):
       self._send_json(
           {"error": str(e), "retry_after_s": e.retry_after_s}, status=503,
           extra_headers={"Retry-After": str(retry_after), **tid_hdr})
+      return
+    except RetryBudgetExhaustedError as e:
+      # A brownout drained the failover budget: fast 503, not a 502 —
+      # the service is overloaded, not gone; clients should back off.
+      tr.finish(error=repr(e))
+      self._send_json({"error": str(e), "attempts": e.attempts},
+                      status=503,
+                      extra_headers={"Retry-After": "1", **tid_hdr})
       return
     except ReplicasExhaustedError as e:
       tr.finish(error=repr(e))

@@ -122,6 +122,134 @@ class RetryPolicy:
     return max(base * (1.0 + self.jitter * rng.uniform(-1.0, 1.0)), 0.0)
 
 
+class RestartBudget:
+  """Sliding-window restart allowance — the crash-loop containment guard.
+
+  A supervisor that restarts a dead backend unconditionally turns a
+  crash-looping binary into an infinite flap: each respawn passes its
+  health gate, crashes, and is respawned again, burning CPU and paging
+  nobody. This budget bounds the loop: at most ``max_restarts``
+  ``try_spend()`` calls may succeed inside any trailing ``window_s``;
+  once exceeded, ``try_spend()`` returns False and the caller quarantines
+  the backend instead of respawning it. A backend that runs longer than
+  the window between crashes earns its budget back (timestamps age out),
+  so an occasional crash never accumulates into a quarantine.
+
+  Thread-safe; the clock is injectable (the serve/-wide rule).
+  """
+
+  def __init__(self, max_restarts: int = 3, window_s: float = 60.0,
+               clock=time.monotonic):
+    if max_restarts < 1:
+      raise ValueError(f"max_restarts must be >= 1, got {max_restarts}")
+    if window_s <= 0:
+      raise ValueError(f"window_s must be > 0, got {window_s}")
+    self.max_restarts = int(max_restarts)
+    self.window_s = float(window_s)
+    self._clock = clock
+    self._lock = threading.Lock()
+    self._spends: list[float] = []
+    self.spent = 0
+    self.refused = 0
+
+  def _prune_locked(self, now: float) -> None:
+    floor = now - self.window_s
+    while self._spends and self._spends[0] <= floor:
+      self._spends.pop(0)
+
+  def try_spend(self) -> bool:
+    """Claim one restart; False means the budget is exhausted."""
+    with self._lock:
+      now = self._clock()
+      self._prune_locked(now)
+      if len(self._spends) >= self.max_restarts:
+        self.refused += 1
+        return False
+      self._spends.append(now)
+      self.spent += 1
+      return True
+
+  def remaining(self) -> int:
+    with self._lock:
+      self._prune_locked(self._clock())
+      return self.max_restarts - len(self._spends)
+
+  def reset(self) -> None:
+    """Forget the window (operator readmit of a quarantined backend)."""
+    with self._lock:
+      self._spends.clear()
+
+  def snapshot(self) -> dict:
+    with self._lock:
+      self._prune_locked(self._clock())
+      return {
+          "max_restarts": self.max_restarts,
+          "window_s": self.window_s,
+          "in_window": len(self._spends),
+          "remaining": self.max_restarts - len(self._spends),
+          "spent": self.spent,
+          "refused": self.refused,
+      }
+
+
+class RetryBudget:
+  """Token-bucket failover budget — the retry-amplification guard.
+
+  Replica failover multiplies load exactly when the fleet can least
+  afford it: in a fleet-wide brownout every request fails its primary
+  and retries ``replication - 1`` more backends, so offered load
+  multiplies by R at the moment everything is slow. The classic fix
+  (Finagle-style retry budgets) bounds aggregate retries as a fraction
+  of real traffic: every request deposits ``ratio`` tokens (capped at
+  ``cap``), every failover attempt withdraws one, and an empty bucket
+  means the caller fails fast instead of amplifying. ``initial`` tokens
+  let a cold router cover isolated failures immediately.
+
+  Pure token arithmetic (no clock); thread-safe.
+  """
+
+  def __init__(self, ratio: float = 0.1, initial: float = 10.0,
+               cap: float = 100.0):
+    if ratio <= 0:
+      raise ValueError(f"ratio must be > 0, got {ratio}")
+    if cap < initial or initial < 0:
+      raise ValueError(f"need 0 <= initial <= cap, got {initial} / {cap}")
+    self.ratio = float(ratio)
+    self.cap = float(cap)
+    self._lock = threading.Lock()
+    self._tokens = float(initial)
+    self.deposits = 0
+    self.withdrawals = 0
+    self.refused = 0
+
+  def deposit(self) -> None:
+    """One real request happened: earn ``ratio`` retry tokens."""
+    with self._lock:
+      self._tokens = min(self._tokens + self.ratio, self.cap)
+      self.deposits += 1
+
+  def try_withdraw(self) -> bool:
+    """Claim one failover attempt; False means stop retrying."""
+    with self._lock:
+      if self._tokens < 1.0:
+        self.refused += 1
+        return False
+      self._tokens -= 1.0
+      self.withdrawals += 1
+      return True
+
+  def snapshot(self) -> dict:
+    with self._lock:
+      return {
+          "tokens": round(self._tokens, 3),
+          "ratio": self.ratio,
+          "cap": self.cap,
+          "deposits": self.deposits,
+          "withdrawals": self.withdrawals,
+          "refused": self.refused,
+      }
+
+
 class CircuitBreaker:
   """CLOSED -> OPEN -> HALF_OPEN consecutive-failure circuit breaker.
 

@@ -153,6 +153,16 @@ class RenderService:
       Surfaced as the ``slo`` block in ``/stats``, ``mpi_slo_*``
       families in ``/metrics``, and folded into ``/healthz`` (a firing
       alert reports ``degraded`` with the reason).
+    alert_hook: optional callable invoked with each ``slo_alert``
+      event's record dict on every alert FIRE and CLEAR edge (``serve
+      --alert-hook CMD`` wraps a user command) — the alert *delivery*
+      seam, the serving twin of ``profile_hook``. Edges are delivered
+      IN ORDER by one daemon worker thread (alert edges fire inside
+      the request path; a pager webhook must not stall a render, and a
+      slow fire delivery must not be overtaken by its clear); failures
+      are counted
+      (``alert_hook_failures``, surfaced in ``/stats``) and never
+      raised: a dead pager must not fail the service it pages about.
     events: the lifecycle event log (``obs.events.EventLog``; a private
       one is made if omitted) serving ``/debug/events`` — breaker
       transitions, watchdog trips, scene swaps, SLO alert edges.
@@ -173,7 +183,7 @@ class RenderService:
                cpu_fallback: str = "auto", fallback_engine=None,
                tracer: Tracer | None = None, profile_dir: str | None = None,
                profiler: DeviceProfiler | None = None,
-               profile_hook=None,
+               profile_hook=None, alert_hook=None,
                slo: "SloConfig | SloTracker | None" = SloConfig(),
                events: EventLog | None = None,
                metrics_ttl_s: float = 0.25, clock=time.monotonic):
@@ -213,6 +223,11 @@ class RenderService:
       self.profiler = (DeviceProfiler(profile_dir) if profile_dir else None)
     self.profile_hook = profile_hook
     self.profile_hook_failures = 0
+    self.alert_hook = alert_hook
+    self._alert_hook_lock = threading.Lock()
+    self._alert_hook_queue = None  # lazy: only alerting services pay it
+    self.alert_hook_runs = 0
+    self.alert_hook_failures = 0
     self.resilient = None if resilience is None else ResilientExecutor(
         resilience, metrics=self.metrics, events=self.events)
     self.fallback_engine = fallback_engine
@@ -240,7 +255,46 @@ class RenderService:
     self._closed = False
 
   def _on_slo_alert(self, name: str, firing: bool, details: dict) -> None:
-    self.events.emit("slo_alert", slo=name, firing=firing, **details)
+    record = self.events.emit("slo_alert", slo=name, firing=firing,
+                              **details)
+    if self.alert_hook is None:
+      return
+    # NULL_EVENTS returns None; the hook still needs the edge's facts.
+    if record is None:
+      record = {"kind": "slo_alert", "slo": name, "firing": firing,
+                **details}
+    # Off the request path: alert edges fire inside SloTracker.check()
+    # under a live render, and a slow pager webhook must not add its
+    # latency to the very requests it is paging about. ONE worker
+    # draining a queue, not a thread per edge: a slow FIRE delivery must
+    # not be overtaken by its own CLEAR (a pager that hears CLEAR then
+    # FIRE is left permanently firing).
+    if self._closed:
+      return  # a post-close scrape must not page about a dead service
+    with self._alert_hook_lock:
+      if self._alert_hook_queue is None:
+        import queue
+
+        self._alert_hook_queue = queue.SimpleQueue()
+        threading.Thread(target=self._alert_hook_worker,
+                         name="mpi-serve-alert-hook", daemon=True).start()
+    self._alert_hook_queue.put(dict(record))
+
+  def _alert_hook_worker(self) -> None:
+    while True:
+      record = self._alert_hook_queue.get()
+      if record is None:  # close() sentinel: drain done, exit
+        return
+      try:
+        self.alert_hook(record)
+        with self._alert_hook_lock:
+          self.alert_hook_runs += 1
+      except Exception as e:  # noqa: BLE001 - a dead pager must not kill serving
+        with self._alert_hook_lock:
+          self.alert_hook_runs += 1
+          self.alert_hook_failures += 1
+        self.events.emit("alert_hook_failed", slo=record.get("slo"),
+                         firing=record.get("firing"), error=repr(e))
 
   # -- scenes -------------------------------------------------------------
 
@@ -435,6 +489,10 @@ class RenderService:
     if self.profiler is not None:
       out["profile"] = {"captures": self.profiler.captures,
                         "hook_failures": self.profile_hook_failures}
+    if self.alert_hook is not None:
+      with self._alert_hook_lock:
+        out["alert_hook"] = {"runs": self.alert_hook_runs,
+                             "failures": self.alert_hook_failures}
     return out
 
   def healthz(self) -> dict:
@@ -505,6 +563,10 @@ class RenderService:
     if not self._closed:
       self._closed = True
       self.scheduler.stop()
+      with self._alert_hook_lock:
+        hook_queue = self._alert_hook_queue
+      if hook_queue is not None:
+        hook_queue.put(None)  # let the alert-hook worker exit
 
   def __enter__(self):
     return self

@@ -72,7 +72,7 @@ def test_lint_covers_the_ckpt_package_and_train_loop():
           "ckpt/watch.py", "ckpt/background.py", "serve/faultinject.py",
           "serve/engine.py", "serve/scheduler.py", "serve/metrics.py",
           "train/loop.py", "train/telemetry.py", "cluster/router.py",
-          "cluster/ring.py", "cluster/pool.py",
+          "cluster/ring.py", "cluster/pool.py", "cluster/supervisor.py",
           "obs/slo.py", "obs/events.py", "obs/trace.py",
           "obs/prom.py"} <= rel
 

@@ -280,6 +280,214 @@ def test_router_all_replicas_open_is_503_with_retry_after():
   assert router.metrics.snapshot()["breaker_fastfails"] == 1
 
 
+# --- eject/readmit (the supervisor's administrative hooks) ---------------
+
+
+def test_router_ejected_backend_is_skipped_without_an_attempt():
+  transport = FakeTransport()
+  transport.set("hostA:1", lambda m, p, b, h: _good_render("s"))
+  transport.set("hostB:1", lambda m, p, b, h: _good_render("s"))
+  router = _two_backend_router(transport)
+  sid, body = _scene_with_primary(router, "a")
+  router.eject("a", reason="rolling_restart")
+  status, headers, _ = router.forward_render(sid, body)
+  assert status == 200 and headers["X-Backend-Id"] == "b"
+  # Planned downtime spends NOTHING: no attempt, no failover, no
+  # breaker count against the ejected backend.
+  assert all(addr != "hostA:1" for addr, _, _ in transport.calls)
+  snap = router.metrics.snapshot()
+  assert snap["failovers"] == 0
+  info = router.stats()["backend_info"]
+  assert info["a"]["breaker"]["consecutive_failures"] == 0
+  assert info["a"]["ejected"] is True
+  assert router.ejected() == ["a"]
+  router.readmit("a")
+  assert router.ejected() == []
+  transport.calls.clear()
+  status, headers, _ = router.forward_render(sid, body)
+  assert status == 200 and headers["X-Backend-Id"] == "a"
+  # Both edges land in the lifecycle log.
+  kinds = router.events.snapshot()["by_kind"]
+  assert kinds["backend_eject"] == 1 and kinds["backend_readmit"] == 1
+
+
+def test_router_all_replicas_ejected_is_503_not_error():
+  transport = FakeTransport()
+  transport.set("hostA:1", lambda m, p, b, h: _good_render("s"))
+  transport.set("hostB:1", lambda m, p, b, h: _good_render("s"))
+  router = _two_backend_router(transport)
+  sid, body = _scene_with_primary(router, "a")
+  router.eject("a")
+  router.eject("b")
+  with pytest.raises(AllReplicasOpenError):
+    router.forward_render(sid, body)
+  assert router.metrics.snapshot()["breaker_fastfails"] == 1
+
+
+# --- retry budget (failover amplification guard) -------------------------
+
+
+def test_router_retry_budget_degrades_brownout_to_fast_503():
+  from mpi_vision_tpu.serve.cluster import RetryBudgetExhaustedError
+
+  transport = FakeTransport()
+  transport.set("hostA:1", _dead)
+  transport.set("hostB:1", _dead)
+  # Breakers never open (high threshold): the budget is the only guard.
+  router = Router({"a": "hostA:1", "b": "hostB:1"}, replication=2,
+                  breaker_threshold=1000, transport=transport,
+                  clock=FakeClock(), retry_budget_ratio=0.1,
+                  retry_budget_initial=2.0)
+  sid, body = _scene_with_primary(router, "a")
+  # 2 initial tokens cover the first two requests' failovers (each walk
+  # = 1 primary attempt + 1 budgeted failover).
+  for _ in range(2):
+    with pytest.raises(ReplicasExhaustedError):
+      router.forward_render(sid, body)
+  # Bucket dry (2 withdrawn, deposits only 0.1/request): the walk now
+  # stops after the primary attempt — fast 503, no amplification.
+  calls_before = len(transport.calls)
+  with pytest.raises(RetryBudgetExhaustedError):
+    router.forward_render(sid, body)
+  assert len(transport.calls) == calls_before + 1  # primary only
+  snap = router.metrics.snapshot()
+  assert snap["retry_budget_exhausted"] == 1
+  budget = router.stats()["retry_budget"]
+  assert budget["withdrawals"] == 2 and budget["refused"] == 1
+  assert budget["tokens"] < 1.0
+
+
+def test_router_retry_budget_refusal_releases_a_claimed_probe_slot():
+  """A dry budget can interrupt the walk right after allow_primary()
+  claimed a replica's half-open probe; the slot must be released or
+  that breaker wedges in HALF_OPEN forever (nothing else feeds it)."""
+  from mpi_vision_tpu.serve.cluster import RetryBudgetExhaustedError
+
+  transport = FakeTransport()
+  transport.set("hostA:1", _dead)
+  transport.set("hostB:1", _dead)
+  clock = FakeClock()
+  router = Router({"a": "hostA:1", "b": "hostB:1"}, replication=2,
+                  breaker_threshold=1, breaker_reset_s=10.0,
+                  transport=transport, clock=clock,
+                  retry_budget_ratio=0.4, retry_budget_initial=1.0)
+  sid, body = _scene_with_primary(router, "a")
+  with pytest.raises(ReplicasExhaustedError):
+    router.forward_render(sid, body)  # opens both breakers, spends the token
+  clock.t += 10.1  # both cooldowns elapse: the next walk probes
+  with pytest.raises(RetryBudgetExhaustedError):
+    # a's probe fails (dead, re-opens a), b's allow_primary() claims ITS
+    # probe slot, then the dry budget stops the walk before the attempt.
+    router.forward_render(sid, body)
+  # Deposits refilled the bucket past 1 token; b's next allow_primary()
+  # must still probe — a leaked slot would keep it False forever (and a,
+  # freshly re-opened, stays skipped: b IS the serving path).
+  transport.set("hostB:1", lambda m, p, b, h: _good_render("s"))
+  status, headers, _ = router.forward_render(sid, body)
+  assert status == 200 and headers["X-Backend-Id"] == "b"
+  assert router.stats()["backend_info"]["b"]["breaker"]["state"] == "closed"
+
+
+def test_router_retry_budget_refills_from_good_traffic():
+  transport = FakeTransport()
+  transport.set("hostA:1", lambda m, p, b, h: _good_render("s"))
+  transport.set("hostB:1", lambda m, p, b, h: _good_render("s"))
+  router = Router({"a": "hostA:1", "b": "hostB:1"}, replication=2,
+                  transport=transport, clock=FakeClock(),
+                  retry_budget_ratio=0.5, retry_budget_initial=0.0)
+  sid, body = _scene_with_primary(router, "a")
+  for _ in range(4):  # 4 * 0.5 = 2 tokens earned
+    assert router.forward_render(sid, body)[0] == 200
+  transport.set("hostA:1", _dead)
+  status, headers, _ = router.forward_render(sid, body)
+  assert status == 200 and headers["X-Backend-Id"] == "b"  # budgeted
+
+
+# --- load-aware replica choice -------------------------------------------
+
+
+def _load_router(transport, clock):
+  return Router({"a": "hostA:1", "b": "hostB:1"}, replication=2,
+                transport=transport, clock=clock, load_aware=True,
+                load_ttl_s=5.0, load_threshold=4)
+
+
+def test_router_load_aware_demotes_deep_primary():
+  transport = FakeTransport()
+  transport.set("hostA:1", lambda m, p, b, h: _good_render("s"))
+  transport.set("hostB:1", lambda m, p, b, h: _good_render("s"))
+  clock = FakeClock()
+  router = _load_router(transport, clock)
+  sid, body = _scene_with_primary(router, "a")
+  # No load data: placement order wins (cache locality).
+  assert router.forward_render(sid, body)[1]["X-Backend-Id"] == "a"
+  # Fresh depths show the primary 9 deep vs 0: demote it.
+  router.note_backend_load("a", 9)
+  router.note_backend_load("b", 0)
+  status, headers, _ = router.forward_render(sid, body)
+  assert status == 200 and headers["X-Backend-Id"] == "b"
+  assert router.metrics.snapshot()["load_reroutes"] == 1
+  # Below the threshold: the primary keeps its scene.
+  router.note_backend_load("a", 3)
+  assert router.forward_render(sid, body)[1]["X-Backend-Id"] == "a"
+
+
+def test_router_load_aware_ignores_stale_depths():
+  transport = FakeTransport()
+  transport.set("hostA:1", lambda m, p, b, h: _good_render("s"))
+  transport.set("hostB:1", lambda m, p, b, h: _good_render("s"))
+  clock = FakeClock()
+  router = _load_router(transport, clock)
+  sid, body = _scene_with_primary(router, "a")
+  router.note_backend_load("a", 9)
+  router.note_backend_load("b", 0)
+  clock.t += 5.1  # past load_ttl_s: yesterday's hotspot is not today's
+  assert router.forward_render(sid, body)[1]["X-Backend-Id"] == "a"
+  assert router.metrics.snapshot()["load_reroutes"] == 0
+
+
+def test_router_stats_fanout_feeds_the_load_table():
+  transport = FakeTransport()
+
+  def statsy(depth):
+    def handler(method, path, body, headers):
+      if path == "/stats":
+        return 200, {}, json.dumps({"queue_depth": depth}).encode()
+      return 200, {}, json.dumps({"status": "ok"}).encode()
+    return handler
+
+  transport.set("hostA:1", statsy(7))
+  transport.set("hostB:1", statsy(1))
+  clock = FakeClock()
+  router = _load_router(transport, clock)
+  router.stats()  # any stats scrape doubles as a load refresh
+  with router._lock:
+    depths = {b: d for b, (d, _) in router._load.items()}
+  assert depths == {"a": 7.0, "b": 1.0}
+
+
+# --- concurrent fan-out (a slow backend must not stall the scrape) -------
+
+
+def test_router_fan_out_probes_backends_concurrently():
+  """Both backends block on one barrier that only releases when BOTH
+  probes are in flight at once — a serial fan-out would deadlock the
+  first probe until its timeout. Deterministic: no sleeps, no timing."""
+  barrier = threading.Barrier(2, timeout=10.0)
+
+  def blocking_backend(method, path, body, headers):
+    barrier.wait()  # serial fan-out: BrokenBarrierError after 10 s
+    return 200, {}, json.dumps({"status": "ok"}).encode()
+
+  transport = FakeTransport()
+  transport.set("hostA:1", blocking_backend)
+  transport.set("hostB:1", blocking_backend)
+  router = _two_backend_router(transport)
+  health = router.healthz()
+  assert health["backends"] == {"a": "ok", "b": "ok"}
+  assert health["status"] == "ok"
+
+
 # --- the router's own HTTP front end ------------------------------------
 
 

@@ -142,6 +142,33 @@ def test_serve_load_cluster_dry_smoke():
     assert "availability" in out["slo"]["objectives"]
 
 
+def test_serve_load_cluster_crashloop_dry_smoke():
+  """The self-healing drill's tier-1 smoke: the fleet supervisor runs
+  over the spawned pool, one backend is killed every time it comes back
+  until its restart budget (1, for speed) quarantines it, and the JSON
+  must record the whole arc — restarts, containment, and a fleet still
+  serving after the quarantine."""
+  out = _run_dry(["--cluster", "--chaos-crashloop", "--restart-budget", "1"])
+  assert out["metric"] == "serve_load" and out["dry"] is True
+  assert out["renders_per_sec"] > 0 and out["requests"] > 0
+  cluster = out["cluster"]
+  drill = cluster["crashloop"]
+  victim = drill["victim"]
+  # The supervisor really respawned the victim (budget's worth) and then
+  # contained the loop: quarantined, no more restarts.
+  assert drill["restarts"] == 1 and drill["restart_budget"] == 1
+  assert drill["kills"] >= 2  # the respawned backend was killed again
+  assert drill["quarantined"] is True
+  assert drill["events"]["backend_restart"] >= 1
+  assert drill["events"]["backend_quarantined"] == 1
+  assert cluster["quarantines"] == {victim: 1}
+  assert cluster["restarts"].get(victim, 0) >= 1
+  assert victim in cluster["ejected"]
+  # Post-quarantine the surviving replicas kept the fleet serving.
+  assert drill["post_quarantine_requests"] > 0
+  assert cluster["health"] == "degraded"
+
+
 def test_serve_load_chaos_dry_smoke():
   """Chaos mode must inject faults AND finish healthy: the workload rides
   retries/fallback instead of aborting, and the JSON carries the

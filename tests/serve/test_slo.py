@@ -15,6 +15,7 @@ artifact-upload hook.
 
 import contextlib
 import json
+import time
 import urllib.parse
 import urllib.request
 
@@ -487,6 +488,78 @@ def test_profile_hook_failure_is_counted_never_fatal(tmp_path):
     assert svc.events.count("profile_hook_failed") == 1
     # The capture machinery is intact for the next call.
     assert svc.profile(0.5)["capture"] == 2
+  finally:
+    svc.close()
+
+
+# --- alert delivery hook (the serving twin of --profile-hook) -------------
+
+
+def _drive_alert_fire(tracker):
+  for _ in range(20):
+    tracker.record(ok=True, latency_s=0.01)
+  for _ in range(10):
+    tracker.record(ok=False)
+
+
+def _await_hook_runs(svc, n, timeout=10.0):
+  deadline = time.monotonic() + timeout
+  while time.monotonic() < deadline:
+    stats = svc.stats()
+    if stats.get("alert_hook", {}).get("runs", 0) >= n:
+      return stats["alert_hook"]
+    time.sleep(0.01)
+  raise AssertionError(
+      f"alert hook never reached {n} runs: {svc.stats().get('alert_hook')}")
+
+
+def test_alert_hook_delivers_fire_and_clear_edges():
+  clock = FakeClock()
+  tracker = SloTracker(_cfg(), clock=clock)
+  seen = []
+  svc = RenderService(use_mesh=False, slo=tracker, alert_hook=seen.append,
+                      metrics_ttl_s=0.0)
+  try:
+    _drive_alert_fire(tracker)
+    assert tracker.alerts_firing() == ["availability"]
+    hook_stats = _await_hook_runs(svc, 1)
+    assert hook_stats["failures"] == 0
+    fire = seen[0]
+    # The hook receives the full slo_alert event record — the same one
+    # /debug/events carries — so a pager script needs no second lookup.
+    assert fire["kind"] == "slo_alert" and fire["slo"] == "availability"
+    assert fire["firing"] is True and fire["fast_burn"] >= 10.0
+    assert "seq" in fire and "ts_unix_s" in fire
+    # Recovery delivers the CLEAR edge too (a pager that only hears
+    # fires never stands down).
+    clock.advance(11)
+    for _ in range(5):
+      tracker.record(ok=True, latency_s=0.01)
+    assert tracker.alerts_firing() == []
+    _await_hook_runs(svc, 2)
+    clears = [r for r in seen if r["firing"] is False]
+    assert clears and clears[0]["slo"] == "availability"
+  finally:
+    svc.close()
+
+
+def test_alert_hook_failure_is_counted_never_fatal():
+  clock = FakeClock()
+  tracker = SloTracker(_cfg(), clock=clock)
+
+  def bad_hook(record):
+    raise RuntimeError("pager webhook down")
+
+  svc = RenderService(use_mesh=False, slo=tracker, alert_hook=bad_hook,
+                      metrics_ttl_s=0.0)
+  try:
+    _drive_alert_fire(tracker)  # must NOT raise into the record path
+    assert tracker.alerts_firing() == ["availability"]
+    hook_stats = _await_hook_runs(svc, 1)
+    assert hook_stats["failures"] == 1
+    assert svc.events.count("alert_hook_failed") == 1
+    # The alert itself still fired everywhere else.
+    assert svc.events.count("slo_alert") == 1
   finally:
     svc.close()
 

@@ -125,6 +125,37 @@ def test_cluster_join_empty_address_list_rejected():
     cli.main(["cluster", "--join", " , ,"])
 
 
+def test_alert_hook_without_slo_rejected():
+  """Alert edges only exist with SLO tracking; a dangling hook would
+  silently never page."""
+  with pytest.raises(SystemExit, match="--alert-hook requires"):
+    cli.main(["serve", "--no-slo", "--alert-hook", "echo",
+              "--duration", "0.1"])
+
+
+@pytest.mark.parametrize("flag", ["--supervise", "--rolling-restart"])
+def test_cluster_supervision_requires_a_local_pool(flag):
+  """--join fronts backends some OTHER supervisor owns; this process
+  can only kill and respawn what it spawned."""
+  with pytest.raises(SystemExit, match="require --backends"):
+    cli.main(["cluster", "--join", "h:1", flag])
+
+
+def test_cluster_bad_supervision_knobs_rejected():
+  """Invalid supervision knobs must fail at the door: the monitor loop
+  swallows tick exceptions by design, so a lazily-raised ValueError
+  would leave supervision silently dead."""
+  with pytest.raises(SystemExit, match="--restart-budget must be"):
+    cli.main(["cluster", "--backends", "1", "--supervise",
+              "--restart-budget", "0"])
+  with pytest.raises(SystemExit, match="--probe-s must be"):
+    cli.main(["cluster", "--backends", "1", "--supervise",
+              "--probe-s", "0"])
+  with pytest.raises(SystemExit, match="--wedge-after must be"):
+    cli.main(["cluster", "--backends", "1", "--supervise",
+              "--wedge-after", "0"])
+
+
 def test_negative_save_every_rejected(tmp_path):
   with pytest.raises(SystemExit, match="--save-every must be >= 0"):
     cli.main(["train", "--synthetic", "--save-every", "-3",

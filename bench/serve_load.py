@@ -98,6 +98,26 @@ def build_parser() -> argparse.ArgumentParser:
                   help="run the load twice — pipelined (--inflight) vs "
                        "blocking (window 1) — and emit one serve_load_ab "
                        "JSON line with both arms + speedup")
+  ap.add_argument("--edge", action="store_true",
+                  help="serve through the pose-quantized edge frame "
+                       "cache (serve/edge/) and report its hit/warp/"
+                       "miss accounting")
+  ap.add_argument("--edge-ab", action="store_true",
+                  help="run the load twice — edge cache on, then off — "
+                       "and emit one serve_load_edge_ab JSON line with "
+                       "both arms, the p50 drop, and the hit rate")
+  ap.add_argument("--edge-trans-cell", type=float, default=0.02,
+                  help="edge view-cell translation pitch (--edge/"
+                       "--edge-ab); the bench default is finer than the "
+                       "serve default so warps show next to exact hits")
+  ap.add_argument("--zipf-poses", type=int, default=0,
+                  help="draw poses Zipf-distributed from a pool of this "
+                       "many fixed poses (rank r with p ~ 1/r^s) instead "
+                       "of fresh-random — the orbit-a-hot-viewpoint "
+                       "traffic shape the edge cache exists for; 0 = "
+                       "fresh random poses")
+  ap.add_argument("--zipf-s", type=float, default=1.1,
+                  help="Zipf exponent for --zipf-poses")
   ap.add_argument("--cache-mb", type=int, default=2048)
   ap.add_argument("--method", default="fused",
                   choices=("fused", "scan", "assoc"))
@@ -214,6 +234,26 @@ def random_pose(rng: np.random.Generator) -> np.ndarray:
   pose = np.eye(4, dtype=np.float32)
   pose[:3, 3] = rng.uniform(-0.05, 0.05, 3).astype(np.float32)
   return pose
+
+
+def zipf_pose_sampler(n: int, s: float, seed: int):
+  """``rng -> pose`` drawing from ``n`` fixed poses with Zipf(s) ranks.
+
+  The pool is a pure function of the seed (workers share it; their own
+  rngs only pick ranks), so repeat draws of a popular rank are the SAME
+  pose — the exact-reuse traffic a view-cell cache monetizes, with a
+  long tail of rarely-seen poses that miss, exactly like a hot scene
+  orbit plus stragglers.
+  """
+  pool_rng = np.random.default_rng([seed, 777])
+  pool = [random_pose(pool_rng) for _ in range(n)]
+  weights = 1.0 / np.arange(1, n + 1, dtype=np.float64) ** s
+  cumulative = np.cumsum(weights / weights.sum())
+
+  def sample(rng: np.random.Generator) -> np.ndarray:
+    return pool[int(np.searchsorted(cumulative, rng.random()))]
+
+  return sample
 
 
 def cluster_main(args) -> int:
@@ -417,10 +457,12 @@ def cluster_main(args) -> int:
     pool.close()
 
 
-def inprocess_run(args, inflight: int) -> dict:
+def inprocess_run(args, inflight: int, edge: bool = False) -> dict:
   """One measured in-process load window at the given pipeline window;
   returns the headline JSON record (the single-run mode prints exactly
-  this; ``--ab`` calls it twice)."""
+  this; ``--ab`` / ``--edge-ab`` call it twice). ``edge`` serves the
+  closed loop through ``RenderService.render_edge`` (the pose-quantized
+  frame cache) instead of the raw scheduler path."""
   from mpi_vision_tpu.obs import slo as slo_mod
   from mpi_vision_tpu.serve import (
       FaultyEngine,
@@ -429,6 +471,7 @@ def inprocess_run(args, inflight: int) -> dict:
       ResilienceConfig,
       Tracer,
   )
+  from mpi_vision_tpu.serve.edge import EdgeConfig
 
   use_mesh = {"auto": None, "on": True, "off": False}[args.sharded]
   engine = None
@@ -450,6 +493,7 @@ def inprocess_run(args, inflight: int) -> dict:
       max_wait_ms=args.max_wait_ms, max_inflight=inflight,
       method=args.method, use_mesh=use_mesh,
       engine=engine, resilience=resilience, tracer=tracer,
+      edge=(EdgeConfig(trans_cell=args.edge_trans_cell) if edge else None),
       slo=slo_window_config(args.duration))
   ids = svc.add_synthetic_scenes(
       args.scenes, height=args.img_size, width=args.img_size,
@@ -478,6 +522,8 @@ def inprocess_run(args, inflight: int) -> dict:
   counts = [0] * args.concurrency
   failure_counts: collections.Counter = collections.Counter()
   failure_lock = threading.Lock()
+  draw_pose = (zipf_pose_sampler(args.zipf_poses, args.zipf_s, args.seed)
+               if args.zipf_poses > 0 else random_pose)
 
   def worker(idx: int) -> None:
     rng = np.random.default_rng(args.seed + 1 + idx)
@@ -487,10 +533,16 @@ def inprocess_run(args, inflight: int) -> dict:
       sid = ids[0] if (rng.random() < 0.5 or len(ids) == 1) \
           else ids[int(rng.integers(1, len(ids)))]
       try:
-        if args.trace:
-          svc.render_traced(sid, random_pose(rng), timeout=600)
+        if edge:
+          # render_edge owns the trace end to end (hits/warps finish it
+          # up front, misses hand it to the flight) — --trace composes.
+          svc.render_edge(
+              sid, draw_pose(rng), timeout=600,
+              trace=svc.tracer.start_trace("render", scene_id=sid))
+        elif args.trace:
+          svc.render_traced(sid, draw_pose(rng), timeout=600)
         else:
-          svc.render(sid, random_pose(rng), timeout=600)
+          svc.render(sid, draw_pose(rng), timeout=600)
       except Exception as e:  # noqa: BLE001 - chaos rides through, else exit
         if not args.chaos:
           errors.append(e)
@@ -550,6 +602,10 @@ def inprocess_run(args, inflight: int) -> dict:
       "sharded": stats["engine"]["sharded"],
       "dry": bool(args.dry),
       "chaos": bool(args.chaos),
+      "zipf_poses": args.zipf_poses or None,
+      # Edge frame-cache accounting (hit/warp/miss split + hit rate)
+      # when the run served through serve/edge/.
+      **({"edge": stats["edge"]} if "edge" in stats else {}),
       # Error + resilience accounting rides EVERY run's JSON (not just
       # chaos): outage behavior must trend across BENCH rounds, and a
       # clean round proving zeros is itself the trend line (ROADMAP).
@@ -608,6 +664,53 @@ def ab_main(args) -> int:
   return 0
 
 
+def edge_ab_main(args) -> int:
+  """The edge-on-vs-off A/B: the same closed-loop load served through
+  the pose-quantized frame cache and then through the raw scheduler
+  path, in one process (identical XLA compiles and scene bakes). One
+  JSON line carries both arms, the hit/warp/miss split, and the p50
+  drop — the number that must fall at high hit rates for the edge tier
+  to earn its bytes. Pair with ``--zipf-poses`` for the orbit-a-hot-
+  viewpoint traffic shape the cache is built for."""
+  if args.zipf_poses == 0:
+    # Fresh-random poses essentially never repeat a view cell inside a
+    # bench window; default the sampler on so the A/B measures the
+    # cache's design load rather than its worst case.
+    args.zipf_poses = 32
+  _log(f"serve_load: edge A/B arm 1/2 — edge cache on "
+       f"(zipf {args.zipf_poses} poses, s={args.zipf_s})")
+  edge_on = inprocess_run(args, args.inflight, edge=True)
+  _log("serve_load: edge A/B arm 2/2 — edge cache off")
+  edge_off = inprocess_run(args, args.inflight)
+  p50_on, p50_off = edge_on["p50_ms"], edge_off["p50_ms"]
+  speedup = (p50_off / p50_on) if (p50_on and p50_off) else None
+  edge_stats = edge_on.get("edge") or {}
+  record = {
+      "metric": "serve_load_edge_ab",
+      "value": round(speedup, 4) if speedup is not None else None,
+      "unit": "x_p50_off_over_on",
+      "p50_ms_edge_on": p50_on,
+      "p50_ms_edge_off": p50_off,
+      "p50_drop_pct": (round((1.0 - p50_on / p50_off) * 100.0, 2)
+                       if speedup is not None else None),
+      "throughput_x": (round(edge_on["renders_per_sec"]
+                             / edge_off["renders_per_sec"], 4)
+                       if edge_off["renders_per_sec"] else None),
+      "hit_rate": edge_stats.get("hit_rate"),
+      "hits": edge_stats.get("hits"),
+      "warp_serves": edge_stats.get("warp_serves"),
+      "misses": edge_stats.get("misses"),
+      "zipf_poses": args.zipf_poses,
+      "zipf_s": args.zipf_s,
+      "edge_on": edge_on,
+      "edge_off": edge_off,
+      "device": edge_on["device"],
+      "dry": bool(args.dry),
+  }
+  print(json.dumps(record))
+  return 0
+
+
 def main(argv=None) -> int:
   args = build_parser().parse_args(argv)
   if os.environ.get("SERVE_LOAD_DRY", "") not in ("", "0", "false"):
@@ -625,18 +728,27 @@ def main(argv=None) -> int:
     raise SystemExit("--chaos-crashloop drills the multi-host tier; "
                      "add --cluster")
   if args.cluster:
-    if args.ab:
-      raise SystemExit("--ab measures the in-process pipeline; "
-                       "it does not combine with --cluster")
+    if args.ab or args.edge_ab:
+      raise SystemExit("--ab/--edge-ab measure the in-process path; "
+                       "they do not combine with --cluster")
+    if args.edge:
+      raise SystemExit("--edge measures the in-process path; spawn edge-"
+                       "caching backends with --backend-args "
+                       "'--edge-cache' via the cluster CLI instead")
     if args.dry:
       args.duration = max(args.duration, 4.0)  # give the kill phase room
     return cluster_main(args)
+  if args.edge_ab:
+    if args.chaos or args.ab:
+      raise SystemExit("--edge-ab compares clean edge-on/off arms; it "
+                       "does not combine with --chaos or --ab")
+    return edge_ab_main(args)
   if args.ab:
     if args.chaos:
       raise SystemExit("--ab compares clean arms; it does not combine "
                        "with --chaos")
     return ab_main(args)
-  print(json.dumps(inprocess_run(args, args.inflight)))
+  print(json.dumps(inprocess_run(args, args.inflight, edge=args.edge)))
   return 0
 
 

@@ -428,6 +428,31 @@ def cmd_serve(args: argparse.Namespace) -> dict:
     # Alert edges only exist with SLO tracking on; accepting the hook
     # without it would silently never deliver a page.
     raise SystemExit("--alert-hook requires SLO tracking (drop --no-slo)")
+  if not args.edge_cache:
+    # Edge knobs only act through the edge cache; silently ignoring them
+    # would drop the fidelity/budget bounds the user asked for.
+    wants_edge = [flag for flag, on in (
+        ("--edge-cache-mb", args.edge_cache_mb is not None),
+        ("--edge-trans-cell", args.edge_trans_cell is not None),
+        ("--edge-rot-bucket-deg", args.edge_rot_bucket_deg is not None),
+        ("--edge-warp-trans", args.edge_warp_trans is not None),
+        ("--edge-warp-rot-deg", args.edge_warp_rot_deg is not None),
+        ("--edge-max-age-s", args.edge_max_age_s is not None)) if on]
+    if wants_edge:
+      raise SystemExit(f"{', '.join(wants_edge)} require(s) --edge-cache")
+  if args.event_log_max_bytes > 0 and not args.event_log:
+    # Rotation only acts on the JSONL sink; the in-memory ring is
+    # already bounded.
+    raise SystemExit("--event-log-max-bytes requires --event-log")
+  if args.max_inflight == "auto":
+    max_inflight = "auto"
+  else:
+    try:
+      max_inflight = int(args.max_inflight)
+    except ValueError:
+      raise SystemExit(
+          f"--max-inflight must be an integer or 'auto', "
+          f"got {args.max_inflight!r}") from None
 
   use_mesh = {"auto": None, "on": True, "off": False}[args.sharded]
   resilience = None
@@ -460,7 +485,35 @@ def cmd_serve(args: argparse.Namespace) -> dict:
   if args.event_log:
     from mpi_vision_tpu.obs import events as events_mod
 
-    events = events_mod.EventLog(sink=events_mod.file_sink(args.event_log))
+    events = events_mod.EventLog(sink=events_mod.file_sink(
+        args.event_log,
+        max_bytes=(args.event_log_max_bytes
+                   if args.event_log_max_bytes > 0 else None),
+        keep=args.event_log_keep))
+  edge = None
+  if args.edge_cache:
+    from mpi_vision_tpu.serve.edge import EdgeConfig
+
+    defaults = EdgeConfig()
+    edge = EdgeConfig(
+        byte_budget=((args.edge_cache_mb << 20)
+                     if args.edge_cache_mb is not None
+                     else defaults.byte_budget),
+        trans_cell=(args.edge_trans_cell
+                    if args.edge_trans_cell is not None
+                    else defaults.trans_cell),
+        rot_bucket_deg=(args.edge_rot_bucket_deg
+                        if args.edge_rot_bucket_deg is not None
+                        else defaults.rot_bucket_deg),
+        warp_max_trans=(args.edge_warp_trans
+                        if args.edge_warp_trans is not None
+                        else defaults.warp_max_trans),
+        warp_max_rot_deg=(args.edge_warp_rot_deg
+                          if args.edge_warp_rot_deg is not None
+                          else defaults.warp_max_rot_deg),
+        max_age_s=(args.edge_max_age_s
+                   if args.edge_max_age_s is not None
+                   else defaults.max_age_s))
   profile_hook = None
   if args.profile_hook:
     import shlex
@@ -488,8 +541,9 @@ def cmd_serve(args: argparse.Namespace) -> dict:
 
   svc = RenderService(
       cache_bytes=args.cache_mb << 20, max_batch=args.max_batch,
-      max_wait_ms=args.max_wait_ms, max_inflight=args.max_inflight,
-      method=args.method, use_mesh=use_mesh,
+      max_wait_ms=args.max_wait_ms, max_inflight=max_inflight,
+      max_inflight_cap=args.max_inflight_cap,
+      method=args.method, use_mesh=use_mesh, edge=edge,
       max_queue=args.max_queue, resilience=resilience,
       cpu_fallback=args.cpu_fallback, tracer=tracer,
       profile_dir=args.profile_dir or None, profile_hook=profile_hook,
@@ -637,6 +691,7 @@ def cmd_serve(args: argparse.Namespace) -> dict:
       "rejected": stats["rejected"],
       "resilience": stats["resilience"],
       "pipeline": stats["pipeline"],
+      **({"edge": stats["edge"]} if "edge" in stats else {}),
       **({"slo": {
           "alerts_firing": stats["slo"]["alerts_firing"],
           "alerts_fired": {
@@ -951,11 +1006,15 @@ def build_parser() -> argparse.ArgumentParser:
                  help="micro-batch cap per device dispatch")
   s.add_argument("--max-wait-ms", type=float, default=3.0,
                  help="straggler window before a partial batch dispatches")
-  s.add_argument("--max-inflight", type=int, default=4,
+  s.add_argument("--max-inflight", default="4",
                  help="streaming-pipeline window: concurrent in-flight "
                       "batches (h2d/compute/readback overlap, futures "
                       "complete out of dispatch order); 1 = legacy "
-                      "blocking dispatch")
+                      "blocking dispatch; 'auto' starts at 2 and grows "
+                      "the window while the dispatch-gap metric keeps "
+                      "improving, up to --max-inflight-cap")
+  s.add_argument("--max-inflight-cap", type=int, default=16,
+                 help="hard ceiling for --max-inflight auto")
   s.add_argument("--cache-mb", type=int, default=2048,
                  help="baked-scene cache byte budget")
   s.add_argument("--max-queue", type=int, default=1024,
@@ -1018,6 +1077,43 @@ def build_parser() -> argparse.ArgumentParser:
                       "transitions, scene swaps, SLO alert edges) to "
                       "this file; /debug/events serves the bounded ring "
                       "either way")
+  s.add_argument("--event-log-max-bytes", type=int, default=0,
+                 help="rotate the --event-log file when it exceeds this "
+                      "many bytes (FILE -> FILE.1 -> ... -> "
+                      "FILE.<keep>, oldest dropped); rotation failures "
+                      "are counted, never fatal; <= 0 disables rotation")
+  s.add_argument("--event-log-keep", type=int, default=3,
+                 help="rotated --event-log files retained")
+  s.add_argument("--edge-cache", action=argparse.BooleanOptionalAction,
+                 default=False,
+                 help="pose-quantized edge frame cache (serve/edge/): "
+                      "quantize request poses onto a view-cell lattice, "
+                      "cache finished frames per cell, serve exact hits "
+                      "directly and near-misses by warping the nearest "
+                      "cached frame; /render gains strong ETags, "
+                      "If-None-Match -> 304, and Cache-Control so "
+                      "browsers/CDNs absorb repeat traffic")
+  s.add_argument("--edge-cache-mb", type=int, default=None,
+                 help="edge frame-cache byte budget (default 512)")
+  s.add_argument("--edge-trans-cell", type=float, default=None,
+                 help="view-cell translation pitch in scene units "
+                      "(default 0.05): poses within one cell share a "
+                      "cached frame")
+  s.add_argument("--edge-rot-bucket-deg", type=float, default=None,
+                 help="view-cell rotation pitch in degrees on the "
+                      "axis-angle vector (default 2.0)")
+  s.add_argument("--edge-warp-trans", type=float, default=None,
+                 help="max translation error (scene units) a near-miss "
+                      "may be from a cached frame and still be served "
+                      "by a homography warp (default 0.1); past it a "
+                      "real render populates the cell")
+  s.add_argument("--edge-warp-rot-deg", type=float, default=None,
+                 help="max rotation error (degrees) for warp serving "
+                      "(default 4.0)")
+  s.add_argument("--edge-max-age-s", type=int, default=None,
+                 help="Cache-Control: max-age on /render responses "
+                      "(default 5) — how long browsers/CDNs may reuse a "
+                      "frame without revalidating")
   s.add_argument("--alert-hook", default="",
                  help="run this command on every SLO alert fire/clear "
                       "edge with the slo_alert event appended to its "

@@ -26,23 +26,74 @@ monotonic in-process spans.
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
 from collections import Counter, deque
 
 
-def file_sink(path: str):
+def file_sink(path: str, max_bytes: int | None = None, keep: int = 3):
   """A sink appending one line per event to ``path`` (line-buffered).
 
   Opened once, append mode — a restarted process extends the log rather
   than truncating the fleet's history.
+
+  Retention (``serve --event-log-max-bytes``, ROADMAP SLO follow-on):
+  with ``max_bytes`` set, a write that pushes the file past it rotates
+  ``path -> path.1 -> ... -> path.keep`` (oldest dropped), so a
+  long-running fleet's JSONL log is bounded at roughly
+  ``(keep + 1) * max_bytes``. A failed rotation costs a counter
+  (``sink.rotate_errors``) and the sink keeps appending to the current
+  file — retention must never be able to kill the event stream it
+  retains.
   """
-  fh = open(path, "a", buffering=1)
+  if max_bytes is not None and max_bytes <= 0:
+    raise ValueError(f"max_bytes must be positive, got {max_bytes}")
+  if keep < 1:
+    raise ValueError(f"keep must be >= 1, got {keep}")
+  # The sink owns its own lock: EventLog.emit deliberately calls sinks
+  # OUTSIDE its lock (a slow write must not serialize emitters against
+  # the ring), so concurrent emitters land here in parallel — and a
+  # rotation closing the file under another thread's write would lose
+  # that thread's event. The pre-rotation sink was safe by accident
+  # (one fh.write is atomic under CPython); rotation makes the
+  # write-then-maybe-swap a real critical section.
+  lock = threading.Lock()
+  state = {"fh": open(path, "a", buffering=1)}
+  state["size"] = state["fh"].tell()
+
+  def _rotate_locked() -> None:
+    try:
+      state["fh"].close()
+      for i in range(keep - 1, 0, -1):
+        rotated = f"{path}.{i}"
+        if os.path.exists(rotated):
+          os.replace(rotated, f"{path}.{i + 1}")
+      os.replace(path, f"{path}.1")
+      sink.rotations += 1
+    except OSError:
+      sink.rotate_errors += 1
+    finally:
+      # Reopen whatever is at ``path`` now: the fresh file after a clean
+      # rotation, or the over-size original after a failed one — either
+      # way the stream keeps flowing.
+      state["fh"] = open(path, "a", buffering=1)
+      state["size"] = state["fh"].tell()
 
   def sink(line: str) -> None:
-    fh.write(line + "\n")
+    with lock:
+      state["fh"].write(line + "\n")
+      state["size"] += len(line) + 1
+      if max_bytes is not None and state["size"] >= max_bytes:
+        _rotate_locked()
 
-  sink.close = fh.close  # let owners release the fd deterministically
+  def close() -> None:
+    with lock:
+      state["fh"].close()
+
+  sink.rotations = 0
+  sink.rotate_errors = 0
+  sink.close = close
   return sink
 
 

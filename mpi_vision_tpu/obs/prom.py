@@ -222,6 +222,31 @@ def serve_registry(stats: dict,
   reg.histogram(p + "batch_size",
                 "Coalesced requests per device dispatch.",
                 buckets, total_reqs, stats.get("batches", 0))
+  # Edge frame cache (serve/edge/): families are always exposed (zeros
+  # while the cache is off) so dashboards and the README metric
+  # reference never depend on a knob.
+  edge = stats.get("edge") or {}
+  reg.counter(p + "edge_hits_total",
+              "Edge frame-cache exact view-cell hits (served stored "
+              "bytes).", edge.get("hits", 0))
+  reg.counter(p + "edge_warp_serves_total",
+              "Edge near-misses served by warping the nearest cached "
+              "frame.", edge.get("warp_serves", 0))
+  reg.counter(p + "edge_misses_total",
+              "Edge lookups that fell through to a real render.",
+              edge.get("misses", 0))
+  reg.counter(p + "edge_revalidations_total",
+              "If-None-Match revalidations answered 304 (no render, no "
+              "body).", edge.get("revalidations", 0))
+  reg.counter(p + "edge_evictions_total",
+              "Edge frame-cache LRU evictions.", edge.get("evictions", 0))
+  reg.counter(p + "edge_invalidations_total",
+              "Edge frames dropped by scene swaps / live reloads.",
+              edge.get("invalidations", 0))
+  reg.gauge(p + "edge_bytes", "Bytes of rendered frames resident in the "
+            "edge cache.", edge.get("bytes", 0))
+  reg.gauge(p + "edge_frames", "Rendered frames resident in the edge "
+            "cache.", edge.get("frames", 0))
   cache = stats.get("cache") or {}
   reg.counter(p + "cache_hits_total", "Scene-cache hits.",
               cache.get("hits", 0))

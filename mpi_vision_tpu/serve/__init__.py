@@ -40,6 +40,7 @@ from mpi_vision_tpu.obs import (
 )
 
 from mpi_vision_tpu.serve.cache import BakedScene, SceneCache, bake_scene
+from mpi_vision_tpu.serve.edge import EdgeConfig, EdgeFrameCache
 from mpi_vision_tpu.serve.engine import InFlightBatch, RenderEngine
 from mpi_vision_tpu.serve.faultinject import Fault, FaultyEngine
 from mpi_vision_tpu.serve.metrics import ServeMetrics

@@ -46,6 +46,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from mpi_vision_tpu.obs import prom
 from mpi_vision_tpu.obs.events import EventLog
+from mpi_vision_tpu.obs.slo import SloConfig, SloTracker
 from mpi_vision_tpu.obs.trace import NULL_TRACE, NULL_TRACER, Tracer
 from mpi_vision_tpu.serve.resilience import CircuitBreaker, RetryBudget
 from mpi_vision_tpu.serve.cluster.ring import HashRing
@@ -295,8 +296,18 @@ class Router:
       ``load_ttl_s``) show it at least ``load_threshold`` requests
       deeper than its best replica — safe because replicas render
       bit-identical pixels.
-    clock: one injectable monotonic base for breakers, metrics, and the
-      exposition cache.
+    slo: client-perceived SLO tracking over the ROUTER'S own request
+      stream (ROADMAP SLO follow-on). The backends' trackers only see
+      requests that reach a backend; the 502s of an exhausted replica
+      walk and the fast 503s of a drained retry budget are failures
+      only the router witnesses — exactly the availability the client
+      experiences. Pass an ``SloConfig`` (the default tracks the same
+      objectives as a backend), a pre-built ``SloTracker`` (tests
+      inject fake clocks), or None to disable. Surfaced as the
+      ``router`` entry of the ``/stats`` ``slo`` block, next to the
+      fleet summary distilled from the backends.
+    clock: one injectable monotonic base for breakers, metrics, the SLO
+      tracker, and the exposition cache.
   """
 
   def __init__(self, backends=None, replication: int = 2, vnodes: int = 64,
@@ -308,7 +319,9 @@ class Router:
                retry_budget_ratio: float = 0.1,
                retry_budget_initial: float = 10.0,
                load_aware: bool = True, load_ttl_s: float = 5.0,
-               load_threshold: int = 4, clock=time.monotonic):
+               load_threshold: int = 4,
+               slo: "SloConfig | SloTracker | None" = SloConfig(),
+               clock=time.monotonic):
     self.replication = int(replication)
     self.breaker_threshold = int(breaker_threshold)
     self.breaker_reset_s = float(breaker_reset_s)
@@ -326,6 +339,12 @@ class Router:
     self.load_ttl_s = float(load_ttl_s)
     self.load_threshold = int(load_threshold)
     self._clock = clock
+    if isinstance(slo, SloTracker):
+      self.slo = slo
+    elif slo is not None:
+      self.slo = SloTracker(slo, clock=clock)
+    else:
+      self.slo = None
     self.metrics = RouterMetrics(clock=clock)
     self._lock = threading.Lock()
     self._backends: dict[str, _Backend] = {}
@@ -503,8 +522,14 @@ class Router:
 
   def forward_render(self, scene_id: str, body: bytes,
                      accept: str | None = None, trace_id: str | None = None,
-                     trace=NULL_TRACE) -> tuple[int, dict, bytes]:
+                     trace=NULL_TRACE,
+                     if_none_match: str | None = None) -> tuple[int, dict, bytes]:
     """Route one ``/render`` body to the scene's replica set.
+
+    ``if_none_match`` forwards the client's revalidation header so a
+    backend's edge cache can answer 304 without rendering — the router
+    stays a pure conditional-request conduit (the backend owns ETag
+    identity; 304s ride back like any other answered status).
 
     Walks the placement list primary-first (load-aware demotion may
     front a measurably idler replica), skipping ejected backends
@@ -526,11 +551,13 @@ class Router:
     ``ReplicasExhaustedError`` (-> 502) when every attempt failed,
     ``KeyError`` when the ring is empty.
     """
+    t0 = self._clock()
     self.metrics.record_request()
     if self.retry_budget is not None:
       self.retry_budget.deposit()
     replicas = self._replicas(scene_id)
     if not replicas:
+      self._slo_bad()
       raise KeyError("no backends registered")
     replicas = self._load_ordered(replicas)
     trace_id = trace_id or new_trace_id_32()
@@ -540,6 +567,8 @@ class Router:
     }
     if accept:
       headers["Accept"] = accept
+    if if_none_match:
+      headers["If-None-Match"] = if_none_match
     attempts: list[str] = []
     retry_afters: list[float] = []
     tried_any = False
@@ -559,6 +588,7 @@ class Router:
           # HALF_OPEN forever (no other caller feeds it).
           backend.breaker.release_probe()
           self.metrics.record_retry_budget_exhausted()
+          self._slo_bad()
           raise RetryBudgetExhaustedError(scene_id, attempts)
         self.metrics.record_failover()
         self.events.emit("failover", scene_id=str(scene_id),
@@ -601,6 +631,12 @@ class Router:
         outcome_recorded = True
         self.metrics.record_forward(backend.backend_id)
         trace.end_span(span, status=status)
+        if self.slo is not None:
+          # The client got an answer: good for availability (a backend-
+          # judged 4xx is the CLIENT's error), timed end to end — queue
+          # time on a hot replica walk counts against latency here even
+          # though no single backend saw it.
+          self.slo.record(ok=True, latency_s=self._clock() - t0)
         resp_headers = dict(resp_headers)
         resp_headers["X-Backend-Id"] = backend.backend_id
         return status, resp_headers, resp_body
@@ -610,12 +646,18 @@ class Router:
           # about the backend: free a claimed half-open probe slot so
           # the breaker cannot wedge in HALF_OPEN.
           backend.breaker.release_probe()
+    self._slo_bad()
     if not tried_any:
       self.metrics.record_breaker_fastfail()
       raise AllReplicasOpenError(
           scene_id, min(retry_afters) if retry_afters else 0.0)
     self.metrics.record_replica_exhausted()
     raise ReplicasExhaustedError(scene_id, attempts)
+
+  def _slo_bad(self) -> None:
+    """One client-perceived failure (502/503 the backends never saw)."""
+    if self.slo is not None:
+      self.slo.record_bad()
 
   @staticmethod
   def _validate_render_body(headers: dict, body: bytes) -> str | None:
@@ -801,11 +843,16 @@ class Router:
     self._feed_load(per_backend)
     with self._lock:
       backends = {b: be.snapshot() for b, be in self._backends.items()}
+    slo_block = self._slo_summary(per_backend)
+    if self.slo is not None:
+      # The router's OWN client-perceived stream: includes the 502s and
+      # retry-budget 503s no backend tracker ever saw.
+      slo_block["router"] = self.slo.snapshot()
     out = {
         "router": self.metrics.snapshot(),
         "backend_info": {b: backends[b] for b in sorted(backends)},
         "backends": {b: per_backend[b] for b in sorted(per_backend)},
-        "slo": self._slo_summary(per_backend),
+        "slo": slo_block,
     }
     if self.retry_budget is not None:
       out["retry_budget"] = self.retry_budget.snapshot()
@@ -995,9 +1042,13 @@ class Router:
 
 # Response headers forwarded verbatim from the winning backend (plus the
 # router's own X-Trace-Id / X-Backend-Id). Hop-by-hop headers like
-# Content-Length are recomputed by the sender.
+# Content-Length are recomputed by the sender. ETag / Cache-Control /
+# X-Edge-Cache carry the backend edge cache's HTTP caching contract
+# through the router so browsers and CDNs fronting the FLEET revalidate
+# exactly like ones fronting a single backend.
 _FORWARD_HEADERS = ("Content-Type", "X-Image-Shape", "X-Image-Dtype",
-                    "X-Scene-Id", "Retry-After")
+                    "X-Scene-Id", "Retry-After", "ETag", "Cache-Control",
+                    "X-Edge-Cache")
 
 
 class _RouterHandler(BaseHTTPRequestHandler):
@@ -1095,7 +1146,8 @@ class _RouterHandler(BaseHTTPRequestHandler):
     try:
       status, headers, resp_body = self.router.forward_render(
           scene_id, body, accept=self.headers.get("Accept"),
-          trace_id=trace_id, trace=tr)
+          trace_id=trace_id, trace=tr,
+          if_none_match=self.headers.get("If-None-Match"))
     except KeyError as e:
       tr.finish(error=repr(e))
       self._send_json({"error": str(e)}, status=503, extra_headers=tid_hdr)

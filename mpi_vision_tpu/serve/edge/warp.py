@@ -1,0 +1,67 @@
+"""Near-miss frame warping: one homography from a cached frame's pose.
+
+The Stereo Magnification observation (PAPER.md) that makes the edge
+cache's warp tier cheap: a finished frame rendered at pose A is one
+plane-induced homography warp away from a good approximation of nearby
+pose B. Instead of re-running the full P-plane sweep composite, the
+cached RGB frame is treated as a single textured plane at a
+representative scene depth and resampled through exactly the machinery
+the renderer itself uses (``core.render.plane_homographies`` ->
+``warp_coordinates`` -> ``core.sampling.bilinear_sample``) — so the warp
+inherits the renderer's coordinate conventions and sampling parity
+rather than reimplementing them.
+
+The approximation error is parallax the single plane cannot express plus
+zero-filled disocclusions at the frame border; both grow with pose
+distance, which is why the serving layer only warps when the pose error
+is under the configured threshold and falls back to a real render past
+it. The warp is jitted per frame shape (steady-state serving pays one
+trace per scene resolution, then a few-ms CPU resample per near-miss).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mpi_vision_tpu.core import render, sampling
+
+
+@jax.jit
+def _warp(frame: jnp.ndarray, rel_pose: jnp.ndarray, intrinsics: jnp.ndarray,
+          plane_depth: jnp.ndarray) -> jnp.ndarray:
+  h, w, _ = frame.shape
+  homs = render.plane_homographies(
+      rel_pose[None], plane_depth[None], intrinsics[None])   # [1, 1, 3, 3]
+  # EXACT, not the reference-parity REF_HOMOGRAPHY: the cached frame is
+  # a finished image, and resampling it must be the identity at zero
+  # pose error — the parity quirk's half-pixel skew would blur every
+  # warp serve for no parity gain (nothing here is oracle-checked).
+  coords = render.warp_coordinates(
+      homs, h, w, convention=sampling.Convention.EXACT)      # [1,1,H,W,2]
+  return sampling.bilinear_sample(frame[None, None], coords)[0, 0]
+
+
+def warp_frame(frame: np.ndarray, src_pose: np.ndarray, tgt_pose: np.ndarray,
+               intrinsics: np.ndarray, plane_depth: float) -> np.ndarray:
+  """Resample a cached ``[H, W, 3]`` frame from ``src_pose`` to ``tgt_pose``.
+
+  ``src_pose``/``tgt_pose`` are the serving pose convention (reference-
+  camera -> camera transforms); ``plane_depth`` is the representative
+  depth the frame is treated as living at (the scene's geometric-mean
+  depth is a good stand-in for typical MPI depth ranges). Regions the
+  source frame never saw come back zero (``bilinear_sample``'s
+  padding), matching the renderer's own out-of-frustum behavior.
+  """
+  src = np.asarray(src_pose, np.float32)
+  tgt = np.asarray(tgt_pose, np.float32)
+  # Transform taking points in the cached camera's frame to the target
+  # camera's frame — the "tgt_pose" the renderer's homography solver
+  # expects when the cached frame plays the role of the reference MPI.
+  rel = (tgt.astype(np.float64) @ np.linalg.inv(
+      src.astype(np.float64))).astype(np.float32)
+  out = _warp(jnp.asarray(frame, jnp.float32), jnp.asarray(rel),
+              jnp.asarray(intrinsics, jnp.float32),
+              jnp.asarray([plane_depth], jnp.float32))
+  return np.asarray(out, np.float32)

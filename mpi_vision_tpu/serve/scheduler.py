@@ -123,6 +123,20 @@ class MicroBatcher:
       legacy blocking behavior: the dispatcher waits for each flight
       before assembling the next. >= 2 overlaps h2d/compute/readback
       across flights and completes out of dispatch order.
+    adaptive_inflight: grow ``max_inflight`` automatically (the
+      ``--max-inflight auto`` mode, PR-6 follow-on): every
+      ``adapt_every`` flights the mean device-idle gap per flight is
+      compared against the previous epoch's; while growing the window
+      keeps improving it by at least ``adapt_improve`` (fractionally),
+      the window grows by one, capped at ``max_inflight_cap``. The
+      first epoch always probes upward (there is nothing to compare
+      yet); a window whose device never idles, or whose growth stopped
+      paying, settles and stays put. The window only grows — shrinking
+      under a lull would just re-learn the same answer when load
+      returns.
+    max_inflight_cap: the adaptive mode's hard ceiling (completion
+      workers are pre-spawned to it, so growth never races thread
+      startup); defaults to ``max(max_inflight, 16)``.
     resilient: optional ``resilience.ResilientExecutor``; when set, every
       flight runs through its retry/breaker/watchdog machinery and an
       open breaker fast-fails submissions (``CircuitOpenError``) unless a
@@ -139,6 +153,9 @@ class MicroBatcher:
                metrics: ServeMetrics | None = None,
                max_batch: int = 8, max_wait_ms: float = 2.0,
                max_queue: int = 1024, max_inflight: int = 1,
+               adaptive_inflight: bool = False,
+               max_inflight_cap: int | None = None,
+               adapt_every: int = 32, adapt_improve: float = 0.05,
                resilient: ResilientExecutor | None = None,
                fallback_engine=None, fallback_scene_provider=None,
                clock=time.monotonic):
@@ -148,6 +165,14 @@ class MicroBatcher:
       raise ValueError(f"max_queue must be >= 1, got {max_queue}")
     if max_inflight < 1:
       raise ValueError(f"max_inflight must be >= 1, got {max_inflight}")
+    if max_inflight_cap is None:
+      max_inflight_cap = max(max_inflight, 16)
+    if max_inflight_cap < max_inflight:
+      raise ValueError(
+          f"max_inflight_cap {max_inflight_cap} < max_inflight "
+          f"{max_inflight}")
+    if adapt_every < 1:
+      raise ValueError(f"adapt_every must be >= 1, got {adapt_every}")
     if fallback_engine is not None and fallback_scene_provider is None:
       raise ValueError("fallback_engine requires fallback_scene_provider")
     self.engine = engine
@@ -157,6 +182,18 @@ class MicroBatcher:
     self.max_wait_s = max(max_wait_ms, 0.0) / 1e3
     self.max_queue = max_queue
     self.max_inflight = int(max_inflight)
+    self.adaptive_inflight = bool(adaptive_inflight)
+    self.max_inflight_cap = int(max_inflight_cap)
+    self._adapt_every = int(adapt_every)
+    self._adapt_improve = float(adapt_improve)
+    # Adaptive-epoch accumulators (guarded by _cond): gap seconds and
+    # flight count since the last decision, the previous epoch's mean
+    # gap per flight, and whether adaptation has settled for good.
+    self._adapt_gap_s = 0.0
+    self._adapt_flights = 0
+    self._adapt_prev: float | None = None
+    self._adapt_settled = not self.adaptive_inflight
+    self._adapt_epochs = 0
     self.resilient = resilient
     self.fallback_engine = fallback_engine
     self.fallback_scene_provider = fallback_scene_provider
@@ -187,10 +224,14 @@ class MicroBatcher:
       raise RuntimeError("MicroBatcher already started")
     self._thread = threading.Thread(target=self._loop,
                                     name="mpi-serve-dispatch", daemon=True)
+    # Adaptive mode pre-spawns workers for the whole cap: growth then
+    # only moves an integer bound, never races thread startup.
+    workers = (self.max_inflight_cap if self.adaptive_inflight
+               else self.max_inflight)
     self._completers = [
         threading.Thread(target=self._complete_loop,
                          name=f"mpi-serve-complete-{i}", daemon=True)
-        for i in range(self.max_inflight)]
+        for i in range(workers)]
     for t in self._completers:
       t.start()
     self._thread.start()
@@ -422,7 +463,10 @@ class MicroBatcher:
       flight.seq = self._seq
       self._seq += 1
       if self._inflight == 0 and self._last_done_t is not None:
-        self.metrics.record_dispatch_gap(self._clock() - self._last_done_t)
+        gap_s = self._clock() - self._last_done_t
+        self.metrics.record_dispatch_gap(gap_s)
+        if not self._adapt_settled:
+          self._adapt_gap_s += max(gap_s, 0.0)
       self._inflight += 1
       self._live_seqs.add(flight.seq)
       self.metrics.set_inflight(self._inflight)
@@ -446,7 +490,46 @@ class MicroBatcher:
       self._inflight -= 1
       self._last_done_t = self._clock()
       self.metrics.set_inflight(self._inflight)
+      if not self._adapt_settled:
+        self._adapt_flights += 1
+        if self._adapt_flights >= self._adapt_every:
+          cur = self._adapt_gap_s / self._adapt_flights
+          self.max_inflight, self._adapt_settled = self._next_window(
+              self._adapt_prev, cur, self.max_inflight,
+              self.max_inflight_cap, self._adapt_improve)
+          self._adapt_prev = cur
+          self._adapt_gap_s, self._adapt_flights = 0.0, 0
+          self._adapt_epochs += 1
       self._cond.notify_all()
+
+  @staticmethod
+  def _next_window(prev_gap: float | None, cur_gap: float, window: int,
+                   cap: int, min_improve: float) -> tuple[int, bool]:
+    """One adaptive-window decision: ``(next_window, settled)``.
+
+    Grow while growing keeps shrinking the mean device-idle gap per
+    flight by at least ``min_improve``; settle the first time it stops
+    (or the device never idles, or the cap is reached). Pure so the
+    policy is unit-testable without threads.
+    """
+    if window >= cap:
+      return window, True
+    if cur_gap <= 1e-9:
+      return window, True  # device never idles: the window is enough
+    if prev_gap is None:
+      return window + 1, False  # first epoch: nothing to compare, probe up
+    if cur_gap <= prev_gap * (1.0 - min_improve):
+      return window + 1, False
+    return window, True
+
+  def adaptive_snapshot(self) -> dict | None:
+    """The ``/stats`` adaptive block (None when the mode is off)."""
+    if not self.adaptive_inflight:
+      return None
+    with self._cond:
+      return {"settled": self._adapt_settled,
+              "cap": self.max_inflight_cap,
+              "epochs": self._adapt_epochs}
 
   def _loop(self) -> None:
     while True:

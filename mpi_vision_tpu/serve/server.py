@@ -50,6 +50,7 @@ from __future__ import annotations
 
 import base64
 import functools
+import hashlib
 import json
 import math
 import re
@@ -75,6 +76,7 @@ from mpi_vision_tpu.obs.trace import (
     new_trace_id,
 )
 from mpi_vision_tpu.serve import cache as cache_mod
+from mpi_vision_tpu.serve.edge import EdgeConfig, EdgeFrameCache, warp_frame
 from mpi_vision_tpu.serve.engine import RenderEngine
 from mpi_vision_tpu.serve.metrics import ServeMetrics
 from mpi_vision_tpu.serve.resilience import (
@@ -125,7 +127,17 @@ class RenderService:
     max_inflight: streaming-pipeline window (scheduler.py): concurrent
       flights whose h2d/compute/readback overlap and whose futures
       complete out of dispatch order. 1 = the legacy blocking dispatch
-      (the A/B baseline in ``bench/serve_load.py``).
+      (the A/B baseline in ``bench/serve_load.py``). The string
+      ``"auto"`` turns on adaptive sizing: the window starts at 2 and
+      grows while growing keeps improving the dispatch-gap metric,
+      capped at ``max_inflight_cap``.
+    max_inflight_cap: hard ceiling for ``max_inflight="auto"``.
+    edge: the pose-quantized edge frame cache (``serve/edge/``): None
+      (default) serves every request through the scheduler as before;
+      an ``EdgeConfig`` caches finished frames per view cell, serves
+      exact cell hits directly, warps near-misses off the nearest
+      cached frame, and gives the HTTP layer strong ETags /
+      ``If-None-Match`` -> 304 / ``Cache-Control`` (``render_edge``).
     method / use_mesh: renderer routing knobs (engine.py).
     resilience: retry/breaker/watchdog knobs (resilience.py); None turns
       the whole resilience layer off (raw PR-1 behavior).
@@ -175,12 +187,14 @@ class RenderService:
   """
 
   def __init__(self, cache_bytes: int = 2 << 30, max_batch: int = 8,
-               max_wait_ms: float = 2.0, max_inflight: int = 4,
+               max_wait_ms: float = 2.0, max_inflight: "int | str" = 4,
+               max_inflight_cap: int = 16,
                method: str = "fused",
                use_mesh: bool | None = None, max_queue: int = 1024,
                engine: RenderEngine | None = None,
                resilience: ResilienceConfig | None = ResilienceConfig(),
                cpu_fallback: str = "auto", fallback_engine=None,
+               edge: EdgeConfig | None = None,
                tracer: Tracer | None = None, profile_dir: str | None = None,
                profiler: DeviceProfiler | None = None,
                profile_hook=None, alert_hook=None,
@@ -194,13 +208,25 @@ class RenderService:
       # The fallback only engages through the resilience layer's breaker;
       # accepting the combination silently would drop an explicit knob.
       raise ValueError("cpu_fallback='on' requires resilience enabled")
-    if max_inflight < 1:
+    adaptive_inflight = max_inflight == "auto"
+    if adaptive_inflight:
+      if max_inflight_cap < 2:
+        raise ValueError(
+            f"max_inflight_cap must be >= 2 for auto, got {max_inflight_cap}")
+      max_inflight = 2  # the adaptive starting window
+    elif isinstance(max_inflight, str):
+      raise ValueError(
+          f"max_inflight must be an int or 'auto', got {max_inflight!r}")
+    elif max_inflight < 1:
       raise ValueError(f"max_inflight must be >= 1, got {max_inflight}")
+    self._clock = clock
     # The engine's own window must not be the bottleneck under retries
-    # (an abandoned attempt can briefly hold a slot next to its retry's).
+    # (an abandoned attempt can briefly hold a slot next to its retry's)
+    # nor under adaptive growth (size it for the cap, not the start).
+    engine_window = max_inflight_cap if adaptive_inflight else max_inflight
     self.engine = engine if engine is not None else RenderEngine(
         method=method, use_mesh=use_mesh,
-        max_inflight=max(8, 2 * max_inflight))
+        max_inflight=max(8, 2 * engine_window))
     self.cache = cache_mod.SceneCache(byte_budget=cache_bytes)
     self.metrics = ServeMetrics()
     self.events = events if events is not None else EventLog()
@@ -241,10 +267,32 @@ class RenderService:
         if self.fallback_engine is not None else None)
     self._scene_data: dict[str, tuple] = {}
     self._scene_lock = threading.Lock()
+    # The edge frame cache (serve/edge/): per-scene generation counters
+    # make the params digest change on every add_scene/swap_scenes, so a
+    # live reload orphans every cached cell of the old pixels; the base
+    # digest folds in the render-affecting engine identity so two
+    # differently-configured services never share frame identities.
+    self.edge = None if edge is None else EdgeFrameCache(edge)
+    self._scene_gen: dict[str, int] = {}
+    desc = self.engine.describe()
+    self._edge_base = hashlib.sha1(repr(tuple(
+        (k, desc.get(k))
+        for k in ("platform", "method", "sharded", "devices")
+    )).encode()).hexdigest()[:8]
+    if self.edge is not None:
+      self.events.emit(
+          "edge_cache_enabled",
+          trans_cell=self.edge.config.trans_cell,
+          rot_bucket_deg=self.edge.config.rot_bucket_deg,
+          warp_max_trans=self.edge.config.warp_max_trans,
+          warp_max_rot_deg=self.edge.config.warp_max_rot_deg,
+          byte_budget=self.edge.config.byte_budget)
     self.scheduler = MicroBatcher(
         self.engine, self._get_scene, metrics=self.metrics,
         max_batch=max_batch, max_wait_ms=max_wait_ms,
         max_queue=max_queue, max_inflight=max_inflight,
+        adaptive_inflight=adaptive_inflight,
+        max_inflight_cap=max_inflight_cap if adaptive_inflight else None,
         resilient=self.resilient,
         fallback_engine=self.fallback_engine,
         fallback_scene_provider=(
@@ -306,6 +354,12 @@ class RenderService:
              np.asarray(intrinsics, np.float32))
     with self._scene_lock:
       self._scene_data[str(scene_id)] = entry
+      # New content under this id: a fresh generation makes every edge
+      # frame digest of the old pixels unreachable.
+      self._scene_gen[str(scene_id)] = \
+          self._scene_gen.get(str(scene_id), 0) + 1
+    if self.edge is not None:
+      self.edge.invalidate_scene(str(scene_id))
 
   def add_synthetic_scenes(self, n: int, height: int = 256, width: int = 256,
                            planes: int = 16, seed: int = 0) -> list[str]:
@@ -371,14 +425,25 @@ class RenderService:
         for sid, (rgba, depths, k) in scenes.items()}
     with self._scene_lock:
       self._scene_data.update(entries)
+      for sid in entries:
+        self._scene_gen[sid] = self._scene_gen.get(sid, 0) + 1
     for sid in entries:
       self.cache.invalidate(sid)
       if self._fallback_cache is not None:
         self._fallback_cache.invalidate(sid)
+    swapped = sorted(entries)
+    if self.edge is not None:
+      # The edge cache invalidates exactly like the baked caches: a
+      # request racing the swap serves old pixels under the OLD etag or
+      # new pixels under a NEW one, never stale bytes under a fresh tag
+      # (the generation bump above already orphaned the old digests;
+      # the sweep frees their bytes).
+      dropped = sum(self.edge.invalidate_scene(sid) for sid in swapped)
+      self.events.emit("edge_cache_invalidated", scenes=swapped,
+                       frames=dropped)
     if prebake:
       for sid in entries:
         self._get_scene(sid)
-    swapped = sorted(entries)
     self.events.emit("scene_swap", scenes=swapped, prebake=bool(prebake))
     return swapped
 
@@ -437,6 +502,104 @@ class RenderService:
     """Non-blocking render; returns a ``concurrent.futures.Future``."""
     return self.scheduler.submit(scene_id, pose)
 
+  # -- edge frame cache ---------------------------------------------------
+
+  def _edge_meta(self, scene_id: str) -> tuple[str, np.ndarray, float]:
+    """``(params_digest, intrinsics, plane_depth)`` for one scene.
+
+    The digest is the edge cache-key component: engine identity + the
+    scene's generation, so any content change (add_scene, swap_scenes,
+    live ckpt reload) retires every previously cached cell. Raises
+    ``KeyError`` for unknown scenes (the same 404 contract as the
+    scheduler path — a cache in front must not invent scenes).
+    """
+    sid = str(scene_id)
+    with self._scene_lock:
+      entry = self._scene_data.get(sid)
+      if entry is None:
+        raise KeyError(f"unknown scene {sid!r}")
+      gen = self._scene_gen.get(sid, 0)
+      depths, intrinsics = entry[1], entry[2]
+    # Representative warp depth: the geometric mean of the scene's depth
+    # range — the single plane that splits typical MPI content evenly.
+    d_near, d_far = float(depths.min()), float(depths.max())
+    return (f"{self._edge_base}:g{gen}", intrinsics,
+            math.sqrt(max(d_near, 1e-6) * max(d_far, 1e-6)))
+
+  def render_edge(self, scene_id: str, pose, timeout: float = 60.0,
+                  trace=NULL_TRACE) -> tuple[np.ndarray, dict]:
+    """Render through the edge frame cache -> ``(image, info)``.
+
+    ``info``: ``{"edge": "off" | "hit" | "warp" | "miss", "etag":
+    str | None, "max_age_s": int | None}``. Exact cell hits return the
+    stored frame (READ-ONLY — it is shared with every other hit) with
+    its strong ETag; near-misses return a fresh single-homography warp
+    of the nearest cached frame (pose-specific, so no ETag); misses
+    render through the scheduler and populate the cell. Hit and warp
+    latencies are recorded into the same request metrics/SLO stream as
+    rendered ones — the p50 drop IS the feature, it must be visible in
+    ``/stats``. With the edge cache disabled this is exactly
+    ``render`` (plus the ``"off"`` info), so callers can wire one path.
+    """
+    if self.edge is None:
+      return (self.scheduler.render(scene_id, pose, timeout=timeout,
+                                    trace=trace),
+              {"edge": "off", "etag": None, "max_age_s": None})
+    t0 = self._clock()
+    try:
+      # Everything before the scheduler hand-off owns the trace's error
+      # edge: a 404 (unknown scene) or a failing warp happens entirely
+      # up here, and the handler's promise that every X-Trace-Id
+      # resolves in /debug/traces must hold for those too. Past the
+      # hand-off the flight finishes the trace (finish is idempotent).
+      pose = np.asarray(pose, np.float32)
+      digest, intrinsics, plane_depth = self._edge_meta(scene_id)
+      max_age = self.edge.config.max_age_s
+      kind, entry, cell = self.edge.lookup(scene_id, digest, pose)
+      if kind == "hit":
+        span = trace.start_span("edge_hit", cell=list(cell))
+        trace.end_span(span)
+        self.metrics.record_request(self._clock() - t0, scene_id=scene_id)
+        trace.finish()
+        return entry.frame, {"edge": "hit", "etag": entry.etag,
+                             "max_age_s": max_age}
+      if kind == "warp":
+        span = trace.start_span("edge_warp", cell=list(cell),
+                                from_cell=list(entry.cell))
+        img = warp_frame(entry.frame, entry.pose, pose, entry.intrinsics,
+                         entry.plane_depth)
+        trace.end_span(span)
+        self.metrics.record_request(self._clock() - t0, scene_id=scene_id)
+        trace.finish()
+        return img, {"edge": "warp", "etag": None, "max_age_s": max_age}
+    except Exception as e:
+      trace.finish(error=repr(e))
+      raise
+    # Miss: a real render (latency recorded by the scheduler as usual),
+    # then populate the cell. First writer wins — serving the RESIDENT
+    # entry's frame keeps every response consistent with the cell's one
+    # strong ETag even when two misses race.
+    img = self.scheduler.render(scene_id, pose, timeout=timeout,
+                                trace=trace)
+    entry = self.edge.put(scene_id, digest, cell, pose, img, intrinsics,
+                          plane_depth)
+    return entry.frame, {"edge": "miss", "etag": entry.etag,
+                         "max_age_s": max_age}
+
+  def edge_revalidate(self, scene_id: str, pose,
+                      if_none_match: str | None) -> str | None:
+    """The matching strong ETag when ``if_none_match`` still identifies
+    the request's view cell (HTTP 304: skip the render AND the body),
+    else None. Unknown scenes return None — the render path owns 404."""
+    if self.edge is None or not if_none_match:
+      return None
+    try:
+      digest, _, _ = self._edge_meta(scene_id)
+    except KeyError:
+      return None
+    return self.edge.revalidate(scene_id, digest, np.asarray(pose, np.float32),
+                                if_none_match)
+
   # -- observability ------------------------------------------------------
 
   def _render_metrics_text(self) -> str:
@@ -478,6 +641,11 @@ class RenderService:
     out = self.metrics.snapshot(cache_stats=self.cache.stats())
     out.setdefault("pipeline", {})["max_inflight"] = \
         self.scheduler.max_inflight
+    adaptive = self.scheduler.adaptive_snapshot()
+    if adaptive is not None:
+      out["pipeline"]["adaptive"] = adaptive
+    if self.edge is not None:
+      out["edge"] = self.edge.stats()
     out["engine"] = self.engine.describe()
     if self.resilient is not None:
       out["breaker"] = self.resilient.breaker.snapshot()
@@ -750,6 +918,19 @@ class _Handler(BaseHTTPRequestHandler):
       self.service.metrics.record_client_disconnect()
       self.close_connection = True
       return
+    edge_on = self.service.edge is not None
+    if edge_on:
+      # Revalidation BEFORE any render work: a matching strong ETag
+      # means the client's cached bytes are still exactly the cell's
+      # resident frame, so the whole request costs one dict lookup.
+      etag = self.service.edge_revalidate(
+          scene_id, pose, self.headers.get("If-None-Match"))
+      if etag is not None:
+        max_age = self.service.edge.config.max_age_s
+        self._send_bytes(b"", status=304, extra_headers={
+            "ETag": etag, "Cache-Control": f"max-age={max_age}",
+            "X-Edge-Cache": "revalidated", **tid_hdr})
+        return
     # The handler owns the trace (not render_traced) so error responses
     # carry the same id the recorded trace has in /debug/traces.
     tr = self.service.tracer.start_trace("render", trace_id=inbound_tid,
@@ -757,7 +938,15 @@ class _Handler(BaseHTTPRequestHandler):
     if tr.trace_id:
       tid_hdr = {"X-Trace-Id": tr.trace_id}
     try:
-      img = self.service.render(scene_id, pose, trace=tr)
+      if edge_on:
+        img, edge_info = self.service.render_edge(scene_id, pose, trace=tr)
+        tid_hdr = dict(tid_hdr)
+        tid_hdr["X-Edge-Cache"] = edge_info["edge"]
+        tid_hdr["Cache-Control"] = f"max-age={edge_info['max_age_s']}"
+        if edge_info["etag"] is not None:
+          tid_hdr["ETag"] = edge_info["etag"]
+      else:
+        img = self.service.render(scene_id, pose, trace=tr)
     except KeyError as e:
       self._send_json({"error": str(e)}, status=404,
                       extra_headers=tid_hdr)

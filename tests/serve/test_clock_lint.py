@@ -30,6 +30,7 @@ import mpi_vision_tpu.ckpt
 import mpi_vision_tpu.obs
 import mpi_vision_tpu.serve
 import mpi_vision_tpu.serve.cluster
+import mpi_vision_tpu.serve.edge
 import mpi_vision_tpu.train.loop
 import mpi_vision_tpu.train.telemetry
 
@@ -43,7 +44,8 @@ def _package_sources(pkg):
 
 def _linted_sources():
   for pkg in (mpi_vision_tpu.serve, mpi_vision_tpu.serve.cluster,
-              mpi_vision_tpu.obs, mpi_vision_tpu.ckpt):
+              mpi_vision_tpu.serve.edge, mpi_vision_tpu.obs,
+              mpi_vision_tpu.ckpt):
     yield from _package_sources(pkg)
   yield pathlib.Path(mpi_vision_tpu.train.loop.__file__)
   yield pathlib.Path(mpi_vision_tpu.train.telemetry.__file__)
@@ -73,6 +75,7 @@ def test_lint_covers_the_ckpt_package_and_train_loop():
           "serve/engine.py", "serve/scheduler.py", "serve/metrics.py",
           "train/loop.py", "train/telemetry.py", "cluster/router.py",
           "cluster/ring.py", "cluster/pool.py", "cluster/supervisor.py",
+          "edge/cache.py", "edge/lattice.py", "edge/warp.py",
           "obs/slo.py", "obs/events.py", "obs/trace.py",
           "obs/prom.py"} <= rel
 

@@ -466,6 +466,84 @@ def test_router_stats_fanout_feeds_the_load_table():
   assert depths == {"a": 7.0, "b": 1.0}
 
 
+# --- router-side client-perceived SLO ------------------------------------
+
+
+def test_router_slo_counts_failures_the_backends_never_see():
+  """Client-perceived availability: a 502 from an exhausted replica walk
+  is a failure NO backend tracker recorded (the backends were dead) —
+  the router's own SloTracker must count it, next to the successes."""
+  transport = FakeTransport()
+  transport.set("hostA:1", lambda m, p, b, h: _good_render("s"))
+  transport.set("hostB:1", lambda m, p, b, h: _good_render("s"))
+  router = _two_backend_router(transport)
+  sid, body = _scene_with_primary(router, "a")
+  for _ in range(8):
+    router.forward_render(sid, body)
+  transport.set("hostA:1", _dead)
+  transport.set("hostB:1", _dead)
+  for _ in range(3):
+    # The first walk exhausts the replicas (502); the failures open both
+    # breakers, so later walks fast-fail (503) — ALL are client-
+    # perceived failures the backend trackers never saw.
+    with pytest.raises((ReplicasExhaustedError, AllReplicasOpenError)):
+      router.forward_render(sid, body)
+  snap = router.slo.snapshot()
+  slow = snap["objectives"]["availability"]["slow"]
+  assert slow["requests"] == 11 and slow["bad"] == 3
+  # Completed requests carry an end-to-end latency sample too.
+  assert snap["objectives"]["latency"]["slow"]["requests"] == 8
+
+
+def test_router_stats_slo_block_carries_the_router_stream():
+  transport = FakeTransport()
+
+  def minimal(method, path, body, headers):
+    if path == "/render":
+      return _good_render("s")
+    return 200, {}, b"{}"
+
+  transport.set("hostA:1", minimal)
+  transport.set("hostB:1", minimal)
+  router = _two_backend_router(transport)
+  sid, body = _scene_with_primary(router, "a")
+  router.forward_render(sid, body)
+  slo = router.stats()["slo"]
+  assert slo["router"]["objectives"]["availability"]["slow"]["requests"] == 1
+  # The fleet summary distilled from the backends still sits beside it.
+  assert "attainment" in slo and "backends_reporting" in slo
+
+
+def test_router_forwards_if_none_match_and_edge_headers():
+  """The router is a pure conditional-request conduit: the client's
+  If-None-Match reaches the backend, and the backend's ETag /
+  Cache-Control / X-Edge-Cache ride back through the HTTP front end's
+  forwarded headers (a 304 is an answered status, not a failure)."""
+  transport = FakeTransport()
+  seen = {}
+
+  def edge_backend(method, path, body, headers):
+    seen.update(headers)
+    if headers.get("If-None-Match") == '"tag123"':
+      return 304, {"ETag": '"tag123"', "Cache-Control": "max-age=5",
+                   "X-Edge-Cache": "revalidated"}, b""
+    return _good_render("s")
+
+  transport.set("hostA:1", edge_backend)
+  transport.set("hostB:1", edge_backend)
+  router = _two_backend_router(transport)
+  sid, body = _scene_with_primary(router, "a")
+  status, headers, resp_body = router.forward_render(
+      sid, body, if_none_match='"tag123"')
+  assert seen.get("If-None-Match") == '"tag123"'
+  assert status == 304 and resp_body == b""
+  assert headers["ETag"] == '"tag123"'
+  # The 304 counted as a healthy answer: breaker closed, SLO good.
+  assert router.breaker_state("a") == "closed"
+  assert router.slo.snapshot()[
+      "objectives"]["availability"]["slow"]["bad"] == 0
+
+
 # --- concurrent fan-out (a slow backend must not stall the scrape) -------
 
 

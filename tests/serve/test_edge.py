@@ -1,0 +1,337 @@
+"""Edge frame cache (serve/edge/): lattice, cache, warp, HTTP semantics.
+
+The acceptance pins from the edge-cache issue live here: (1) an
+exact-cell hit serves bytes bit-identical to the cell's first real
+render; (2) a near-miss is served by warping a cached frame only when
+the pose error is under the configured thresholds; (3) ``swap_scenes``
+invalidates cached frames — no frame of the old pixels survives a live
+reload, and the post-swap response is bit-identical to a fresh render;
+(4) strong-ETag revalidation answers 304 over real HTTP and stops
+matching after a swap.
+
+Scenes stay at the suite's shared 16x16x4 shape so the XLA compiles are
+reused from the other serve tests.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mpi_vision_tpu.serve import RenderService, make_http_server
+from mpi_vision_tpu.serve.edge import (
+    EdgeConfig,
+    EdgeFrameCache,
+    pose_error,
+    quantize_pose,
+    warp_frame,
+)
+from mpi_vision_tpu.serve.server import synthetic_scene
+
+H = W = 16
+P = 4
+
+
+def _pose(tx=0.0, ty=0.0, tz=0.0, yaw_deg=0.0):
+  pose = np.eye(4, dtype=np.float32)
+  if yaw_deg:
+    a = np.radians(yaw_deg)
+    pose[0, 0] = pose[2, 2] = np.cos(a)
+    pose[0, 2], pose[2, 0] = np.sin(a), -np.sin(a)
+  pose[:3, 3] = (tx, ty, tz)
+  return pose
+
+
+# --- lattice -------------------------------------------------------------
+
+
+def test_quantize_pose_is_stable_within_a_cell():
+  cell = quantize_pose(_pose(0.011, 0.0, 0.0), 0.01, 2.0)
+  assert quantize_pose(_pose(0.019, 0.0, 0.0), 0.01, 2.0) == cell
+  assert quantize_pose(_pose(0.021, 0.0, 0.0), 0.01, 2.0) != cell
+  assert quantize_pose(_pose(0.011, yaw_deg=3.0), 0.01, 2.0) != cell
+  # Rotations inside one bucket share the cell.
+  assert (quantize_pose(_pose(yaw_deg=0.5), 0.01, 2.0)
+          == quantize_pose(_pose(yaw_deg=1.4), 0.01, 2.0))
+
+
+def test_pose_error_translation_and_rotation():
+  trans, rot = pose_error(_pose(0.03), _pose(0.0))
+  assert trans == pytest.approx(0.03, abs=1e-6)
+  assert rot == pytest.approx(0.0, abs=1e-4)
+  trans, rot = pose_error(_pose(yaw_deg=5.0), _pose())
+  assert trans == pytest.approx(0.0, abs=1e-6)
+  assert rot == pytest.approx(5.0, abs=1e-3)
+
+
+# --- cache ---------------------------------------------------------------
+
+
+def _frame(fill=0.5, h=4, w=4):
+  return np.full((h, w, 3), fill, np.float32)
+
+
+def _cache(**overrides):
+  kwargs = dict(trans_cell=0.01, rot_bucket_deg=2.0, warp_max_trans=0.05,
+                warp_max_rot_deg=4.0, byte_budget=1 << 20)
+  kwargs.update(overrides)
+  return EdgeFrameCache(EdgeConfig(**kwargs))
+
+
+def test_cache_hit_warp_miss_classification():
+  cache = _cache()
+  k = np.eye(3, dtype=np.float32)
+  kind, entry, cell = cache.lookup("s", "d", _pose(0.001))
+  assert kind == "miss" and entry is None
+  put = cache.put("s", "d", cell, _pose(0.001), _frame(), k, 10.0)
+  # Exact cell (different pose inside it) -> hit on the stored entry.
+  kind, entry, _ = cache.lookup("s", "d", _pose(0.009))
+  assert kind == "hit" and entry.etag == put.etag
+  # Neighboring cell inside the warp thresholds -> warp off it.
+  kind, entry, _ = cache.lookup("s", "d", _pose(0.03))
+  assert kind == "warp" and entry.etag == put.etag
+  # Beyond the warp radius -> miss.
+  kind, entry, _ = cache.lookup("s", "d", _pose(0.2))
+  assert kind == "miss" and entry is None
+  # A different params digest never matches.
+  kind, _, _ = cache.lookup("s", "other", _pose(0.001))
+  assert kind == "miss"
+  stats = cache.stats()
+  assert (stats["hits"], stats["warp_serves"], stats["misses"]) == (1, 1, 3)
+  assert stats["hit_rate"] == pytest.approx(0.4)
+
+
+def test_cache_warp_picks_the_nearest_entry():
+  cache = _cache()
+  k = np.eye(3, dtype=np.float32)
+  for tx in (0.0, 0.045):
+    _, _, cell = cache.lookup("s", "d", _pose(tx))
+    cache.put("s", "d", cell, _pose(tx), _frame(tx), k, 10.0)
+  kind, entry, _ = cache.lookup("s", "d", _pose(0.035))
+  assert kind == "warp"
+  assert float(entry.pose[0, 3]) == pytest.approx(0.045)
+
+
+def test_cache_put_is_first_writer_wins():
+  cache = _cache()
+  k = np.eye(3, dtype=np.float32)
+  _, _, cell = cache.lookup("s", "d", _pose())
+  first = cache.put("s", "d", cell, _pose(), _frame(0.1), k, 10.0)
+  second = cache.put("s", "d", cell, _pose(0.004), _frame(0.9), k, 10.0)
+  assert second is first  # the resident entry (and its ETag) stand
+
+
+def test_cache_byte_budget_evicts_lru():
+  one = _frame().nbytes
+  cache = _cache(byte_budget=3 * one)  # ~2 entries + metadata
+  k = np.eye(3, dtype=np.float32)
+  cells = []
+  for i, tx in enumerate((0.0, 0.1, 0.2)):
+    _, _, cell = cache.lookup("s", "d", _pose(tx))
+    cells.append(cell)
+    cache.put("s", "d", cell, _pose(tx), _frame(i * 0.1), k, 10.0)
+  stats = cache.stats()
+  assert stats["evictions"] >= 1 and stats["bytes"] <= 3 * one
+  # The oldest cell was the victim; the newest survives.
+  with cache._lock:
+    assert ("s", "d", cells[0]) not in cache._entries
+    assert ("s", "d", cells[-1]) in cache._entries
+
+
+def test_cache_invalidate_scene_drops_all_digests():
+  cache = _cache()
+  k = np.eye(3, dtype=np.float32)
+  for digest in ("d1", "d2"):
+    _, _, cell = cache.lookup("s", digest, _pose())
+    cache.put("s", digest, cell, _pose(), _frame(), k, 10.0)
+  _, _, cell = cache.lookup("other", "d1", _pose())
+  cache.put("other", "d1", cell, _pose(), _frame(), k, 10.0)
+  assert cache.invalidate_scene("s") == 2
+  assert len(cache) == 1 and cache.stats()["invalidations"] == 2
+  assert cache.lookup("s", "d1", _pose())[0] == "miss"
+  assert cache.lookup("other", "d1", _pose())[0] == "hit"
+
+
+def test_cache_revalidate_only_matches_resident_entries():
+  cache = _cache()
+  k = np.eye(3, dtype=np.float32)
+  _, _, cell = cache.lookup("s", "d", _pose())
+  entry = cache.put("s", "d", cell, _pose(), _frame(), k, 10.0)
+  assert cache.revalidate("s", "d", _pose(0.004), entry.etag) == entry.etag
+  assert cache.revalidate("s", "d", _pose(), '"bogus"') is None
+  assert cache.revalidate("s", "d", _pose(), f'"bogus", {entry.etag}') \
+      == entry.etag
+  cache.invalidate_scene("s")
+  assert cache.revalidate("s", "d", _pose(), entry.etag) is None
+  assert cache.stats()["revalidations"] == 2
+
+
+# --- warp ----------------------------------------------------------------
+
+
+def test_warp_frame_identity_pose_is_near_exact():
+  rng = np.random.default_rng(0)
+  frame = rng.uniform(0, 1, (H, W, 3)).astype(np.float32)
+  k = np.asarray([[8.0, 0, 8.0], [0, 8.0, 8.0], [0, 0, 1]], np.float32)
+  out = warp_frame(frame, _pose(0.01), _pose(0.01), k, 10.0)
+  np.testing.assert_allclose(out, frame, atol=1e-5)
+
+
+# --- service integration -------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def svc():
+  service = RenderService(
+      max_batch=4, max_wait_ms=5.0, use_mesh=False,
+      edge=EdgeConfig(trans_cell=0.02, rot_bucket_deg=2.0,
+                      warp_max_trans=0.06, warp_max_rot_deg=4.0,
+                      byte_budget=64 << 20))
+  service.add_synthetic_scenes(3, height=H, width=W, planes=P)
+  yield service
+  service.close()
+
+
+def test_exact_cell_hit_is_bit_identical_to_its_first_render(svc):
+  img1, info1 = svc.render_edge("scene_000", _pose(0.001))
+  assert info1["edge"] == "miss" and info1["etag"]
+  # The populated frame IS a real render: bit-identical to the
+  # scheduler path for the same pose.
+  direct = svc.render("scene_000", _pose(0.001))
+  assert direct.tobytes() == img1.tobytes()
+  img2, info2 = svc.render_edge("scene_000", _pose(0.001))
+  assert info2["edge"] == "hit" and info2["etag"] == info1["etag"]
+  assert img2.tobytes() == img1.tobytes()
+  # A different pose in the same cell shares the cell's bytes.
+  img3, info3 = svc.render_edge("scene_000", _pose(0.004))
+  assert info3["edge"] == "hit" and img3.tobytes() == img1.tobytes()
+
+
+def test_near_miss_is_warp_served_under_the_threshold(svc):
+  base = _pose(0.0, 0.0, 0.3)
+  img0, info0 = svc.render_edge("scene_001", base)
+  assert info0["edge"] == "miss"
+  # Adjacent cell, pose error 0.025 < warp_max_trans 0.06 -> warp.
+  near = _pose(0.025, 0.0, 0.3)
+  img1, info1 = svc.render_edge("scene_001", near)
+  assert info1["edge"] == "warp" and info1["etag"] is None
+  trans, rot = pose_error(near, base)
+  assert trans <= svc.edge.config.warp_max_trans
+  assert rot <= svc.edge.config.warp_max_rot_deg
+  # The warp is a real resample toward the requested pose: finite,
+  # frame-shaped, and not the source frame's bytes.
+  assert img1.shape == img0.shape and np.isfinite(img1).all()
+  assert img1.tobytes() != img0.tobytes()
+  # Beyond the radius: a real render populates the new cell.
+  far = _pose(0.0, 0.0, -0.4)
+  _, info2 = svc.render_edge("scene_001", far)
+  assert info2["edge"] == "miss"
+
+
+def test_swap_scenes_invalidates_and_repopulates_bit_exact(svc):
+  pose = _pose(0.002, 0.0, 0.1)
+  old, info_old = svc.render_edge("scene_002", pose)
+  assert info_old["edge"] == "miss"
+  before = svc.events.count("edge_cache_invalidated")
+  svc.swap_scenes(
+      {"scene_002": synthetic_scene("scene_002", H, W, P, seed=123)})
+  assert svc.events.count("edge_cache_invalidated") == before + 1
+  new, info_new = svc.render_edge("scene_002", pose)
+  # No frame from the old checkpoint survives: fresh render, fresh tag.
+  assert info_new["edge"] == "miss" and info_new["etag"] != info_old["etag"]
+  assert new.tobytes() != old.tobytes()
+  assert svc.render("scene_002", pose).tobytes() == new.tobytes()
+  assert svc.stats()["edge"]["invalidations"] >= 1
+
+
+def test_render_edge_unknown_scene_raises_keyerror(svc):
+  from mpi_vision_tpu.obs.trace import Tracer
+
+  tracer = Tracer()
+  tr = tracer.start_trace("render", scene_id="nope")
+  with pytest.raises(KeyError, match="nope"):
+    svc.render_edge("nope", _pose(), trace=tr)
+  # The error path owns the trace: it must land finished (with the
+  # error) in the tracer, upholding the X-Trace-Id contract.
+  assert tracer.finished == 1
+  assert "nope" in tracer.snapshot()["recent"][-1]["error"]
+
+
+def test_render_edge_hit_finishes_its_trace(svc):
+  from mpi_vision_tpu.obs.trace import Tracer
+
+  tracer = Tracer()
+  pose = _pose(0.3, 0.0, 0.0)
+  svc.render_edge("scene_000", pose,
+                  trace=tracer.start_trace("render", scene_id="scene_000"))
+  svc.render_edge("scene_000", pose,
+                  trace=tracer.start_trace("render", scene_id="scene_000"))
+  assert tracer.finished == 2  # miss (flight-finished) AND hit
+  names = {s["name"] for t in tracer.snapshot()["recent"] for s in t["spans"]}
+  assert "edge_hit" in names
+
+
+def test_stats_and_metrics_expose_edge_families(svc):
+  stats = svc.stats()
+  assert {"hits", "warp_serves", "misses", "revalidations", "bytes",
+          "frames", "invalidations", "hit_rate"} <= set(stats["edge"])
+  text = svc.metrics_text()
+  for family in ("mpi_serve_edge_hits_total", "mpi_serve_edge_misses_total",
+                 "mpi_serve_edge_warp_serves_total", "mpi_serve_edge_bytes",
+                 "mpi_serve_edge_revalidations_total"):
+    assert family in text
+
+
+# --- HTTP revalidation ---------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def http_base(svc):
+  httpd = make_http_server(svc)
+  threading.Thread(target=httpd.serve_forever, daemon=True).start()
+  yield f"http://127.0.0.1:{httpd.server_address[1]}"
+  httpd.shutdown()
+
+
+def _post(base, payload, headers=None):
+  req = urllib.request.Request(base + "/render",
+                               data=json.dumps(payload).encode(),
+                               headers=headers or {})
+  try:
+    with urllib.request.urlopen(req) as resp:
+      return resp.status, dict(resp.headers), resp.read()
+  except urllib.error.HTTPError as e:
+    with e:
+      return e.code, dict(e.headers), e.read()
+
+
+def test_http_304_revalidation_roundtrip(svc, http_base):
+  body = {"scene_id": "scene_000",
+          "pose": _pose(0.0, 0.3, 0.0).tolist()}
+  status, headers, payload = _post(http_base, body)
+  assert status == 200 and headers["X-Edge-Cache"] == "miss"
+  etag = headers["ETag"]
+  assert etag.startswith('"') and headers["Cache-Control"] == "max-age=5"
+  assert json.loads(payload)["scene_id"] == "scene_000"
+  # Unconditional repeat: a 200 exact hit under the same strong tag.
+  status, headers, _ = _post(http_base, body)
+  assert status == 200 and headers["X-Edge-Cache"] == "hit"
+  assert headers["ETag"] == etag
+  # Conditional repeat: 304, empty body, no render.
+  revalidations = svc.stats()["edge"]["revalidations"]
+  status, headers, payload = _post(http_base, body,
+                                   {"If-None-Match": etag})
+  assert status == 304 and payload == b""
+  assert headers["ETag"] == etag
+  assert headers["X-Edge-Cache"] == "revalidated"
+  assert svc.stats()["edge"]["revalidations"] == revalidations + 1
+  # After a live reload the old tag stops validating: full 200, new tag.
+  svc.swap_scenes(
+      {"scene_000": synthetic_scene("scene_000", H, W, P, seed=77)})
+  status, headers, payload = _post(http_base, body,
+                                   {"If-None-Match": etag})
+  assert status == 200 and headers["X-Edge-Cache"] == "miss"
+  assert headers["ETag"] != etag and payload

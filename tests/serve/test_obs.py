@@ -673,3 +673,59 @@ def test_permanent_bake_fault_fails_request_with_bake_span_error():
     assert img.shape == (H, W, 3)
   finally:
     svc.close()
+
+
+# --- event-log retention (file_sink rotation) ----------------------------
+
+
+def test_file_sink_rotates_at_max_bytes_and_keeps_k(tmp_path):
+  from mpi_vision_tpu.obs.events import EventLog, file_sink
+
+  path = str(tmp_path / "events.jsonl")
+  sink = file_sink(path, max_bytes=300, keep=2)
+  log = EventLog(sink=sink)
+  for i in range(60):
+    log.emit("tick", i=i)
+  assert sink.rotations >= 2 and sink.rotate_errors == 0
+  files = sorted(p.name for p in tmp_path.iterdir())
+  # The live file plus at most `keep` rotated generations; no .3 ever.
+  assert "events.jsonl" in files and "events.jsonl.1" in files
+  assert "events.jsonl.3" not in files
+  assert (tmp_path / "events.jsonl").stat().st_size < 300 + 200
+  # Every retained line is still intact JSON (rotation never tears one).
+  for name in files:
+    for line in (tmp_path / name).read_text().splitlines():
+      json.loads(line)
+  # The newest event survived the rotation churn: it is the last line of
+  # the live file, or of ".1" when the final write itself rotated.
+  lines = (tmp_path / "events.jsonl").read_text().splitlines() \
+      or (tmp_path / "events.jsonl.1").read_text().splitlines()
+  assert json.loads(lines[-1])["i"] == 59
+
+
+def test_file_sink_rotation_failure_is_counted_never_fatal(
+    tmp_path, monkeypatch):
+  from mpi_vision_tpu.obs import events as events_mod
+
+  path = str(tmp_path / "events.jsonl")
+  sink = events_mod.file_sink(path, max_bytes=120, keep=2)
+  log = events_mod.EventLog(sink=sink)
+  monkeypatch.setattr(events_mod.os, "replace",
+                      lambda *a: (_ for _ in ()).throw(OSError("disk")))
+  for i in range(20):
+    log.emit("tick", i=i)  # must not raise
+  assert sink.rotate_errors > 0
+  # The sink never raised into the log (rotation is not a sink error)
+  # and events kept landing in the (over-size) live file.
+  assert log.sink_errors == 0
+  lines = (tmp_path / "events.jsonl").read_text().splitlines()
+  assert json.loads(lines[-1])["i"] == 19
+
+
+def test_file_sink_validates_retention_knobs(tmp_path):
+  from mpi_vision_tpu.obs.events import file_sink
+
+  with pytest.raises(ValueError, match="max_bytes"):
+    file_sink(str(tmp_path / "e.jsonl"), max_bytes=0)
+  with pytest.raises(ValueError, match="keep"):
+    file_sink(str(tmp_path / "e.jsonl"), max_bytes=100, keep=0)

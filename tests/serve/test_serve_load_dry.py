@@ -111,6 +111,28 @@ def test_serve_load_ab_dry_smoke():
   assert blocking["out_of_order_completions"] == 0
 
 
+def test_serve_load_edge_ab_dry_smoke():
+  """The edge-cache A/B smoke: Zipf-distributed poses served through the
+  pose-quantized frame cache, then through the raw path, one JSON line.
+  Pins the contract (both arms + hit/warp/miss split + p50 fields) and
+  that the cache really served the bulk of the Zipf traffic — not a
+  dry-mode p50 ordering, which toy scenes could flip on noise."""
+  out = _run_dry(["--edge-ab", "--zipf-poses", "16"])
+  assert out["metric"] == "serve_load_edge_ab" and out["dry"] is True
+  assert out["device"] == "cpu" and out["zipf_poses"] == 16
+  assert out["p50_ms_edge_on"] > 0 and out["p50_ms_edge_off"] > 0
+  assert out["value"] and out["value"] > 0
+  # The Zipf pool repeats poses, so the cache must have absorbed most
+  # lookups (hits + warp serves), with the counts in the report.
+  assert out["hits"] + out["warp_serves"] + out["misses"] > 0
+  assert out["misses"] >= 1  # cells had to populate
+  assert out["hit_rate"] > 0.5
+  edge_on = out["edge_on"]
+  assert edge_on["edge"]["hit_rate"] == out["hit_rate"]
+  assert edge_on["requests"] > 0 and out["edge_off"]["requests"] > 0
+  assert "edge" not in out["edge_off"]
+
+
 def test_serve_load_cluster_dry_smoke():
   """The multi-host tier's tier-1 smoke: spawn real backend processes,
   route through the cluster Router, SIGKILL one backend mid-window, and

@@ -274,3 +274,55 @@ def test_abandoned_flight_is_counted_and_engine_slot_freed():
   finally:
     eng.release.set()
     svc.close()
+
+
+# --- adaptive in-flight window (--max-inflight auto) ---------------------
+
+
+def test_adaptive_window_decision_logic():
+  """The pure growth policy: probe upward first, keep growing while the
+  mean device-idle gap per flight improves >= 5%, settle when it stops,
+  when the device never idles, or at the cap."""
+  from mpi_vision_tpu.serve.scheduler import MicroBatcher
+
+  nw = MicroBatcher._next_window
+  assert nw(None, 0.05, 2, 8, 0.05) == (3, False)   # first epoch: probe
+  assert nw(0.05, 0.04, 3, 8, 0.05) == (4, False)   # improving: grow
+  assert nw(0.04, 0.039, 4, 8, 0.05) == (4, True)   # <5% better: settle
+  assert nw(0.04, 0.05, 4, 8, 0.05) == (4, True)    # worse: settle
+  assert nw(0.04, 0.0, 4, 8, 0.05) == (4, True)     # never idle: settle
+  assert nw(0.01, 0.001, 8, 8, 0.05) == (8, True)   # at cap: settle
+
+
+def test_adaptive_service_grows_within_cap_and_serves():
+  """``max_inflight="auto"``: the window starts at 2, every request
+  still renders correctly, and after enough flights the window sits in
+  [2, cap] with the adaptive block visible in /stats."""
+  svc = RenderService(max_inflight="auto", max_inflight_cap=4,
+                      max_batch=2, max_wait_ms=0.0, use_mesh=False)
+  svc.add_synthetic_scenes(1, height=H, width=W, planes=P)
+  try:
+    # Drive enough flights (max_batch 2, serial submits => 1 per flight)
+    # to cross at least one 32-flight adaptation epoch.
+    svc.scheduler._adapt_every = 8
+    reference = svc.render("scene_000", _pose(0.01))
+    for _ in range(20):
+      out = svc.render("scene_000", _pose(0.01))
+    assert out.tobytes() == reference.tobytes()
+    stats = svc.stats()
+    adaptive = stats["pipeline"]["adaptive"]
+    assert adaptive["cap"] == 4 and adaptive["epochs"] >= 1
+    assert 2 <= stats["pipeline"]["max_inflight"] <= 4
+    assert svc.scheduler.dispatcher_alive()
+  finally:
+    svc.close()
+
+
+def test_adaptive_rejects_bad_knobs():
+  with pytest.raises(ValueError, match="auto"):
+    RenderService(max_inflight="fast")
+  from mpi_vision_tpu.serve.scheduler import MicroBatcher
+
+  with pytest.raises(ValueError, match="max_inflight_cap"):
+    MicroBatcher(object(), lambda s: None, max_inflight=8,
+                 max_inflight_cap=4)

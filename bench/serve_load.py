@@ -188,13 +188,18 @@ def slo_window_config(duration: float):
   """Objectives sized to the measured window so the verdict block judges
   THIS run: the fast window reacts inside the load window (alerts can
   fire and clear during a chaos phase) and the slow window spans the
-  whole measurement (the report card covers every request)."""
+  whole measurement (the report card covers every request). The
+  histogram-quantile objective (p99 under the latency threshold, judged
+  from the pooled native histogram) and its per-scene variant are on so
+  every BENCH line trends a percentile-true p99 verdict, not just
+  threshold counts."""
   from mpi_vision_tpu.obs import SloConfig
 
   fast = max(duration / 4.0, 0.5)
   return SloConfig(fast_window_s=fast,
                    slow_window_s=max(2.0 * duration, fast),
-                   bucket_s=max(fast / 8.0, 0.1))
+                   bucket_s=max(fast / 8.0, 0.1),
+                   quantile=0.99, per_scene=True)
 
 
 def cluster_slo_verdict(router_stats: dict) -> dict | None:
@@ -206,7 +211,10 @@ def cluster_slo_verdict(router_stats: dict) -> dict | None:
   for st in router_stats.get("backends", {}).values():
     slo = st.get("slo") if isinstance(st, dict) else None
     if isinstance(slo, dict) and "objectives" in slo:
-      targets = {n: o["target"] for n, o in slo["objectives"].items()}
+      # Quantile objectives carry a threshold, not a fractional target;
+      # the fleet attainment table only scores the fractional ones.
+      targets = {n: o["target"] for n, o in slo["objectives"].items()
+                 if "target" in o}
       break
   if not targets or not attainment:
     return None

@@ -428,6 +428,33 @@ def cmd_serve(args: argparse.Namespace) -> dict:
     # Alert edges only exist with SLO tracking on; accepting the hook
     # without it would silently never deliver a page.
     raise SystemExit("--alert-hook requires SLO tracking (drop --no-slo)")
+  if not args.slo:
+    # Quantile knobs only act through the SLO tracker.
+    wants_slo = [flag for flag, on in (
+        ("--slo-quantile", args.slo_quantile is not None),
+        ("--slo-per-scene", args.slo_per_scene)) if on]
+    if wants_slo:
+      raise SystemExit(
+          f"{', '.join(wants_slo)} require(s) SLO tracking (drop --no-slo)")
+  if args.slo_per_scene and args.slo_quantile is None:
+    # The per-scene objective IS the quantile one; without a quantile
+    # there is nothing per-scene to judge.
+    raise SystemExit("--slo-per-scene requires --slo-quantile")
+  if args.tsdb_interval_s <= 0:
+    wants_tsdb = [flag for flag, on in (
+        ("--tsdb-points", args.tsdb_points is not None),
+        ("--tsdb-max-series", args.tsdb_max_series is not None)) if on]
+    if wants_tsdb:
+      raise SystemExit(
+          f"{', '.join(wants_tsdb)} require(s) --tsdb-interval-s > 0")
+  if not args.ship_url:
+    wants_ship = [flag for flag, on in (
+        ("--ship-interval-s", args.ship_interval_s is not None),
+        ("--ship-timeout-s", args.ship_timeout_s is not None),
+        ("--ship-spool-dir", bool(args.ship_spool_dir)),
+        ("--ship-spool-mb", args.ship_spool_mb is not None)) if on]
+    if wants_ship:
+      raise SystemExit(f"{', '.join(wants_ship)} require(s) --ship-url")
   if not args.edge_cache:
     # Edge knobs only act through the edge cache; silently ignoring them
     # would drop the fidelity/budget bounds the user asked for.
@@ -480,7 +507,39 @@ def cmd_serve(args: argparse.Namespace) -> dict:
         latency_target=args.slo_latency_target,
         fast_window_s=args.slo_fast_window_s,
         slow_window_s=args.slo_slow_window_s,
-        burn_threshold=args.slo_burn_threshold)
+        burn_threshold=args.slo_burn_threshold,
+        quantile=args.slo_quantile,
+        per_scene=args.slo_per_scene)
+  tsdb = None
+  if args.tsdb_interval_s > 0:
+    from mpi_vision_tpu.obs import TsdbConfig
+
+    defaults = TsdbConfig()
+    tsdb = TsdbConfig(
+        interval_s=args.tsdb_interval_s,
+        max_points=(args.tsdb_points if args.tsdb_points is not None
+                    else defaults.max_points),
+        max_series=(args.tsdb_max_series
+                    if args.tsdb_max_series is not None
+                    else defaults.max_series))
+  ship = None
+  if args.ship_url:
+    from mpi_vision_tpu.obs import ship as ship_lib
+
+    # Unset knobs are simply not passed — the dataclass defaults stay
+    # the single source of truth.
+    ship_kwargs = {}
+    if args.ship_interval_s is not None:
+      ship_kwargs["interval_s"] = args.ship_interval_s
+    if args.ship_timeout_s is not None:
+      ship_kwargs["timeout_s"] = args.ship_timeout_s
+    if args.ship_spool_mb is not None:
+      ship_kwargs["spool_budget_bytes"] = args.ship_spool_mb << 20
+    ship = ship_lib.ShipConfig(
+        url=args.ship_url,
+        spool_dir=args.ship_spool_dir or None,
+        events_path=args.event_log or None,
+        events_keep=args.event_log_keep, **ship_kwargs)
   events = None
   if args.event_log:
     from mpi_vision_tpu.obs import events as events_mod
@@ -547,7 +606,7 @@ def cmd_serve(args: argparse.Namespace) -> dict:
       max_queue=args.max_queue, resilience=resilience,
       cpu_fallback=args.cpu_fallback, tracer=tracer,
       profile_dir=args.profile_dir or None, profile_hook=profile_hook,
-      alert_hook=alert_hook, slo=slo, events=events,
+      alert_hook=alert_hook, slo=slo, events=events, tsdb=tsdb, ship=ship,
       metrics_ttl_s=args.metrics_ttl_ms / 1e3)
   if args.mpi_dir:
     from mpi_vision_tpu.core.camera import intrinsics_matrix, inv_depths
@@ -700,6 +759,18 @@ def cmd_serve(args: argparse.Namespace) -> dict:
       }} if "slo" in stats else {}),
       **({"alert_hook": stats["alert_hook"]}
          if "alert_hook" in stats else {}),
+      **({"tsdb": {
+          "series": stats["tsdb"]["series"],
+          "samples": stats["tsdb"]["samples"],
+          "dropped_series": stats["tsdb"]["dropped_series"],
+      }} if "tsdb" in stats else {}),
+      **({"ship": {
+          "batches_shipped": stats["ship"]["batches_shipped"],
+          "segments_shipped": stats["ship"]["segments_shipped"],
+          "post_failures": stats["ship"]["post_failures"],
+          "spooled": stats["ship"]["spooled"],
+          "spool_dropped": stats["ship"]["spool_dropped"],
+      }} if "ship" in stats else {}),
       "events_emitted": stats["events"]["emitted"],
       **({"traces": svc.tracer.finished} if args.trace else {}),
       **({"ckpt_step": ckpt_info["step"],
@@ -741,6 +812,8 @@ def cmd_cluster(args: argparse.Namespace) -> dict:
     raise SystemExit(f"--probe-s must be > 0, got {args.probe_s}")
   if args.wedge_after < 1:
     raise SystemExit(f"--wedge-after must be >= 1, got {args.wedge_after}")
+  if args.tsdb_points is not None and args.tsdb_interval_s <= 0:
+    raise SystemExit("--tsdb-points requires --tsdb-interval-s > 0")
 
   pool = None
   supervisor = None
@@ -765,6 +838,15 @@ def cmd_cluster(args: argparse.Namespace) -> dict:
         raise SystemExit(f"--join parsed no addresses from {args.join!r}")
 
     tracer = Tracer(ring=args.trace_ring) if args.trace else None
+    router_tsdb = None
+    if args.tsdb_interval_s > 0:
+      from mpi_vision_tpu.obs import TsdbConfig
+
+      defaults = TsdbConfig()
+      router_tsdb = TsdbConfig(
+          interval_s=args.tsdb_interval_s,
+          max_points=(args.tsdb_points if args.tsdb_points is not None
+                      else defaults.max_points))
     router = Router(
         backends, replication=args.replication, vnodes=args.vnodes,
         breaker_threshold=args.breaker_threshold,
@@ -772,7 +854,7 @@ def cmd_cluster(args: argparse.Namespace) -> dict:
         render_timeout_s=args.render_timeout_s,
         health_timeout_s=args.health_timeout_s,
         retry_budget_ratio=args.retry_budget,
-        load_aware=args.load_aware,
+        load_aware=args.load_aware, tsdb=router_tsdb,
         metrics_ttl_s=args.metrics_ttl_ms / 1e3, tracer=tracer)
     if args.supervise or args.rolling_restart:
       # Lifecycle decisions share the router's event log so one
@@ -1143,6 +1225,47 @@ def build_parser() -> argparse.ArgumentParser:
   s.add_argument("--slo-burn-threshold", type=float, default=10.0,
                  help="error-budget burn rate (x sustainable) at which "
                       "the alert fires")
+  s.add_argument("--slo-quantile", type=float, default=None,
+                 help="add a histogram-quantile objective (e.g. 0.99: "
+                      "'p99 latency under --slo-latency-ms'), judged "
+                      "from the native latency histogram pooled over "
+                      "the window — percentile-true, not a threshold "
+                      "count; requires SLO tracking")
+  s.add_argument("--slo-per-scene", action="store_true",
+                 help="judge the quantile objective per scene too "
+                      "(bounded per-scene table; alerts named like "
+                      "latency_p99:scene_007); requires --slo-quantile")
+  s.add_argument("--tsdb-interval-s", type=float, default=0.0,
+                 help="sample every /metrics family into the on-box "
+                      "time-series ring this often and serve windowed "
+                      "history at GET /debug/tsdb (<= 0 disables)")
+  s.add_argument("--tsdb-points", type=int, default=None,
+                 help="points retained per series (default 512; history "
+                      "span = interval x points); requires "
+                      "--tsdb-interval-s")
+  s.add_argument("--tsdb-max-series", type=int, default=None,
+                 help="series cap for the whole ring (default 4096; "
+                      "overflow counted, never fatal); requires "
+                      "--tsdb-interval-s")
+  s.add_argument("--ship-url", default="",
+                 help="POST telemetry batches (rotated event-log "
+                      "segments, SLO alert edges, incremental tsdb "
+                      "snapshots) to this HTTP sink on a daemon thread; "
+                      "failures are counted (mpi_obs_ship_*), retried, "
+                      "spooled — never fatal, never on the request path")
+  s.add_argument("--ship-interval-s", type=float, default=None,
+                 help="shipping cadence (default 10); requires --ship-url")
+  s.add_argument("--ship-timeout-s", type=float, default=None,
+                 help="per-POST sink timeout (default 5); requires "
+                      "--ship-url")
+  s.add_argument("--ship-spool-dir", default="",
+                 help="spool undeliverable batches to this directory "
+                      "and drain them oldest-first when the sink "
+                      "recovers (unset: failed batches drop, counted); "
+                      "requires --ship-url")
+  s.add_argument("--ship-spool-mb", type=int, default=None,
+                 help="spool byte budget (default 64; oldest dropped "
+                      "past it); requires --ship-url")
   s.add_argument("--metrics-ttl-ms", type=float, default=250.0,
                  help="memoize the /metrics exposition string this long "
                       "(scrape storms cost one snapshot render per "
@@ -1196,6 +1319,16 @@ def build_parser() -> argparse.ArgumentParser:
   c.add_argument("--metrics-ttl-ms", type=float, default=250.0,
                  help="memoize the aggregated /metrics exposition this "
                       "long (one pool fan-out per window)")
+  c.add_argument("--tsdb-interval-s", type=float, default=0.0,
+                 help="sample the AGGREGATED exposition (pooled "
+                      "mpi_serve_* + mpi_cluster_*) into a router-side "
+                      "time-series ring this often; GET /debug/tsdb "
+                      "serves it next to every backend's ring "
+                      "(<= 0 disables the router ring; the fan-out "
+                      "always runs)")
+  c.add_argument("--tsdb-points", type=int, default=None,
+                 help="points retained per series in the router ring; "
+                      "requires --tsdb-interval-s")
   c.add_argument("--supervise", action="store_true",
                  help="run the self-healing supervisor over the spawned "
                       "pool: /healthz probes, crashed/wedged backends "

@@ -27,9 +27,22 @@ per-stage accounting, not a single end-to-end number:
     transitions, failovers, scene swaps, checkpoint lifecycle, NaN
     rollbacks, alert fire/clear) served at ``/debug/events`` with an
     optional JSONL file sink.
+  * ``hist`` — native (sparse exponential-bucket) histograms with
+    per-bucket trace-id exemplars: percentile-true latency families
+    (``mpi_serve_*_nativehist``) that merge exactly across time buckets
+    and backends, powering the quantile SLOs and pooled fleet quantiles.
+  * ``tsdb`` — the on-box time-series ring: every metric family sampled
+    on a cadence into bounded per-series rings, served at
+    ``/debug/tsdb`` (the router fans the query out fleet-wide).
+  * ``ship`` — off-host telemetry shipping: rotated event-log segments,
+    SLO alert edges, and incremental tsdb snapshots batched to an HTTP
+    sink with retry + disk spool (imported as ``mpi_vision_tpu.obs.ship``,
+    not re-exported here — it layers on ``serve.resilience``).
 """
 
 from mpi_vision_tpu.obs.events import NULL_EVENTS, EventLog, file_sink
+from mpi_vision_tpu.obs.hist import NativeHistogram
+from mpi_vision_tpu.obs.tsdb import TsdbConfig, TsdbRecorder
 from mpi_vision_tpu.obs.profile import DeviceProfiler, ProfileBusyError
 from mpi_vision_tpu.obs.prom import (
     ExpositionCache,

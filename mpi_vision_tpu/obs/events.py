@@ -65,6 +65,13 @@ def file_sink(path: str, max_bytes: int | None = None, keep: int = 3):
   def _rotate_locked() -> None:
     try:
       state["fh"].close()
+      # The oldest slot is about to be overwritten: that segment's
+      # events leave local disk forever UNLESS a shipper (obs/ship.py)
+      # already delivered-and-deleted it. Counting the drop here is
+      # what closes the /debug/events retention blind spot — the
+      # snapshot can now say how many segments rotated away unshipped.
+      if os.path.exists(f"{path}.{keep}"):
+        sink.segments_dropped += 1
       for i in range(keep - 1, 0, -1):
         rotated = f"{path}.{i}"
         if os.path.exists(rotated):
@@ -93,6 +100,7 @@ def file_sink(path: str, max_bytes: int | None = None, keep: int = 3):
 
   sink.rotations = 0
   sink.rotate_errors = 0
+  sink.segments_dropped = 0
   sink.close = close
   return sink
 
@@ -152,12 +160,19 @@ class EventLog:
 
   def snapshot(self, recent: int = 128, kind: str | None = None) -> dict:
     """The ``/debug/events`` payload: counters + the most recent events
-    (optionally filtered to one ``kind``)."""
+    (optionally filtered to one ``kind``).
+
+    With a rotating file sink attached, a ``retention`` block accounts
+    for the JSONL segments the ring endpoint can no longer see: how many
+    rotations happened and how many segments rotated off local disk
+    entirely (``segments_dropped`` stays 0 while a shipper keeps
+    delivering-and-deleting them first).
+    """
     with self._lock:
       events = list(self._ring)
       if kind is not None:
         events = [e for e in events if e["kind"] == kind]
-      return {
+      out = {
           "emitted": self.emitted,
           "dropped": self.dropped,
           "sink_errors": self.sink_errors,
@@ -165,6 +180,14 @@ class EventLog:
           "by_kind": dict(sorted(self._by_kind.items())),
           "events": events[-recent:] if recent > 0 else [],
       }
+      sink = self.sink
+    if sink is not None and hasattr(sink, "rotations"):
+      out["retention"] = {
+          "rotations": sink.rotations,
+          "rotate_errors": sink.rotate_errors,
+          "segments_dropped": getattr(sink, "segments_dropped", 0),
+      }
+    return out
 
 
 class _NullEventLog:

@@ -22,6 +22,8 @@ import math
 import threading
 import time
 
+from mpi_vision_tpu.obs import hist as hist_mod
+
 _TYPES = ("counter", "gauge", "histogram")
 
 # The shared metric-name prefix: one grep (or one Grafana variable) finds
@@ -60,8 +62,12 @@ def format_value(value) -> str:
 class Metric:
   """One metric family: name, type, help, and its samples.
 
-  Samples are ``(suffix, labels, value)`` — suffix is appended to the
-  family name (histograms use ``_bucket``/``_sum``/``_count``).
+  Samples are ``(suffix, labels, value, exemplar)`` — suffix is appended
+  to the family name (histograms use ``_bucket``/``_sum``/``_count``).
+  ``exemplar`` is an optional ``(trace_id, observed_value)`` pair,
+  rendered OpenMetrics-style after the sample
+  (`` # {trace_id="..."} value``) — the native-histogram families use it
+  to link a bucket to a recorded trace.
   """
 
   name: str
@@ -72,23 +78,28 @@ class Metric:
     if self.mtype not in _TYPES:
       raise ValueError(f"metric type must be one of {_TYPES}, "
                        f"got {self.mtype!r}")
-    self.samples: list[tuple[str, dict, object]] = []
+    self.samples: list[tuple[str, dict, object, tuple | None]] = []
 
   def sample(self, value, labels: dict | None = None,
-             suffix: str = "") -> "Metric":
-    self.samples.append((suffix, dict(labels or {}), value))
+             suffix: str = "", exemplar: tuple | None = None) -> "Metric":
+    self.samples.append((suffix, dict(labels or {}), value, exemplar))
     return self
 
   def render(self) -> str:
     lines = [f"# HELP {self.name} {_escape_help(self.help)}",
              f"# TYPE {self.name} {self.mtype}"]
-    for suffix, labels, value in self.samples:
+    for suffix, labels, value, exemplar in self.samples:
       label_str = ""
       if labels:
         inner = ",".join(f'{k}="{_escape_label(str(v))}"'
                          for k, v in labels.items())
         label_str = "{" + inner + "}"
-      lines.append(f"{self.name}{suffix}{label_str} {format_value(value)}")
+      line = f"{self.name}{suffix}{label_str} {format_value(value)}"
+      if exemplar is not None:
+        tid, observed = exemplar
+        line += (f' # {{trace_id="{_escape_label(str(tid))}"}} '
+                 f"{format_value(observed)}")
+      lines.append(line)
     return "\n".join(lines)
 
 
@@ -127,6 +138,14 @@ class Registry:
       m.sample(count, {"le": "+Inf"}, suffix="_bucket")
     m.sample(sum_value, suffix="_sum")
     m.sample(count, suffix="_count")
+    self._metrics.append(m)
+    return m
+
+  def histogram_family(self, name: str, help: str) -> Metric:
+    """A histogram-typed family whose samples the caller fills directly
+    (the native-histogram families: ``_bucket{idx=,le=}`` / ``_zero`` /
+    ``_sum`` / ``_count``, see ``obs/hist.py``)."""
+    m = Metric(name, "histogram", help)
     self._metrics.append(m)
     return m
 
@@ -222,6 +241,40 @@ def serve_registry(stats: dict,
   reg.histogram(p + "batch_size",
                 "Coalesced requests per device dispatch.",
                 buckets, total_reqs, stats.get("batches", 0))
+  # Native histograms (obs/hist.py): sparse exponential buckets with
+  # per-bucket trace-id exemplars — percentile-true latency families the
+  # router can pool-merge exactly (shared idx space: per-sample sums ARE
+  # the bucket merge). Always exposed, zeros and all.
+  nh = stats.get("hist") or {}
+  hist_mod.add_family(
+      reg, p + "request_latency_nativehist",
+      "Request latency (seconds) in native exponential buckets with "
+      "trace-id exemplars.", [({}, nh.get("request"))])
+  hist_mod.add_family(
+      reg, p + "phase_latency_nativehist",
+      "Per-dispatch device phase duration (seconds) in native buckets, "
+      "label phase=h2d|compute|readback.",
+      [({"phase": phase}, (nh.get("phase") or {}).get(phase))
+       for phase in ("h2d", "compute", "readback")])
+  hist_mod.add_family(
+      reg, p + "batch_latency_nativehist",
+      "Per-dispatch device render time (seconds) in native buckets.",
+      [({}, nh.get("batch"))])
+  wpe = nh.get("warp_pose_error") or {}
+  hist_mod.add_family(
+      reg, p + "edge_warp_pose_error",
+      "Pose error of every edge warp-serve (how far the served frame's "
+      "render pose was from the request), label component=trans "
+      "(scene units) | rot_deg (degrees).",
+      [({"component": "trans"}, wpe.get("trans")),
+       ({"component": "rot_deg"}, wpe.get("rot_deg"))])
+  quant = reg.gauge(
+      p + "request_quantile_seconds",
+      "Request-latency quantiles estimated from the native histogram "
+      "(NaN while idle), label q.")
+  for q in hist_mod.QUANTILES:
+    quant.sample(hist_mod.quantile_of(nh.get("request"), q),
+                 {"q": hist_mod.q_label(q)})
   # Edge frame cache (serve/edge/): families are always exposed (zeros
   # while the cache is off) so dashboards and the README metric
   # reference never depend on a knob.
@@ -320,7 +373,8 @@ class ExpositionCache:
 
 
 def aggregate_metrics_texts(texts, extra: "Registry | None" = None,
-                            drop=frozenset()) -> str:
+                            drop=frozenset(),
+                            collect: dict | None = None) -> str:
   """Sum several Prometheus expositions into one (the cluster /metrics).
 
   Every sample with the same ``(family, sample name, labels)`` key is
@@ -342,6 +396,11 @@ def aggregate_metrics_texts(texts, extra: "Registry | None" = None,
   Dead backends simply contribute nothing — aggregated counters dip when
   a backend is lost, which is itself the signal (the router's
   ``mpi_cluster_backend_up`` gauge says which one).
+
+  ``collect``, when given, is filled with the aggregated families
+  (``{family: {"samples": {key: value}, ...}}``) so a caller that needs
+  the parsed form (the router's pooled-quantile computation) does not
+  re-parse the multi-thousand-line output it just produced.
   """
   order: list[str] = []
   fams: dict[str, dict] = {}
@@ -352,13 +411,22 @@ def aggregate_metrics_texts(texts, extra: "Registry | None" = None,
       agg = fams.get(name)
       if agg is None:
         agg = fams[name] = {"type": fam["type"], "help": fam["help"],
-                            "samples": {}, "order": []}
+                            "samples": {}, "exemplars": {}, "order": []}
         order.append(name)
       for key, value in fam["samples"].items():
         if key not in agg["samples"]:
           agg["samples"][key] = 0.0
           agg["order"].append(key)
         agg["samples"][key] += value
+      for key, exemplar in fam.get("exemplars", {}).items():
+        # Exemplars survive the merge: counts add, but an exemplar is one
+        # concrete observation — keep the largest across the pool (the
+        # tail an operator chasing a quantile alert wants to see).
+        mine = agg["exemplars"].get(key)
+        if mine is None or exemplar[1] >= mine[1]:
+          agg["exemplars"][key] = exemplar
+  if collect is not None:
+    collect.update(fams)
   lines = []
   for name in order:
     fam = fams[name]
@@ -372,28 +440,50 @@ def aggregate_metrics_texts(texts, extra: "Registry | None" = None,
         inner = ",".join(f'{k}="{_escape_label(str(v))}"'
                          for k, v in labels)
         label_str = "{" + inner + "}"
-      lines.append(
-          f"{sample_name}{label_str} "
-          f"{format_value(fam['samples'][(sample_name, labels)])}")
+      line = (f"{sample_name}{label_str} "
+              f"{format_value(fam['samples'][(sample_name, labels)])}")
+      exemplar = fam["exemplars"].get((sample_name, labels))
+      if exemplar is not None:
+        line += (f' # {{trace_id="{_escape_label(str(exemplar[0]))}"}} '
+                 f"{format_value(exemplar[1])}")
+      lines.append(line)
   out = "\n".join(lines) + ("\n" if lines else "")
   if extra is not None:
     out += extra.render()
   return out
 
 
+def strip_exemplars(text: str) -> str:
+  """The exposition without exemplar suffixes.
+
+  Exemplars (`` # {...} v``) are OpenMetrics syntax; the classic
+  ``text/plain; version=0.0.4`` format allows only a timestamp after the
+  value, and a vanilla Prometheus scrape that meets one fails the ENTIRE
+  scrape. The HTTP layer serves this stripped form by default and the
+  exemplar-ful form at ``?exemplars=1`` (which the cluster router's
+  scrape uses, so exemplars still survive the pool merge).
+  """
+  if " # " not in text:
+    return text
+  return "\n".join(
+      line if line.startswith("#") else line.partition(" # ")[0]
+      for line in text.splitlines()) + ("\n" if text.endswith("\n") else "")
+
+
 def parse_metrics_text(text: str) -> dict:
   """Minimal exposition-format parser (the test-side inverse).
 
   Returns ``{family: {"type": str, "help": str, "samples":
-  {(sample_name, (("label", "value"), ...)): float}}}``. Handles exactly
-  what ``Registry.render`` emits (no exemplars, no timestamps, no
-  escaped-quote labels with commas inside).
+  {(sample_name, (("label", "value"), ...)): float}, "exemplars":
+  {same key: (trace_id, observed_value)}}}``. Handles exactly what
+  ``Registry.render`` emits (OpenMetrics-style `` # {...} v`` exemplars
+  included; no timestamps, no escaped-quote labels with commas inside).
   """
   out: dict = {}
 
   def family(name: str) -> dict:
-    return out.setdefault(name,
-                          {"type": None, "help": None, "samples": {}})
+    return out.setdefault(name, {"type": None, "help": None,
+                                 "samples": {}, "exemplars": {}})
 
   for line in text.splitlines():
     line = line.strip()
@@ -410,6 +500,15 @@ def parse_metrics_text(text: str) -> dict:
     elif line.startswith("#"):
       continue
     else:
+      exemplar = None
+      if " # " in line:
+        line, _, exemplar_part = line.partition(" # ")
+        ex_labels, _, ex_value = exemplar_part.rpartition(" ")
+        tid = ex_labels.partition('trace_id="')[2].partition('"')[0]
+        try:
+          exemplar = (tid, float(ex_value))
+        except ValueError:
+          exemplar = None
       name_part, _, value_str = line.rpartition(" ")
       labels: tuple = ()
       if "{" in name_part:
@@ -423,10 +522,13 @@ def parse_metrics_text(text: str) -> dict:
       else:
         sample_name = name_part
       base = sample_name
-      for suffix in ("_bucket", "_sum", "_count"):
+      for suffix in ("_bucket", "_zero", "_sum", "_count"):
         if base.endswith(suffix) and base[:-len(suffix)] in out:
           base = base[:-len(suffix)]
           break
       value = float(value_str) if value_str != "+Inf" else math.inf
-      family(base)["samples"][(sample_name, labels)] = value
+      fam = family(base)
+      fam["samples"][(sample_name, labels)] = value
+      if exemplar is not None:
+        fam["exemplars"][(sample_name, labels)] = exemplar
   return out

@@ -45,6 +45,8 @@ from concurrent.futures import ThreadPoolExecutor
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from mpi_vision_tpu.obs import prom
+from mpi_vision_tpu.obs import hist as hist_mod
+from mpi_vision_tpu.obs import tsdb as tsdb_mod
 from mpi_vision_tpu.obs.events import EventLog
 from mpi_vision_tpu.obs.slo import SloConfig, SloTracker
 from mpi_vision_tpu.obs.trace import NULL_TRACE, NULL_TRACER, Tracer
@@ -296,6 +298,13 @@ class Router:
       ``load_ttl_s``) show it at least ``load_threshold`` requests
       deeper than its best replica — safe because replicas render
       bit-identical pixels.
+    tsdb: the router-side time-series ring (``obs.tsdb``): pass a
+      ``TsdbConfig`` to sample the AGGREGATED exposition on its cadence
+      — pooled ``mpi_serve_*`` families plus the router's own — so
+      ``GET /debug/tsdb`` answers "what did the fleet's p99 do during
+      the last rolling restart" from one process; a pre-built
+      ``TsdbRecorder`` is adopted un-started (tests). The same endpoint
+      always fans the query out to every backend's ring too.
     slo: client-perceived SLO tracking over the ROUTER'S own request
       stream (ROADMAP SLO follow-on). The backends' trackers only see
       requests that reach a backend; the 502s of an exhausted replica
@@ -320,6 +329,7 @@ class Router:
                retry_budget_initial: float = 10.0,
                load_aware: bool = True, load_ttl_s: float = 5.0,
                load_threshold: int = 4,
+               tsdb: "tsdb_mod.TsdbConfig | tsdb_mod.TsdbRecorder | None" = None,
                slo: "SloConfig | SloTracker | None" = SloConfig(),
                clock=time.monotonic):
     self.replication = int(replication)
@@ -353,6 +363,16 @@ class Router:
     self._ring = HashRing(vnodes=vnodes, replication=replication)
     self._metrics_cache = prom.ExpositionCache(
         self._render_metrics_text, ttl_s=metrics_ttl_s, clock=clock)
+    # The router's own flight-recorder ring samples the AGGREGATED
+    # exposition (fresh renders, not the cache) — fleet history, not one
+    # backend's.
+    if isinstance(tsdb, tsdb_mod.TsdbRecorder):
+      self.tsdb = tsdb
+    elif tsdb is not None:
+      self.tsdb = tsdb_mod.TsdbRecorder(
+          self._render_metrics_text, tsdb).start()
+    else:
+      self.tsdb = None
     self._closed = False
     if backends:
       items = (backends.items() if isinstance(backends, dict)
@@ -937,7 +957,38 @@ class Router:
         "spans_total": spans,
     }
 
-  def _cluster_registry(self) -> prom.Registry:
+  def tsdb_snapshot(self, family: str | None = None,
+                    recent_s: float | None = None,
+                    points: int | None = None) -> dict:
+    """The aggregated ``/debug/tsdb``: the router's own ring (fleet-level
+    pooled families, when configured) next to every backend's ring — one
+    query reads the whole fleet's history ("what did p99 look like
+    during the last rolling restart").
+    """
+    if family:
+      qs = f"/debug/tsdb?family={urllib.parse.quote(str(family))}"
+      if recent_s is not None:
+        qs += f"&recent={float(recent_s):g}"
+      if points is not None:
+        qs += f"&points={int(points)}"
+      per_backend = self._fan_out_get(qs, self.health_timeout_s)
+      router_view = (self.tsdb.query(family, recent_s=recent_s,
+                                     points=points)
+                     if self.tsdb is not None else None)
+    else:
+      per_backend = self._fan_out_get("/debug/tsdb",
+                                      self.health_timeout_s)
+      router_view = ({"families": self.tsdb.families(),
+                      "stats": self.tsdb.stats()}
+                     if self.tsdb is not None else None)
+    return {
+        "family": family,
+        "router": router_view,
+        "backends": {b: per_backend[b] for b in sorted(per_backend)},
+    }
+
+  def _cluster_registry(self, pooled_request_hist: dict | None = None) \
+      -> prom.Registry:
     snap = self.metrics.snapshot()
     with self._lock:
       backends = list(self._backends.values())
@@ -994,12 +1045,26 @@ class Router:
       up.sample(1 if (backend.breaker.state == CircuitBreaker.CLOSED
                       and not backend.ejected) else 0,
                 {"backend": backend.backend_id})
+    # Pooled request-latency quantiles, estimated from the POOL-MERGED
+    # native histogram (per-idx bucket sums are the exact merge — the
+    # per-backend quantile gauges are dropped because summing p99s is
+    # garbage, but the merged buckets give the fleet's true quantiles).
+    pooled = reg.gauge(
+        p + "request_quantile_seconds",
+        "Fleet request-latency quantiles from the pool-merged native "
+        "histogram (NaN while idle), label q.")
+    for q in hist_mod.QUANTILES:
+      pooled.sample(hist_mod.quantile_of(pooled_request_hist, q),
+                    {"q": hist_mod.q_label(q)})
     return reg
 
   def _render_metrics_text(self) -> str:
     def one(backend):
+      # ?exemplars=1: the backend's default exposition strips exemplars
+      # for vanilla scrapers; the router wants them so they survive the
+      # pool merge (its own /metrics strips them again by default).
       status, _, body = self.transport.request(
-          "GET", backend.base_url + "/metrics",
+          "GET", backend.base_url + "/metrics?exemplars=1",
           timeout=self.health_timeout_s)
       return body.decode("utf-8", "replace") if status == 200 else None
 
@@ -1014,12 +1079,21 @@ class Router:
         raise result  # a dead backend contributes nothing; a bug raises
     from mpi_vision_tpu.obs import slo as slo_mod
 
-    # Ratio/target SLO gauges are per-backend statements — summing them
-    # exports garbage (and one idle backend's NaN poisons the sample);
-    # the summable mpi_slo_* slices still aggregate.
-    return prom.aggregate_metrics_texts(
-        texts, extra=self._cluster_registry(),
-        drop=slo_mod.NON_ADDITIVE_FAMILIES)
+    # Ratio/target SLO gauges and per-backend quantile gauges are
+    # per-backend statements — summing them exports garbage (and one
+    # idle backend's NaN poisons the sample); the summable mpi_slo_*
+    # slices and the native-histogram buckets still aggregate (the
+    # buckets EXACTLY: shared idx space, counts add).
+    parsed: dict = {}
+    agg = prom.aggregate_metrics_texts(
+        texts,
+        drop=slo_mod.NON_ADDITIVE_FAMILIES | hist_mod.NON_ADDITIVE_FAMILIES,
+        collect=parsed)
+    pooled_hists = hist_mod.snapshots_from_samples(
+        parsed.get("mpi_serve_request_latency_nativehist",
+                   {}).get("samples", {}))
+    return agg + self._cluster_registry(
+        pooled_request_hist=pooled_hists.get(())).render()
 
   def metrics_text(self) -> str:
     """Aggregated ``/metrics``: pool-summed ``mpi_serve_*`` families plus
@@ -1028,6 +1102,8 @@ class Router:
 
   def close(self) -> None:
     self._closed = True
+    if self.tsdb is not None:
+      self.tsdb.stop()
     with self._lock:
       pool, self._fanout_pool = self._fanout_pool, None
     if pool is not None:
@@ -1091,8 +1167,15 @@ class _RouterHandler(BaseHTTPRequestHandler):
     elif parsed.path == "/stats":
       self._send_json(self.router.stats())
     elif parsed.path == "/metrics":
+      # Same contract as a backend: classic format by default (a `#`
+      # after the value fails a vanilla Prometheus scrape), exemplars
+      # inline at ?exemplars=1.
+      text = self.router.metrics_text()
+      if urllib.parse.parse_qs(parsed.query).get(
+          "exemplars", ["0"])[0] not in ("1", "true"):
+        text = prom.strip_exemplars(text)
       self._send_bytes(
-          self.router.metrics_text().encode(),
+          text.encode(),
           content_type="text/plain; version=0.0.4; charset=utf-8")
     elif parsed.path == "/debug/traces":
       # ?id= fans the search out to every backend and returns the
@@ -1110,6 +1193,18 @@ class _RouterHandler(BaseHTTPRequestHandler):
         self._send_json({"error": "recent must be an integer"}, status=400)
         return
       self._send_json(self.router.events_snapshot(recent=recent))
+    elif parsed.path == "/debug/tsdb":
+      # One query reads fleet history: the router's own ring (pooled
+      # families) plus every backend's, fanned out concurrently.
+      try:
+        family, recent, points = tsdb_mod.parse_query(
+            urllib.parse.parse_qs(parsed.query))
+      except ValueError:
+        self._send_json({"error": "recent must be a number and points "
+                                  "an integer"}, status=400)
+        return
+      self._send_json(self.router.tsdb_snapshot(
+          family=family, recent_s=recent, points=points))
     else:
       self._send_json({"error": f"unknown path {self.path}"}, status=404)
 

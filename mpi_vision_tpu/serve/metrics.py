@@ -14,6 +14,8 @@ import collections
 import threading
 import time
 
+from mpi_vision_tpu.obs import hist as hist_mod
+
 
 def percentile(sorted_values, q: float) -> float:
   """Nearest-rank percentile of an already-sorted non-empty sequence."""
@@ -97,19 +99,33 @@ class ServeMetrics:
       # Per-scene latency breakdown (hot-scene regression hunting):
       # scene -> [count, sum_s, max_s, deque(recent latencies)].
       self._per_scene: dict = {}
+      # Native histograms (obs/hist.py): percentile-true, mergeable,
+      # with per-bucket trace-id exemplars — the flight recorder's
+      # measurement layer next to the classic fixed-bucket histogram.
+      self._hist_request = hist_mod.NativeHistogram()
+      self._hist_phase = {phase: hist_mod.NativeHistogram()
+                          for phase in ("h2d", "compute", "readback")}
+      self._hist_batch = hist_mod.NativeHistogram()
+      self._hist_warp_pose_error = {
+          "trans": hist_mod.NativeHistogram(),
+          "rot_deg": hist_mod.NativeHistogram(),
+      }
     if self.slo is not None:
       self.slo.reset()
 
-  def record_request(self, latency_s: float, scene_id: str | None = None) -> None:
+  def record_request(self, latency_s: float, scene_id: str | None = None,
+                     trace_id: str | None = None) -> None:
     """One request completed, queue-to-response latency.
 
     ``scene_id`` feeds the bounded per-scene breakdown; None (legacy
-    callers) skips it.
+    callers) skips it. ``trace_id`` becomes the latency bucket's
+    exemplar so a quantile reading links to a recorded trace.
     """
     with self._lock:
       self.requests += 1
       self._latencies.append(latency_s)
       self._lat_sum += latency_s
+      self._hist_request.record(latency_s, exemplar=trace_id)
       for i, bound in enumerate(LATENCY_BUCKETS_S):
         if latency_s <= bound:
           self._lat_bucket_counts[i] += 1
@@ -129,7 +145,7 @@ class ServeMetrics:
         entry[2] = max(entry[2], latency_s)
         entry[3].append(latency_s)
     if self.slo is not None:
-      self.slo.record(ok=True, latency_s=latency_s)
+      self.slo.record(ok=True, latency_s=latency_s, scene_id=scene_id)
 
   def record_error(self, kind: str, count: int = 1) -> None:
     """``count`` requests failed with a ``kind``-class error.
@@ -225,9 +241,22 @@ class ServeMetrics:
       self.batches += 1
       self._batch_hist[int(size)] += 1
       self.render_seconds += render_s
+      self._hist_batch.record(render_s)
       if phases:
         for key in ("h2d", "compute", "readback"):
-          self.phase_seconds[key] += float(phases.get(key + "_s", 0.0))
+          phase_s = float(phases.get(key + "_s", 0.0))
+          self.phase_seconds[key] += phase_s
+          self._hist_phase[key].record(phase_s)
+
+  def record_warp_pose_error(self, trans: float, rot_deg: float,
+                             trace_id: str | None = None) -> None:
+    """One edge warp-serve's pose error (how far the served frame's
+    render pose was from the request pose) — warp-quality drift must be
+    visible in telemetry before users see it as smeared pixels."""
+    with self._lock:
+      self._hist_warp_pose_error["trans"].record(trans, exemplar=trace_id)
+      self._hist_warp_pose_error["rot_deg"].record(rot_deg,
+                                                   exemplar=trace_id)
 
   def latency_histogram(self) -> dict:
     """Cumulative Prometheus-style latency histogram.
@@ -294,6 +323,18 @@ class ServeMetrics:
                       if self.dispatch_gaps else None),
                   "max_ms": round(self.dispatch_gap_max_s * 1e3, 3),
               },
+          },
+          # Native-histogram snapshots (JSON-ready, obs/hist.py): the
+          # source for the mpi_serve_*_nativehist families, the request
+          # quantile gauges, and the off-host shipper's batches.
+          "hist": {
+              "request": self._hist_request.snapshot(),
+              "phase": {phase: h.snapshot()
+                        for phase, h in self._hist_phase.items()},
+              "batch": self._hist_batch.snapshot(),
+              "warp_pose_error": {
+                  comp: h.snapshot()
+                  for comp, h in self._hist_warp_pose_error.items()},
           },
           "per_scene": {
               sid: {

@@ -717,7 +717,8 @@ class MicroBatcher:
     done = self._clock()
     for i, req in enumerate(batch):
       self.metrics.record_request(done - req.t_enqueue,
-                                  scene_id=req.scene_id)
+                                  scene_id=req.scene_id,
+                                  trace_id=req.trace.trace_id or None)
       dspan = req.trace.add_span("dispatch", d0, d1, size=len(batch))
       if recorder is not None:
         recorder.replay(req.trace, parent=dspan)

@@ -15,6 +15,11 @@ stdlib ``http.server`` front end:
   GET  /debug/events  -> the bounded structured lifecycle event log
                    (breaker transitions, scene swaps, SLO alert edges;
                    ?kind= filters, ?recent=N bounds)
+  GET  /debug/tsdb -> windowed history from the on-box time-series ring
+                   (?family= selects one metric family, ?recent=S bounds
+                   the window, ?points=N caps points per series; no
+                   family lists the resident families; 503 unless built
+                   with a tsdb config)
   GET  /debug/profile?seconds=N -> capture a device profile of live
                    traffic (409 while one is in flight; 503 unless the
                    service was built with a profile dir); a configured
@@ -66,6 +71,8 @@ import numpy as np
 from mpi_vision_tpu.core import camera
 from mpi_vision_tpu.core.camera import inv_depths
 from mpi_vision_tpu.obs import prom
+from mpi_vision_tpu.obs import ship as ship_mod
+from mpi_vision_tpu.obs import tsdb as tsdb_mod
 from mpi_vision_tpu.obs.events import EventLog
 from mpi_vision_tpu.obs.profile import DeviceProfiler, ProfileBusyError
 from mpi_vision_tpu.obs.slo import SloConfig, SloTracker
@@ -77,6 +84,7 @@ from mpi_vision_tpu.obs.trace import (
 )
 from mpi_vision_tpu.serve import cache as cache_mod
 from mpi_vision_tpu.serve.edge import EdgeConfig, EdgeFrameCache, warp_frame
+from mpi_vision_tpu.serve.edge.lattice import pose_error
 from mpi_vision_tpu.serve.engine import RenderEngine
 from mpi_vision_tpu.serve.metrics import ServeMetrics
 from mpi_vision_tpu.serve.resilience import (
@@ -178,6 +186,18 @@ class RenderService:
     events: the lifecycle event log (``obs.events.EventLog``; a private
       one is made if omitted) serving ``/debug/events`` — breaker
       transitions, watchdog trips, scene swaps, SLO alert edges.
+    tsdb: the on-box time-series ring (``obs.tsdb``): pass a
+      ``TsdbConfig`` to sample every ``/metrics`` family on its cadence
+      (the recorder thread starts here and stops in ``close``) and
+      serve windowed history at ``GET /debug/tsdb``; pass a pre-built
+      ``TsdbRecorder`` to adopt it un-started (tests drive ``sample()``
+      with fake clocks); None disables the endpoint (503).
+    ship: off-host telemetry shipping (``obs.ship``): pass a
+      ``ShipConfig`` to batch rotated event-log segments, SLO alert
+      edges, and incremental tsdb snapshots to its HTTP sink on a
+      daemon thread (retry + disk spool; counted, never fatal, never on
+      the request path); pass a pre-built ``TelemetryShipper`` to adopt
+      it un-started (tests drive ``tick()``); None disables shipping.
     metrics_ttl_s: ``/metrics`` exposition-string cache TTL
       (``obs.prom.ExpositionCache``) — scrape storms on the aggregated
       cluster endpoint cost one snapshot render per window instead of
@@ -200,6 +220,8 @@ class RenderService:
                profile_hook=None, alert_hook=None,
                slo: "SloConfig | SloTracker | None" = SloConfig(),
                events: EventLog | None = None,
+               tsdb: "tsdb_mod.TsdbConfig | tsdb_mod.TsdbRecorder | None" = None,
+               ship: "ship_mod.ShipConfig | ship_mod.TelemetryShipper | None" = None,
                metrics_ttl_s: float = 0.25, clock=time.monotonic):
     if cpu_fallback not in ("auto", "on", "off"):
       raise ValueError(
@@ -300,17 +322,42 @@ class RenderService:
             if self.fallback_engine is not None else None)).start()
     self._metrics_cache = prom.ExpositionCache(
         self._render_metrics_text, ttl_s=metrics_ttl_s, clock=clock)
+    # Flight-recorder legs (obs/tsdb.py, obs/ship.py): configs build and
+    # START the daemon threads; pre-built objects are adopted un-started
+    # (tests drive sample()/tick() against fake clocks/sinks). The tsdb
+    # recorder samples _render_metrics_text directly — history must be
+    # fresh samples, not the exposition cache's memoized string.
+    if isinstance(tsdb, tsdb_mod.TsdbRecorder):
+      self.tsdb = tsdb
+    elif tsdb is not None:
+      self.tsdb = tsdb_mod.TsdbRecorder(
+          self._render_metrics_text, tsdb).start()
+    else:
+      self.tsdb = None
+    if isinstance(ship, ship_mod.TelemetryShipper):
+      self.shipper = ship
+      if self.shipper.tsdb is None:
+        self.shipper.tsdb = self.tsdb
+    elif ship is not None:
+      self.shipper = ship_mod.TelemetryShipper(ship, tsdb=self.tsdb).start()
+    else:
+      self.shipper = None
     self._closed = False
 
   def _on_slo_alert(self, name: str, firing: bool, details: dict) -> None:
     record = self.events.emit("slo_alert", slo=name, firing=firing,
                               **details)
-    if self.alert_hook is None:
-      return
-    # NULL_EVENTS returns None; the hook still needs the edge's facts.
+    # NULL_EVENTS returns None; the shipper/hook still need the facts.
     if record is None:
       record = {"kind": "slo_alert", "slo": name, "firing": firing,
                 **details}
+    shipper = getattr(self, "shipper", None)
+    if shipper is not None:
+      # O(1) queue append — the off-host delivery happens on the
+      # shipper's own thread, never inside the alert (request) path.
+      shipper.note_alert(record)
+    if self.alert_hook is None:
+      return
     # Off the request path: alert edges fire inside SloTracker.check()
     # under a live render, and a slow pager webhook must not add its
     # latency to the very requests it is paging about. ONE worker
@@ -559,7 +606,8 @@ class RenderService:
       if kind == "hit":
         span = trace.start_span("edge_hit", cell=list(cell))
         trace.end_span(span)
-        self.metrics.record_request(self._clock() - t0, scene_id=scene_id)
+        self.metrics.record_request(self._clock() - t0, scene_id=scene_id,
+                                    trace_id=trace.trace_id or None)
         trace.finish()
         return entry.frame, {"edge": "hit", "etag": entry.etag,
                              "max_age_s": max_age}
@@ -569,7 +617,15 @@ class RenderService:
         img = warp_frame(entry.frame, entry.pose, pose, entry.intrinsics,
                          entry.plane_depth)
         trace.end_span(span)
-        self.metrics.record_request(self._clock() - t0, scene_id=scene_id)
+        # Warp-quality telemetry (ROADMAP satellite): how far the served
+        # frame's render pose was from the request. Drift here shows in
+        # mpi_serve_edge_warp_pose_error BEFORE users see smeared
+        # pixels, and the exemplar links the tail to a recorded trace.
+        warp_trans, warp_rot_deg = pose_error(pose, entry.pose)
+        self.metrics.record_warp_pose_error(
+            warp_trans, warp_rot_deg, trace_id=trace.trace_id or None)
+        self.metrics.record_request(self._clock() - t0, scene_id=scene_id,
+                                    trace_id=trace.trace_id or None)
         trace.finish()
         return img, {"edge": "warp", "etag": None, "max_age_s": max_age}
     except Exception as e:
@@ -607,6 +663,14 @@ class RenderService:
                                      self.metrics.latency_histogram())
     if self.slo is not None:
       text += self.slo.metrics_text()
+    # Flight-recorder families ride every exposition (zeros while the
+    # knobs are off — the always-exposed convention).
+    tsdb = getattr(self, "tsdb", None)
+    text += tsdb_mod.registry(
+        tsdb.stats() if tsdb is not None else None).render()
+    shipper = getattr(self, "shipper", None)
+    text += ship_mod.registry(
+        shipper.stats() if shipper is not None else None).render()
     return text
 
   def metrics_text(self) -> str:
@@ -654,6 +718,10 @@ class RenderService:
     out["events"] = {"emitted": self.events.emitted,
                      "dropped": self.events.dropped,
                      "sink_errors": self.events.sink_errors}
+    if self.tsdb is not None:
+      out["tsdb"] = self.tsdb.stats()
+    if self.shipper is not None:
+      out["ship"] = self.shipper.stats()
     if self.profiler is not None:
       out["profile"] = {"captures": self.profiler.captures,
                         "hook_failures": self.profile_hook_failures}
@@ -661,6 +729,22 @@ class RenderService:
       with self._alert_hook_lock:
         out["alert_hook"] = {"runs": self.alert_hook_runs,
                              "failures": self.alert_hook_failures}
+    return out
+
+  def events_snapshot(self, recent: int = 128,
+                      kind: str | None = None) -> dict:
+    """The ``/debug/events`` payload, with the retention story closed:
+    the ring's snapshot (plus the sink's rotation accounting) and — with
+    a shipper attached — how many rotated segments made it off-host vs.
+    are still waiting on disk."""
+    out = self.events.snapshot(recent=recent, kind=kind)
+    if self.shipper is not None:
+      ship_stats = self.shipper.stats()
+      out.setdefault("retention", {})["shipped"] = {
+          "segments_shipped": ship_stats["segments_shipped"],
+          "segments_pending": self.shipper.pending_segments(),
+          "segment_errors": ship_stats["segment_errors"],
+      }
     return out
 
   def healthz(self) -> dict:
@@ -688,10 +772,28 @@ class RenderService:
       snap_slo = self.slo.snapshot()
       parts = []
       for name in slo_firing:
+        if ":" in name:
+          # Per-scene quantile alert ("latency_p99:scene_007"): the
+          # windowed quantile lives in the per_scene block.
+          base, _, scene = name.partition(":")
+          entry = (snap_slo.get("per_scene") or {}).get(scene)
+          thr_ms = snap_slo["objectives"].get(base, {}).get("threshold_ms")
+          q_ms = entry["fast"]["quantile_ms"] if entry is not None else None
+          if q_ms is not None and thr_ms is not None:
+            parts.append(f"{name} at {q_ms:g}ms (> {thr_ms:g}ms)")
+          else:
+            parts.append(name)
+          continue
         obj = snap_slo["objectives"][name]
-        parts.append(f"{name} burning at {obj['fast']['burn_rate']:g}x "
-                     f"(>= {snap_slo['config']['burn_threshold']:g}x "
-                     f"of a {obj['target']:g} target)")
+        if "quantile" in obj:
+          q_ms = obj["fast"]["quantile_ms"]
+          parts.append(
+              f"{name} at {q_ms:g}ms (> {obj['threshold_ms']:g}ms "
+              "threshold)" if q_ms is not None else name)
+        else:
+          parts.append(f"{name} burning at {obj['fast']['burn_rate']:g}x "
+                       f"(>= {snap_slo['config']['burn_threshold']:g}x "
+                       f"of a {obj['target']:g} target)")
       slo_reason = "SLO alert firing: " + "; ".join(parts)
     if self._closed:
       status, reason = "unhealthy", "service closed"
@@ -730,6 +832,10 @@ class RenderService:
   def close(self) -> None:
     if not self._closed:
       self._closed = True
+      if self.tsdb is not None:
+        self.tsdb.stop()
+      if self.shipper is not None:
+        self.shipper.stop()
       self.scheduler.stop()
       with self._alert_hook_lock:
         hook_queue = self._alert_hook_queue
@@ -827,8 +933,16 @@ class _Handler(BaseHTTPRequestHandler):
     elif parsed.path == "/stats":
       self._send_json(self.service.stats())
     elif parsed.path == "/metrics":
+      # Default: classic text format, exemplars STRIPPED — a `#` after
+      # the value is a parse error that fails a vanilla Prometheus
+      # scrape wholesale. ?exemplars=1 (the cluster router's scrape,
+      # OpenMetrics-aware collectors) serves them inline.
+      text = self.service.metrics_text()
+      query = urllib.parse.parse_qs(parsed.query)
+      if query.get("exemplars", ["0"])[0] not in ("1", "true"):
+        text = prom.strip_exemplars(text)
       self._send_bytes(
-          self.service.metrics_text().encode(),
+          text.encode(),
           content_type="text/plain; version=0.0.4; charset=utf-8")
     elif parsed.path == "/debug/traces":
       # ?id=<trace_id> searches the retained traces for one id (ring +
@@ -849,12 +963,37 @@ class _Handler(BaseHTTPRequestHandler):
       except ValueError:
         self._send_json({"error": "recent must be an integer"}, status=400)
         return
-      self._send_json(self.service.events.snapshot(recent=recent,
+      self._send_json(self.service.events_snapshot(recent=recent,
                                                    kind=kind))
+    elif parsed.path == "/debug/tsdb":
+      self._do_tsdb(parsed.query)
     elif parsed.path == "/debug/profile":
       self._do_profile(parsed.query)
     else:
       self._send_json({"error": f"unknown path {self.path}"}, status=404)
+
+  def _do_tsdb(self, query: str) -> None:
+    """``/debug/tsdb?family=&recent=&points=``: windowed history from
+    the on-box time-series ring. Without ``family``, the index: resident
+    family names + recorder stats."""
+    if self.service.tsdb is None:
+      self._send_json(
+          {"error": "tsdb disabled: construct RenderService with tsdb "
+                    "(serve --tsdb-interval-s)"}, status=503)
+      return
+    try:
+      family, recent, points = tsdb_mod.parse_query(
+          urllib.parse.parse_qs(query))
+    except ValueError:
+      self._send_json({"error": "recent must be a number and points an "
+                                "integer"}, status=400)
+      return
+    if family:
+      self._send_json(self.service.tsdb.query(family, recent_s=recent,
+                                              points=points))
+    else:
+      self._send_json({"families": self.service.tsdb.families(),
+                       "stats": self.service.tsdb.stats()})
 
   def _do_profile(self, query: str) -> None:
     try:

@@ -77,7 +77,8 @@ def test_lint_covers_the_ckpt_package_and_train_loop():
           "cluster/ring.py", "cluster/pool.py", "cluster/supervisor.py",
           "edge/cache.py", "edge/lattice.py", "edge/warp.py",
           "obs/slo.py", "obs/events.py", "obs/trace.py",
-          "obs/prom.py"} <= rel
+          "obs/prom.py", "obs/hist.py", "obs/tsdb.py",
+          "obs/ship.py"} <= rel
 
 
 def test_lint_actually_catches_calls():

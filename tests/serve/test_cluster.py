@@ -635,7 +635,7 @@ def _obs_backend(metrics_text, health_status="ok"):
       return 200, {}, json.dumps({"status": health_status}).encode()
     if path == "/stats":
       return 200, {}, json.dumps({"requests": 1}).encode()
-    if path == "/metrics":
+    if path.startswith("/metrics"):  # the router scrapes ?exemplars=1
       return 200, {}, metrics_text.encode()
     return 404, {}, b"{}"
   return handler

@@ -1,0 +1,1057 @@
+"""Flight-recorder tests: native histograms, quantile SLOs, tsdb, shipper.
+
+The acceptance pin for PR 10 lives here: a latency fault window on ONE
+scene fires a per-scene p99 quantile-SLO alert visible simultaneously on
+``/healthz`` (degraded with the quantile reason), ``/stats`` (the
+``per_scene`` slo block), and ``/metrics`` (the native histogram with an
+exemplar linking to a recorded trace id); the episode is queryable
+afterward from ``/debug/tsdb`` history through the cluster router; and
+every alert edge reaches a fake HTTP sink via the shipper — with the
+sink down for part of the window and nothing lost (the disk spool drains
+on recovery).
+
+Everything else is fake-clock unit coverage: the exponential-bucket
+math, exact merge (time buckets and backends), exemplar retention
+through the router's pool aggregation (pinned against per-backend
+ground truth), the tsdb ring's bounds, and the shipper's
+retry/spool/segment accounting.
+"""
+
+import json
+import math
+import os
+import threading
+import urllib.parse
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mpi_vision_tpu.obs import hist as hist_mod
+from mpi_vision_tpu.obs import prom
+from mpi_vision_tpu.obs import ship as ship_mod
+from mpi_vision_tpu.obs import tsdb as tsdb_mod
+from mpi_vision_tpu.obs.events import EventLog, file_sink
+from mpi_vision_tpu.obs.slo import SloConfig, SloTracker, verdict
+from mpi_vision_tpu.obs.trace import Tracer
+from mpi_vision_tpu.serve import RenderService, make_http_server
+from mpi_vision_tpu.serve.cluster.router import Router
+from mpi_vision_tpu.serve.metrics import ServeMetrics
+
+H = W = 16
+P = 4
+
+
+class FakeClock:
+  def __init__(self, t=1000.0):
+    self.t = t
+
+  def __call__(self):
+    return self.t
+
+  def advance(self, dt):
+    self.t += dt
+    return self.t
+
+
+# --- native histogram core ------------------------------------------------
+
+
+class TestNativeHistogram:
+
+  def test_bucket_bounds_cover_the_index(self):
+    for value in (1e-4, 0.003, 0.5, 1.0, 7.3, 120.0):
+      idx = hist_mod.bucket_index(value)
+      lo, hi = hist_mod.bucket_bounds(idx)
+      assert lo < value <= hi or math.isclose(value, lo)
+
+  def test_quantiles_track_ground_truth_within_bucket_width(self):
+    rng = np.random.default_rng(7)
+    values = rng.lognormal(-3.0, 1.0, 4000)
+    h = hist_mod.NativeHistogram()
+    for v in values:
+      h.record(float(v))
+    assert h.count == 4000
+    for q in (0.5, 0.9, 0.99):
+      est, true = h.quantile(q), float(np.quantile(values, q))
+      # Exponential buckets at SCALE=4 are ~19% wide: the estimate must
+      # land within one bucket of truth.
+      assert abs(est - true) / true < 0.2, (q, est, true)
+
+  def test_zero_and_negative_land_in_the_zero_bucket(self):
+    h = hist_mod.NativeHistogram()
+    h.record(0.0)
+    h.record(-1.0)
+    h.record(1.0)
+    assert h.zero == 2 and h.count == 3
+    assert h.quantile(0.25) == 0.0
+    assert h.quantile(1.0) > 0.0
+
+  def test_empty_quantile_is_none(self):
+    assert hist_mod.NativeHistogram().quantile(0.99) is None
+    assert hist_mod.quantile_of(None, 0.5) is None
+    assert hist_mod.quantile_of({"count": 0}, 0.5) is None
+
+  def test_extreme_values_clamp_instead_of_growing_without_bound(self):
+    h = hist_mod.NativeHistogram()
+    h.record(1e-300)
+    h.record(1e300)
+    assert set(h.buckets) == {hist_mod.MIN_IDX, hist_mod.MAX_IDX}
+
+  def test_merge_equals_combined_recording(self):
+    rng = np.random.default_rng(0)
+    a_vals = rng.lognormal(-3, 0.5, 500)
+    b_vals = rng.lognormal(-1, 0.5, 500)
+    a, b, combined = (hist_mod.NativeHistogram() for _ in range(3))
+    for v in a_vals:
+      a.record(float(v))
+      combined.record(float(v))
+    for v in b_vals:
+      b.record(float(v))
+      combined.record(float(v))
+    merged = hist_mod.merge([a.snapshot(), b.snapshot()])
+    assert merged.count == combined.count
+    assert merged.buckets == combined.buckets
+    for q in (0.5, 0.99):
+      assert merged.quantile(q) == pytest.approx(combined.quantile(q))
+
+  def test_exemplars_newest_wins_and_merge_keeps_the_larger(self):
+    a = hist_mod.NativeHistogram()
+    a.record(0.1, exemplar="first")
+    a.record(0.1, exemplar="second")  # same bucket: newest wins
+    idx = hist_mod.bucket_index(0.1)
+    assert a.exemplars[idx][0] == "second"
+    b = hist_mod.NativeHistogram()
+    b.record(0.105, exemplar="bigger")  # same bucket, larger value
+    merged = hist_mod.merge([a.snapshot(), b.snapshot()])
+    assert merged.exemplars[idx][0] == "bigger"
+
+  def test_snapshot_is_json_ready_and_round_trips(self):
+    h = hist_mod.NativeHistogram()
+    for v in (0.01, 0.02, 0.5, 0.0):
+      h.record(v, exemplar="tid")
+    snap = json.loads(json.dumps(h.snapshot()))
+    back = hist_mod.merge([snap])
+    assert back.count == h.count and back.zero == h.zero
+    assert back.quantile(0.5) == pytest.approx(h.quantile(0.5))
+
+  def test_fraction_over_threshold(self):
+    h = hist_mod.NativeHistogram()
+    for _ in range(90):
+      h.record(0.01)
+    for _ in range(10):
+      h.record(1.0)
+    frac = h.fraction_over(0.1)
+    assert 0.05 <= frac <= 0.15  # ~10%, within bucket interpolation
+
+
+# --- exposition: render, parse, pool-merge --------------------------------
+
+
+def _metrics_text(latencies, trace_ids=None):
+  m = ServeMetrics()
+  for i, lat in enumerate(latencies):
+    m.record_request(lat, scene_id="s0",
+                     trace_id=trace_ids[i] if trace_ids else None)
+  return prom.render_serve_metrics(
+      m.snapshot(cache_stats=None), m.latency_histogram())
+
+
+class TestExposition:
+
+  def test_nativehist_family_round_trips_with_exemplars(self):
+    text = _metrics_text([0.01, 0.5], trace_ids=["aaa", "bbb"])
+    fam = prom.parse_metrics_text(text)[
+        "mpi_serve_request_latency_nativehist"]
+    assert fam["type"] == "histogram"
+    snaps = hist_mod.snapshots_from_samples(fam["samples"])
+    snap = snaps[()]
+    assert snap["count"] == 2
+    assert hist_mod.quantile_of(snap, 0.5) == pytest.approx(0.01, rel=0.2)
+    # Exemplar trace ids parsed off the bucket samples.
+    tids = {ex[0] for ex in fam["exemplars"].values()}
+    assert tids == {"aaa", "bbb"}
+
+  def test_pool_aggregation_is_the_exact_bucket_merge(self):
+    """The router-side contract (the PR's router-aggregation satellite):
+    summing per-idx bucket samples across backends IS the exact
+    histogram merge — pooled quantiles match the combined distribution's
+    ground truth, unlike the non-additive gauges PR 7 had to drop."""
+    rng = np.random.default_rng(3)
+    fast = [float(v) for v in rng.lognormal(-4, 0.3, 400)]  # ~18 ms
+    slow = [float(v) for v in rng.lognormal(-1, 0.3, 100)]  # ~370 ms
+    t1 = _metrics_text(fast, trace_ids=["fast-tid"] * len(fast))
+    t2 = _metrics_text(slow, trace_ids=["slow-tid"] * len(slow))
+    agg = prom.aggregate_metrics_texts(
+        [t1, t2], drop=hist_mod.NON_ADDITIVE_FAMILIES)
+    fam = prom.parse_metrics_text(agg)[
+        "mpi_serve_request_latency_nativehist"]
+    snap = hist_mod.snapshots_from_samples(fam["samples"])[()]
+    assert snap["count"] == 500
+    combined = sorted(fast + slow)
+    for q in (0.5, 0.9, 0.99):
+      pooled = hist_mod.quantile_of(snap, q)
+      true = float(np.quantile(combined, q))
+      assert abs(pooled - true) / true < 0.2, (q, pooled, true)
+    # The per-backend quantile gauges were dropped (summed p99s are
+    # garbage) while the buckets merged.
+    assert "mpi_serve_request_quantile_seconds" not in \
+        prom.parse_metrics_text(agg)
+    # Exemplars survive the merge; colliding buckets keep the larger
+    # observation (the tail).
+    assert 'trace_id="slow-tid"' in agg
+
+  def test_serve_registry_quantile_gauges_agree_with_the_hist(self):
+    m = ServeMetrics()
+    for lat in (0.01, 0.02, 0.03, 0.4):
+      m.record_request(lat)
+    stats = m.snapshot(cache_stats=None)
+    fams = prom.parse_metrics_text(
+        prom.render_serve_metrics(stats, m.latency_histogram()))
+    gauge = fams["mpi_serve_request_quantile_seconds"]["samples"]
+    for q in hist_mod.QUANTILES:
+      want = hist_mod.quantile_of(stats["hist"]["request"], q)
+      got = gauge[("mpi_serve_request_quantile_seconds",
+                   (("q", hist_mod.q_label(q)),))]
+      assert got == pytest.approx(want)
+
+  def test_strip_exemplars_yields_classic_format(self):
+    """The default /metrics response must be parseable by a vanilla
+    Prometheus text parser: no `#` after a sample value."""
+    text = _metrics_text([0.01, 0.5], trace_ids=["aaa", "bbb"])
+    assert " # {" in text
+    plain = prom.strip_exemplars(text)
+    assert " # {" not in plain
+    # Same samples, exemplars gone.
+    a = prom.parse_metrics_text(text)["mpi_serve_request_latency_nativehist"]
+    b = prom.parse_metrics_text(plain)["mpi_serve_request_latency_nativehist"]
+    assert a["samples"] == b["samples"]
+    assert b["exemplars"] == {}
+
+  def test_warp_pose_error_family_records_both_components(self):
+    m = ServeMetrics()
+    m.record_warp_pose_error(0.03, 1.5, trace_id="warp-tid")
+    stats = m.snapshot(cache_stats=None)
+    wpe = stats["hist"]["warp_pose_error"]
+    assert wpe["trans"]["count"] == 1 and wpe["rot_deg"]["count"] == 1
+    text = prom.render_serve_metrics(stats, m.latency_histogram())
+    fam = prom.parse_metrics_text(text)["mpi_serve_edge_warp_pose_error"]
+    comps = {dict(labels).get("component")
+             for (name, labels) in fam["samples"]
+             if name.endswith("_bucket")}
+    assert comps == {"trans", "rot_deg"}
+    assert 'trace_id="warp-tid"' in text
+
+
+# --- quantile + per-scene SLO objectives ----------------------------------
+
+
+def _qcfg(**kw):
+  base = dict(fast_window_s=10.0, slow_window_s=60.0, bucket_s=1.0,
+              min_requests=5, latency_threshold_s=0.25, quantile=0.99,
+              per_scene=True)
+  base.update(kw)
+  return SloConfig(**base)
+
+
+class TestQuantileSlo:
+
+  def test_config_validation(self):
+    with pytest.raises(ValueError, match="quantile"):
+      SloConfig(quantile=1.5)
+    with pytest.raises(ValueError, match="per_scene"):
+      SloConfig(per_scene=True)  # needs a quantile
+    assert SloConfig(quantile=0.99).quantile_name() == "latency_p99"
+    assert SloConfig(quantile=0.999).quantile_name() == "latency_p99.9"
+    assert SloConfig().quantile_name() is None
+
+  def test_healthy_traffic_is_quiet(self):
+    t = SloTracker(_qcfg(), clock=FakeClock())
+    for _ in range(50):
+      t.record(ok=True, latency_s=0.01, scene_id="a")
+    assert t.alerts_firing() == []
+    snap = t.snapshot()
+    q99 = snap["objectives"]["latency_p99"]
+    assert q99["fast"]["quantile_ms"] < 250
+    assert snap["per_scene"]["a"]["alert"]["firing"] is False
+
+  def test_single_hot_scene_fires_its_own_alert(self):
+    clock = FakeClock()
+    alerts = []
+    t = SloTracker(_qcfg(), clock=clock,
+                   on_alert=lambda n, f, d: alerts.append((n, f, d)))
+    # 100 healthy requests on scene a, 20 slow ones on scene b: scene
+    # b's p99 is deep over threshold while a's stays fine.
+    for _ in range(100):
+      t.record(ok=True, latency_s=0.01, scene_id="a")
+    for _ in range(20):
+      t.record(ok=True, latency_s=0.9, scene_id="b")
+    firing = t.alerts_firing()
+    assert "latency_p99:b" in firing
+    assert "latency_p99:a" not in firing
+    fire = next(a for a in alerts if a[0] == "latency_p99:b" and a[1])
+    assert fire[2]["scene"] == "b" and fire[2]["fast_ms"] > 250
+    snap = t.snapshot()
+    assert snap["per_scene"]["b"]["alert"]["firing"] is True
+    assert snap["per_scene"]["a"]["alert"]["firing"] is False
+    assert snap["per_scene"]["b"]["slow"]["quantile_ms"] > 250
+    # Recovery: the slow scene's samples age out of the fast window.
+    clock.advance(11)
+    for _ in range(10):
+      t.record(ok=True, latency_s=0.01, scene_id="b")
+    assert "latency_p99:b" not in t.alerts_firing()
+    clear = next(a for a in alerts if a[0] == "latency_p99:b" and not a[1])
+    assert clear[2]["scene"] == "b"
+
+  def test_scene_whose_traffic_vanishes_still_clears(self):
+    clock = FakeClock()
+    t = SloTracker(_qcfg(), clock=clock)
+    for _ in range(20):
+      t.record(ok=True, latency_s=0.9, scene_id="b")
+    assert "latency_p99:b" in t.alerts_firing()
+    # No further traffic at all: once the fast window drains the alert
+    # must clear on a bare scrape (an abandoned scene cannot page
+    # forever).
+    clock.advance(11)
+    assert "latency_p99:b" not in t.alerts_firing()
+
+  def test_min_requests_guards_idle_spikes(self):
+    t = SloTracker(_qcfg(min_requests=50), clock=FakeClock())
+    for _ in range(10):
+      t.record(ok=True, latency_s=0.9, scene_id="b")
+    assert t.alerts_firing() == []
+
+  def test_window_memo_invalidates_on_new_data(self):
+    """The merged quantile windows are memoized per (total, bucket) so a
+    healthz probe doesn't pay the full ring-merge three times — but new
+    data must invalidate it immediately, never serve a stale quantile."""
+    clock = FakeClock()
+    t = SloTracker(_qcfg(), clock=clock)
+    for _ in range(20):
+      t.record(ok=True, latency_s=0.01, scene_id="a")
+    first = t.snapshot()["objectives"]["latency_p99"]["fast"]["quantile_ms"]
+    assert t.snapshot()["objectives"]["latency_p99"]["fast"][
+        "quantile_ms"] == first  # memo hit: same answer
+    for _ in range(50):
+      t.record(ok=True, latency_s=0.9, scene_id="a")
+    after = t.snapshot()["objectives"]["latency_p99"]["fast"]["quantile_ms"]
+    assert after > first  # new data visible at once
+
+  def test_record_does_not_pay_quantile_merges_mid_bucket(self):
+    """The hot-path contract: record() evaluates quantile alerts only on
+    bucket rotation (merging every in-window histogram per bad request
+    would tax the scheduler exactly during an incident); scrapes —
+    alerts_firing/snapshot, i.e. healthz probes — evaluate them every
+    time, so alert latency is bounded by min(bucket_s, scrape
+    interval)."""
+    t = SloTracker(_qcfg(), clock=FakeClock())
+    for _ in range(20):
+      t.record(ok=True, latency_s=0.9, scene_id="b")
+    # Mid-bucket, no scrape yet: the quantile alert has not fired...
+    assert not t._alerts["latency_p99"].firing
+    # ...but the very next scrape fires it.
+    assert "latency_p99" in t.alerts_firing()
+
+  def test_scene_cardinality_is_bounded(self):
+    t = SloTracker(_qcfg(), clock=FakeClock())
+    from mpi_vision_tpu.obs import slo as slo_lib
+
+    for i in range(slo_lib.PER_SCENE_CAP + 10):
+      t.record(ok=True, latency_s=0.01, scene_id=f"scene_{i:03d}")
+    snap = t.snapshot()
+    assert len(snap["per_scene"]) <= slo_lib.PER_SCENE_CAP + 1
+    assert "_other" in snap["per_scene"]
+
+  def test_verdict_carries_quantile_and_per_scene_blocks(self):
+    t = SloTracker(_qcfg(), clock=FakeClock())
+    for _ in range(100):
+      t.record(ok=True, latency_s=0.01, scene_id="a")
+    for _ in range(20):
+      t.record(ok=True, latency_s=0.9, scene_id="b")
+    v = verdict(t.snapshot())
+    q99 = v["objectives"]["latency_p99"]
+    assert q99["quantile"] == 0.99 and q99["threshold_ms"] == 250.0
+    assert q99["quantile_ms"] > 250 and q99["pass"] is False
+    assert v["per_scene"]["failing"] == ["b"]
+    assert v["per_scene"]["pass"] is False
+    # The global verdict is judged by the global objectives; the
+    # per-scene block carries its own pass.
+    assert v["pass"] is False
+
+  def test_registry_exposes_quantile_families(self):
+    t = SloTracker(_qcfg(), clock=FakeClock())
+    for _ in range(20):
+      t.record(ok=True, latency_s=0.9, scene_id="b")
+    snap = t.snapshot()
+    fams = prom.parse_metrics_text(t.registry(snap).render())
+    val = fams["mpi_slo_quantile_latency_seconds"]["samples"][
+        ("mpi_slo_quantile_latency_seconds",
+         (("slo", "latency_p99"), ("window", "fast")))]
+    assert val == pytest.approx(
+        snap["objectives"]["latency_p99"]["fast"]["quantile_ms"] / 1e3)
+    assert fams["mpi_slo_quantile"]["samples"][
+        ("mpi_slo_quantile", (("slo", "latency_p99"),))] == 0.99
+    firing_scenes = fams["mpi_slo_scene_alerts_firing"]["samples"][
+        ("mpi_slo_scene_alerts_firing", ())]
+    assert firing_scenes == 1  # scene b's alert
+    # The quantile gauges are registered non-additive (a pool must not
+    # sum p99s).
+    from mpi_vision_tpu.obs import slo as slo_lib
+
+    assert "mpi_slo_quantile_latency_seconds" in \
+        slo_lib.NON_ADDITIVE_FAMILIES
+
+
+# --- tsdb ring ------------------------------------------------------------
+
+
+class TestTsdb:
+
+  def _recorder(self, clock, texts):
+    """A recorder over a canned sequence of exposition texts."""
+    state = {"i": 0}
+
+    def collect():
+      text = texts[min(state["i"], len(texts) - 1)]
+      state["i"] += 1
+      if isinstance(text, Exception):
+        raise text
+      return text
+
+    return tsdb_mod.TsdbRecorder(collect, tsdb_mod.TsdbConfig(
+        interval_s=1.0, max_points=4, max_series=8), clock=clock)
+
+  def test_sample_query_window_and_point_bounds(self):
+    clock = FakeClock(100.0)
+    texts = [f"# TYPE m gauge\nm{{x=\"1\"}} {i}\n" for i in range(6)]
+    rec = self._recorder(clock, texts)
+    for _ in range(6):
+      rec.sample()
+      clock.advance(1.0)
+    assert rec.families() == ["m"]
+    series = rec.query("m")["series"]
+    assert len(series) == 1
+    # max_points=4: the ring kept only the newest 4 points.
+    assert [p[1] for p in series[0]["points"]] == [2.0, 3.0, 4.0, 5.0]
+    # recent window bounds further.
+    recent = rec.query("m", recent_s=2.5)["series"][0]["points"]
+    assert [p[1] for p in recent] == [4.0, 5.0]
+    assert rec.query("m", points=1)["series"][0]["points"] == [[105.0, 5.0]]
+    assert rec.query("absent")["series"] == []
+
+  def test_series_cap_and_collector_errors_are_counted(self):
+    clock = FakeClock()
+    wide = "# TYPE m gauge\n" + "\n".join(
+        f'm{{x="{i}"}} 1' for i in range(12)) + "\n"
+    rec = self._recorder(clock, [wide, RuntimeError("boom")])
+    rec.sample()
+    stats = rec.stats()
+    assert stats["series"] == 8 and stats["dropped_series"] == 4
+    rec.sample()  # the collector raises: counted, never raised
+    assert rec.stats()["sample_errors"] == 1
+
+  def test_nan_and_inf_samples_never_enter_the_ring(self):
+    """NaN ("no data" gauges like the idle quantile ones) and Inf must
+    be skipped at record time: json.dumps would emit literal
+    NaN/Infinity tokens — invalid JSON for every /debug/tsdb consumer
+    and ship-sink collector."""
+    clock = FakeClock()
+    text = ("# TYPE m gauge\nm{x=\"nan\"} NaN\nm{x=\"inf\"} +Inf\n"
+            "m{x=\"ok\"} 1\n")
+    rec = self._recorder(clock, [text])
+    rec.sample()
+    q = rec.query("m")
+    assert len(q["series"]) == 1
+    assert q["series"][0]["labels"] == {"x": "ok"}
+    json.dumps(q)  # must be valid JSON end to end
+    json.dumps(rec.snapshot_since(None))
+
+  def test_points_zero_returns_no_points_not_all(self):
+    clock = FakeClock()
+    rec = self._recorder(clock, ["# TYPE m gauge\nm 1\n"] * 2)
+    rec.sample()
+    rec.sample()
+    assert rec.query("m", points=0)["series"] == []
+    assert len(rec.query("m", points=1)["series"][0]["points"]) == 1
+
+  def test_snapshot_since_is_an_incremental_cursor(self):
+    clock = FakeClock(10.0)
+    rec = self._recorder(clock, ["# TYPE m gauge\nm 1\n"] * 3)
+    rec.sample()
+    clock.advance(5)
+    rec.sample()
+    full = rec.snapshot_since(None)
+    assert len(full["m"][0]["points"]) == 2
+    inc = rec.snapshot_since(12.0)
+    assert [p[1] for p in inc["m"][0]["points"]] == [1.0]
+    assert rec.snapshot_since(99.0) == {}
+
+
+# --- shipper --------------------------------------------------------------
+
+
+class FlakySink:
+  """A sink transport that is down until told otherwise."""
+
+  def __init__(self, down=True):
+    self.down = down
+    self.bodies: list[dict] = []
+
+  def post(self, url, body, timeout):
+    if self.down:
+      raise ConnectionError("sink down")
+    self.bodies.append(json.loads(body))
+    return 200
+
+
+def _shipper(tmp_path, sink, clock, **cfg_kw):
+  cfg = ship_mod.ShipConfig(url="http://sink.invalid/ingest",
+                            spool_dir=str(tmp_path / "spool"), **cfg_kw)
+  return ship_mod.TelemetryShipper(cfg, transport=sink, clock=clock,
+                                   sleep=lambda s: None)
+
+
+class TestShipper:
+
+  def test_outage_spools_then_recovery_drains_in_order(self, tmp_path):
+    clock = FakeClock()
+    sink = FlakySink(down=True)
+    shipper = _shipper(tmp_path, sink, clock)
+    shipper.note_alert({"kind": "slo_alert", "slo": "x", "firing": True})
+    shipper.tick()  # down: batch spooled
+    clock.advance(1)
+    shipper.note_alert({"kind": "slo_alert", "slo": "x", "firing": False})
+    shipper.tick()  # still down: second batch spooled
+    stats = shipper.stats()
+    assert stats["spooled"] == 2 and stats["spool_files"] == 2
+    assert stats["batches_shipped"] == 0 and stats["post_failures"] > 0
+    sink.down = False
+    shipper.tick()  # recovery: the spool drains oldest-first
+    stats = shipper.stats()
+    assert stats["spool_files"] == 0 and stats["batches_shipped"] == 2
+    edges = [e for b in sink.bodies for it in b["items"]
+             for e in it["edges"]]
+    assert [e["firing"] for e in edges] == [True, False]  # order kept
+
+  def test_spool_budget_drops_oldest(self, tmp_path):
+    clock = FakeClock()
+    sink = FlakySink(down=True)
+    shipper = _shipper(tmp_path, sink, clock, spool_budget_bytes=400)
+    for i in range(5):
+      shipper.note_alert({"kind": "slo_alert", "slo": f"pad{i}" * 20,
+                          "firing": True})
+      shipper.tick()
+    stats = shipper.stats()
+    assert stats["spool_dropped"] >= 1
+    assert stats["spool_bytes"] <= 400
+
+  def test_oversized_batch_is_never_evicted_by_its_own_spool(self, tmp_path):
+    """A batch larger than the whole spool budget must survive its own
+    budget sweep: _spool returning True advances the tsdb cursor, so
+    evicting the just-written file would silently lose that window
+    (bounded overshoot beats silent loss)."""
+    clock = FakeClock()
+    sink = FlakySink(down=True)
+    shipper = _shipper(tmp_path, sink, clock, spool_budget_bytes=64)
+    shipper.note_alert({"kind": "slo_alert", "pad": "x" * 500})
+    shipper.tick()
+    stats = shipper.stats()
+    assert stats["spool_files"] == 1 and stats["spool_dropped"] == 0
+    sink.down = False
+    shipper.tick()
+    assert shipper.stats()["spool_files"] == 0
+    assert any(it["kind"] == "slo_alert_edges"
+               for b in sink.bodies for it in b.get("items", []))
+
+  def test_without_spool_failed_batches_drop_counted(self, tmp_path):
+    clock = FakeClock()
+    sink = FlakySink(down=True)
+    cfg = ship_mod.ShipConfig(url="http://sink.invalid/i", spool_dir=None)
+    shipper = ship_mod.TelemetryShipper(cfg, transport=sink, clock=clock,
+                                        sleep=lambda s: None)
+    shipper.note_alert({"kind": "slo_alert"})
+    shipper.tick()
+    assert shipper.stats()["spool_dropped"] == 1
+
+  def test_cursor_holds_when_batch_neither_ships_nor_spools(self):
+    """Spool off + sink down: the batch is gone, but its tsdb points
+    still sit in the ring — the cursor must NOT advance, so the next
+    successful tick re-ships them for free instead of stranding up to a
+    whole interval of history."""
+    clock = FakeClock(0.0)
+    rec = tsdb_mod.TsdbRecorder(lambda: "# TYPE m gauge\nm 1\n",
+                                tsdb_mod.TsdbConfig(interval_s=1.0),
+                                clock=clock)
+    rec.sample()  # point at ts=0.0
+    sink = FlakySink(down=True)
+    cfg = ship_mod.ShipConfig(url="http://x/i", spool_dir=None)
+    shipper = ship_mod.TelemetryShipper(cfg, tsdb=rec, transport=sink,
+                                        clock=clock, sleep=lambda s: None)
+    shipper.tick()  # down, no spool: dropped — cursor must hold
+    sink.down = False
+    shipper.tick()
+    shipped_ts = [p[0] for b in sink.bodies for it in b.get("items", [])
+                  if it["kind"] == "tsdb"
+                  for series in it["families"]["m"]
+                  for p in series["points"]]
+    assert shipped_ts == [0.0]  # recovered from the ring, not lost
+
+  def test_rotated_segments_ship_and_delete(self, tmp_path):
+    clock = FakeClock()
+    sink = FlakySink(down=False)
+    events_path = str(tmp_path / "events.jsonl")
+    # Tiny rotation budget: a few emits rotate segments out.
+    sink_fn = file_sink(events_path, max_bytes=64, keep=2)
+    log = EventLog(clock=clock, sink=sink_fn)
+    for i in range(12):
+      log.emit("tick", i=i, pad="x" * 40)
+    assert sink_fn.rotations >= 2
+    assert sink_fn.segments_dropped >= 1  # rotated off the end, unshipped
+    snap = log.snapshot()
+    assert snap["retention"]["rotations"] == sink_fn.rotations
+    assert snap["retention"]["segments_dropped"] == \
+        sink_fn.segments_dropped
+    cfg = ship_mod.ShipConfig(url="http://sink.invalid/i",
+                              events_path=events_path, events_keep=2)
+    shipper = ship_mod.TelemetryShipper(cfg, transport=sink, clock=clock,
+                                        sleep=lambda s: None)
+    pending = shipper.pending_segments()
+    assert pending >= 1
+    shipper.tick()
+    assert shipper.stats()["segments_shipped"] == pending
+    assert shipper.pending_segments() == 0  # delivered => deleted
+    segs = [b for b in sink.bodies if b.get("kind") == "mpi_events_segment"]
+    assert len(segs) == pending
+    assert all("tick" in s["content"] for s in segs)
+    # The sink goes down: segments survive on disk for the next tick.
+    for i in range(12):
+      log.emit("tick", i=i, pad="y" * 40)
+    sink.down = True
+    before = shipper.pending_segments()
+    assert before >= 1
+    shipper.tick()
+    assert shipper.pending_segments() == before
+    sink_fn.close()
+
+  def test_spool_sequence_survives_a_process_restart(self, tmp_path):
+    """A restarted shipper must resume the spool sequence PAST the
+    previous process's files: restarting at 1 would os.replace over
+    them — losing exactly the telemetry the spool exists to preserve —
+    and break the oldest-first drain order."""
+    clock = FakeClock()
+    sink = FlakySink(down=True)
+    first = _shipper(tmp_path, sink, clock)
+    first.note_alert({"kind": "slo_alert", "run": 1})
+    first.tick()
+    assert first.stats()["spool_files"] == 1
+    # "Restart": a fresh shipper over the same spool dir.
+    second = _shipper(tmp_path, sink, clock)
+    second.note_alert({"kind": "slo_alert", "run": 2})
+    second.tick()
+    assert second.stats()["spool_files"] == 2  # nothing overwritten
+    sink.down = False
+    second.tick()
+    runs = [e["run"] for b in sink.bodies for it in b["items"]
+            for e in it["edges"]]
+    assert runs == [1, 2]  # both survived, drained oldest-first
+
+  def test_segments_are_claimed_before_shipping(self, tmp_path):
+    """The rotation TOCTOU guard: a sink-down tick atomically renames
+    rotated segments OUT of rotation's FILE.N namespace before any POST,
+    so a rotation that lands mid-outage can neither overwrite a segment
+    being shipped nor be deleted in its place; everything — claimed and
+    newly rotated — arrives once the sink recovers."""
+    clock = FakeClock()
+    sink = FlakySink(down=True)
+    events_path = str(tmp_path / "events.jsonl")
+    sink_fn = file_sink(events_path, max_bytes=64, keep=2)
+    log = EventLog(clock=clock, sink=sink_fn)
+    for i in range(8):
+      log.emit("gen1", i=i, pad="x" * 40)
+    cfg = ship_mod.ShipConfig(url="http://sink.invalid/i",
+                              events_path=events_path, events_keep=2)
+    shipper = ship_mod.TelemetryShipper(cfg, transport=sink, clock=clock,
+                                        sleep=lambda s: None)
+    first_wave = shipper.pending_segments()
+    assert first_wave >= 1
+    shipper.tick()  # sink down: segments CLAIMED (renamed), not lost
+    assert shipper.pending_segments() == first_wave
+    assert not any(os.path.exists(f"{events_path}.{i}")
+                   for i in (1, 2))  # rotation's slots are free again
+    # Rotation keeps going during the outage — new segments appear in
+    # the now-free slots without touching the claimed ones.
+    for i in range(8):
+      log.emit("gen2", i=i, pad="y" * 40)
+    assert shipper.pending_segments() > first_wave
+    sink.down = False
+    shipper.tick()
+    assert shipper.pending_segments() == 0
+    contents = "".join(b["content"] for b in sink.bodies
+                       if b.get("kind") == "mpi_events_segment")
+    assert "gen1" in contents and "gen2" in contents  # nothing lost
+    sink_fn.close()
+
+  def test_garbled_sink_response_is_retried_and_spooled(self, tmp_path,
+                                                        monkeypatch):
+    """A half-dead sink raising http.client.HTTPException (BadStatusLine,
+    IncompleteRead) must look like a down sink — retried then spooled —
+    not escape as a tick_error that silently drops the drained edges."""
+    import http.client
+
+    # The real transport maps HTTPException -> ConnectionError (the
+    # router-transport contract).
+    monkeypatch.setattr(
+        "urllib.request.urlopen",
+        lambda req, timeout: (_ for _ in ()).throw(
+            http.client.BadStatusLine("garbage")))
+    with pytest.raises(ConnectionError):
+      ship_mod.HttpPostTransport().post("http://x/i", b"{}", 1.0)
+
+    # End to end, even a transport that BREAKS the contract and raises
+    # something else: the arc still retries and spools, never drops.
+    class GarbledSink:
+      def post(self, url, body, timeout):
+        raise http.client.BadStatusLine("garbage")
+
+    clock = FakeClock()
+    cfg = ship_mod.ShipConfig(url="http://x/i",
+                              spool_dir=str(tmp_path / "spool"))
+    shipper = ship_mod.TelemetryShipper(
+        cfg, transport=GarbledSink(), clock=clock, sleep=lambda s: None)
+    shipper.note_alert({"kind": "slo_alert"})
+    shipper.tick()
+    assert shipper.stats()["spooled"] == 1
+    assert shipper.stats()["tick_errors"] == 0
+
+  def test_claim_backlog_is_bounded_during_a_long_outage(self, tmp_path):
+    """A sink outage under a busy event stream must not grow FILE.ship.*
+    without bound (claiming frees rotation's slots, so the events_keep
+    disk bound no longer applies): past MAX_CLAIMED_SEGMENTS the oldest
+    claims drop, counted."""
+    clock = FakeClock()
+    sink = FlakySink(down=True)
+    events_path = str(tmp_path / "events.jsonl")
+    sink_fn = file_sink(events_path, max_bytes=64, keep=2)
+    log = EventLog(clock=clock, sink=sink_fn)
+    cfg = ship_mod.ShipConfig(url="http://sink.invalid/i",
+                              events_path=events_path, events_keep=2)
+    shipper = ship_mod.TelemetryShipper(cfg, transport=sink, clock=clock,
+                                        sleep=lambda s: None)
+    for round_i in range(ship_mod.MAX_CLAIMED_SEGMENTS):
+      for i in range(6):
+        log.emit("tick", r=round_i, i=i, pad="z" * 40)
+      shipper.tick()  # down: claims whatever rotated this round
+    assert shipper.pending_segments() <= ship_mod.MAX_CLAIMED_SEGMENTS
+    assert shipper.stats()["segments_dropped"] >= 1
+    sink_fn.close()
+
+  def test_tsdb_backlog_drains_across_ticks_without_loss(self, tmp_path):
+    """More points per series than one batch carries: truncation keeps
+    the OLDEST and the cursor follows what shipped, so the backlog
+    drains over consecutive ticks — nothing stranded behind the
+    cursor."""
+    clock = FakeClock(0.0)
+    rec = tsdb_mod.TsdbRecorder(lambda: "# TYPE m gauge\nm 1\n",
+                                tsdb_mod.TsdbConfig(interval_s=1.0,
+                                                    max_points=256),
+                                clock=clock)
+    for _ in range(10):
+      rec.sample()
+      clock.advance(1.0)
+    sink = FlakySink(down=False)
+    cfg = ship_mod.ShipConfig(url="http://x/i",
+                              spool_dir=str(tmp_path / "spool"))
+    shipper = ship_mod.TelemetryShipper(cfg, tsdb=rec, transport=sink,
+                                        clock=clock, sleep=lambda s: None)
+    # Force tiny batches via the snapshot bound.
+    original = rec.snapshot_since
+    rec.snapshot_since = lambda since, max_points_per_series=64: original(
+        since, max_points_per_series=3)
+    for _ in range(5):
+      shipper.tick()
+    shipped = [p[0] for b in sink.bodies for it in b.get("items", [])
+               if it["kind"] == "tsdb"
+               for series in it["families"]["m"]
+               for p in series["points"]]
+    assert shipped == [float(i) for i in range(10)]  # all, in order
+
+  def test_tsdb_cursor_tracks_shipped_points_not_the_clock(self, tmp_path):
+    """The cursor advances to the max point timestamp actually shipped —
+    a clock-read cursor ahead of the recorder's timestamps would skip
+    every later sample forever."""
+    rec_clock = FakeClock(10.0)
+    texts = ["# TYPE m gauge\nm 1\n"]
+    rec = tsdb_mod.TsdbRecorder(lambda: texts[0],
+                                tsdb_mod.TsdbConfig(interval_s=1.0),
+                                clock=rec_clock)
+    rec.sample()  # point at ts=10.0
+    # The shipper's wall clock runs far AHEAD of the recorder's stamps.
+    ship_clock = FakeClock(1000.0)
+    sink = FlakySink(down=False)
+    cfg = ship_mod.ShipConfig(url="http://x/i",
+                              spool_dir=str(tmp_path / "spool"))
+    shipper = ship_mod.TelemetryShipper(cfg, tsdb=rec, transport=sink,
+                                        clock=ship_clock,
+                                        sleep=lambda s: None)
+    shipper.tick()
+    rec_clock.advance(5)
+    rec.sample()  # point at ts=15.0 — BELOW the shipper's wall clock
+    shipper.tick()
+    shipped_ts = [p[0] for b in sink.bodies for it in b.get("items", [])
+                  if it["kind"] == "tsdb"
+                  for series in it["families"]["m"]
+                  for p in series["points"]]
+    assert shipped_ts == [10.0, 15.0]  # nothing skipped, nothing doubled
+
+  def test_retry_policy_counts_and_registry_zeros(self, tmp_path):
+    clock = FakeClock()
+    sink = FlakySink(down=True)
+    shipper = _shipper(tmp_path, sink, clock)
+    shipper.note_alert({"kind": "slo_alert"})
+    shipper.tick()
+    stats = shipper.stats()
+    # RetryPolicy default here: 2 retries => 3 attempts per arc.
+    assert stats["posts"] == 3 and stats["retries"] == 2
+    fams = prom.parse_metrics_text(ship_mod.registry(stats).render())
+    assert fams["mpi_obs_ship_failures_total"]["samples"][
+        ("mpi_obs_ship_failures_total", ())] == 3
+    zeros = prom.parse_metrics_text(ship_mod.registry(None).render())
+    assert zeros["mpi_obs_ship_batches_total"]["samples"][
+        ("mpi_obs_ship_batches_total", ())] == 0
+
+
+# --- router: pooled quantiles + tsdb fan-out (fake transport) -------------
+
+
+class FakeBackendTransport:
+  """Canned per-backend GET responses keyed by (address, path)."""
+
+  def __init__(self, responses):
+    self.responses = responses  # {address: {path: payload}}
+
+  def request(self, method, url, body=None, headers=None, timeout=30.0):
+    parsed = urllib.parse.urlsplit(url)
+    path = parsed.path + ("?" + parsed.query if parsed.query else "")
+    backend = self.responses.get(parsed.netloc)
+    if backend is None:
+      raise ConnectionError("refused")
+    payload = backend.get(path)
+    if payload is None:
+      payload = {"error": f"unknown path {path}"}
+    if isinstance(payload, str):
+      return 200, {"Content-Type": "text/plain"}, payload.encode()
+    return 200, {"Content-Type": "application/json"}, \
+        json.dumps(payload).encode()
+
+
+def test_router_pools_native_histograms_against_ground_truth():
+  """The router-aggregation satellite: pooled quantiles are bucket-merged
+  across backends (pinned against the combined distribution's ground
+  truth) and exemplar trace ids survive the merge."""
+  rng = np.random.default_rng(11)
+  lat1 = [float(v) for v in rng.lognormal(-4.0, 0.4, 300)]
+  lat2 = [float(v) for v in rng.lognormal(-0.5, 0.4, 60)]
+  transport = FakeBackendTransport({
+      "h1:1": {"/metrics?exemplars=1":
+               _metrics_text(lat1, ["t1"] * len(lat1))},
+      "h2:2": {"/metrics?exemplars=1":
+               _metrics_text(lat2, ["t2-slow"] * len(lat2))},
+  })
+  router = Router({"b1": "h1:1", "b2": "h2:2"}, transport=transport,
+                  metrics_ttl_s=0.0)
+  text = router.metrics_text()
+  fams = prom.parse_metrics_text(text)
+  combined = sorted(lat1 + lat2)
+  for q in hist_mod.QUANTILES:
+    pooled = fams["mpi_cluster_request_quantile_seconds"]["samples"][
+        ("mpi_cluster_request_quantile_seconds",
+         (("q", hist_mod.q_label(q)),))]
+    true = float(np.quantile(combined, q))
+    assert abs(pooled - true) / true < 0.2, (q, pooled, true)
+  # Bucket counts merged exactly (counts add to the combined total)...
+  snap = hist_mod.snapshots_from_samples(
+      fams["mpi_serve_request_latency_nativehist"]["samples"])[()]
+  assert snap["count"] == len(combined)
+  # ...the per-backend quantile gauges were dropped, not summed...
+  assert "mpi_serve_request_quantile_seconds" not in fams
+  # ...and the slow backend's exemplar survived the merge.
+  assert 'trace_id="t2-slow"' in text
+  router.close()
+
+
+def test_router_tsdb_fanout_merges_backends():
+  payload = {"family": "m", "series": [
+      {"name": "m", "labels": {}, "points": [[1.0, 2.0]]}]}
+  transport = FakeBackendTransport({
+      "h1:1": {"/debug/tsdb?family=m&recent=60": payload},
+      "h2:2": {},  # backend without the endpoint: its error rides along
+  })
+  router = Router({"b1": "h1:1", "b2": "h2:2"}, transport=transport)
+  snap = router.tsdb_snapshot(family="m", recent_s=60)
+  assert snap["backends"]["b1"] == payload
+  assert "error" in snap["backends"]["b2"]
+  assert snap["router"] is None  # no router-side ring configured
+  router.close()
+
+
+# --- THE acceptance pin ---------------------------------------------------
+
+
+def _get_json(port, path):
+  with urllib.request.urlopen(
+      f"http://127.0.0.1:{port}{path}", timeout=30) as resp:
+    return resp.status, json.loads(resp.read())
+
+
+def test_flight_recorder_acceptance(tmp_path):
+  """The full loop: a latency fault window on ONE scene fires a
+  per-scene p99 quantile-SLO alert visible on /healthz, /stats, and
+  /metrics (native histogram + exemplar linking to a recorded trace id),
+  is queryable afterward from /debug/tsdb history through the router,
+  and arrives at a fake HTTP sink via the shipper — with the sink down
+  for part of the window and no telemetry lost (the spool drains on
+  recovery)."""
+  clock = FakeClock()
+  tracker = SloTracker(_qcfg(), clock=clock)
+  svc = RenderService(use_mesh=False, slo=tracker, tracer=Tracer(),
+                      metrics_ttl_s=0.0)
+  recorder = tsdb_mod.TsdbRecorder(
+      svc._render_metrics_text,
+      tsdb_mod.TsdbConfig(interval_s=1.0), clock=clock)
+  svc.tsdb = recorder
+  sink = FlakySink(down=False)
+  shipper = ship_mod.TelemetryShipper(
+      ship_mod.ShipConfig(url="http://sink.invalid/ingest",
+                          spool_dir=str(tmp_path / "spool")),
+      tsdb=recorder, transport=sink, clock=clock, sleep=lambda s: None)
+  svc.shipper = shipper
+  svc.add_synthetic_scenes(2, height=H, width=W, planes=P)
+  svc.warmup()
+  httpd = make_http_server(svc)
+  port = httpd.server_address[1]
+  threading.Thread(target=httpd.serve_forever, daemon=True).start()
+  try:
+    # One REAL render over HTTP: its X-Trace-Id is the recorded trace
+    # the exemplar must link to.
+    body = json.dumps({"scene_id": "scene_001",
+                       "pose": np.eye(4).tolist()}).encode()
+    req = urllib.request.Request(f"http://127.0.0.1:{port}/render",
+                                 data=body,
+                                 headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=60) as resp:
+      tid = resp.headers["X-Trace-Id"]
+    assert svc.tracer.find(tid)  # the id resolves to a recorded trace
+
+    # Phase 1 — healthy traffic on both scenes; one tsdb sample. 120
+    # good samples per scene keep the window's p99 inside the healthy
+    # mass even though the one real render above (arbitrarily slow on a
+    # loaded CI box) is in the same window.
+    for _ in range(120):
+      svc.metrics.record_request(0.01, scene_id="scene_000")
+      svc.metrics.record_request(0.01, scene_id="scene_001", trace_id=tid)
+    assert tracker.alerts_firing() == []
+    recorder.sample()
+    shipper.tick()  # sink up: baseline batch lands
+    baseline_batches = shipper.stats()["batches_shipped"]
+    clock.advance(2)
+
+    # Phase 2 — the fault window: ONLY scene_001 turns slow. Its p99
+    # blows through the 250 ms threshold; scene_000 stays healthy.
+    sink.down = True  # ...and the telemetry sink goes down with it
+    for _ in range(60):
+      svc.metrics.record_request(0.9, scene_id="scene_001", trace_id=tid)
+      svc.metrics.record_request(0.01, scene_id="scene_000")
+    firing = tracker.alerts_firing()
+    assert "latency_p99:scene_001" in firing
+    assert "latency_p99:scene_000" not in firing
+    recorder.sample()
+    shipper.tick()  # sink down: the batch (with the FIRE edge) spools
+    assert shipper.stats()["spooled"] >= 1
+
+    # Surface 1: /healthz — degraded, with the per-scene quantile
+    # reason.
+    status, health = _get_json(port, "/healthz")
+    assert status == 200 and health["status"] == "degraded"
+    assert "latency_p99:scene_001" in health["reason"]
+    assert "latency_p99:scene_001" in health["slo_alerts_firing"]
+
+    # Surface 2: /stats — the per_scene slo block shows the hot scene.
+    _, stats = _get_json(port, "/stats")
+    per_scene = stats["slo"]["per_scene"]
+    assert per_scene["scene_001"]["alert"]["firing"] is True
+    assert per_scene["scene_001"]["fast"]["quantile_ms"] > 250
+    assert per_scene["scene_000"]["alert"]["firing"] is False
+
+    # Surface 3: /metrics — the native histogram family carries the
+    # fault window, with an exemplar linking to the recorded trace
+    # (?exemplars=1; the default response strips them for vanilla
+    # Prometheus parsers).
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=30) as resp:
+      plain = resp.read().decode()
+    assert " # {" not in plain  # classic-format safe by default
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics?exemplars=1", timeout=30) as resp:
+      mtext = resp.read().decode()
+    fams = prom.parse_metrics_text(mtext)
+    snap = hist_mod.snapshots_from_samples(
+        fams["mpi_serve_request_latency_nativehist"]["samples"])[()]
+    assert hist_mod.quantile_of(snap, 0.99) > 0.25
+    assert f'trace_id="{tid}"' in mtext
+    assert fams["mpi_slo_scene_alerts_firing"]["samples"][
+        ("mpi_slo_scene_alerts_firing", ())] >= 1
+
+    # Phase 3 — recovery: the fault ages out; the alert clears.
+    clock.advance(11)
+    for _ in range(20):
+      svc.metrics.record_request(0.01, scene_id="scene_001", trace_id=tid)
+    assert "latency_p99:scene_001" not in tracker.alerts_firing()
+    recorder.sample()
+
+    # The episode is queryable AFTERWARD from /debug/tsdb — directly...
+    _, ts = _get_json(
+        port, "/debug/tsdb?family=mpi_slo_quantile_latency_seconds")
+    fast_series = next(
+        s for s in ts["series"]
+        if s["labels"] == {"slo": "latency_p99", "window": "fast"})
+    values = [p[1] for p in fast_series["points"]]
+    assert len(values) == 3
+    assert values[1] > 0.25 > values[0]  # the spike is in the history
+    assert values[2] < 0.25              # ...and so is the recovery
+
+    # ...and through the router (one query reads fleet history).
+    router = Router({"b0": f"127.0.0.1:{port}"}, metrics_ttl_s=0.0)
+    try:
+      rsnap = router.tsdb_snapshot(
+          family="mpi_slo_quantile_latency_seconds")
+      rvals = [p[1] for s in rsnap["backends"]["b0"]["series"]
+               if s["labels"] == {"slo": "latency_p99", "window": "fast"}
+               for p in s["points"]]
+      assert rvals == values
+      # The router's pooled exposition also carries the fleet p99 from
+      # the merged native histogram.
+      rfams = prom.parse_metrics_text(router.metrics_text())
+      assert ("mpi_cluster_request_quantile_seconds",
+              (("q", "0.99"),)) in \
+          rfams["mpi_cluster_request_quantile_seconds"]["samples"]
+    finally:
+      router.close()
+
+    # The sink recovers: the spool drains and NOTHING was lost — the
+    # fire AND clear edges (and tsdb items) all reach the sink.
+    sink.down = False
+    shipper.tick()
+    stats = shipper.stats()
+    assert stats["spool_files"] == 0
+    assert stats["batches_shipped"] > baseline_batches
+    edges = [e for b in sink.bodies for it in b.get("items", [])
+             if it["kind"] == "slo_alert_edges" for e in it["edges"]]
+    scene_edges = [(e["firing"]) for e in edges
+                   if e["slo"] == "latency_p99:scene_001"]
+    assert scene_edges == [True, False]  # fire then clear, in order
+    assert any(it["kind"] == "tsdb" for b in sink.bodies
+               for it in b.get("items", []))
+    assert os.listdir(tmp_path / "spool") == []
+  finally:
+    httpd.shutdown()
+    svc.close()

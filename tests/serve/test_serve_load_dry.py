@@ -67,12 +67,25 @@ def test_serve_load_dry_emits_headline_json():
   # slow-window attainment. A clean dry run must PASS availability
   # outright (no errors => attainment 1.0).
   slo = out["slo"]
-  assert set(slo["objectives"]) == {"availability", "latency"}
+  assert set(slo["objectives"]) == {"availability", "latency",
+                                    "latency_p99"}
   avail = slo["objectives"]["availability"]
   assert avail["target"] == 0.99 and avail["attained"] == 1.0
   assert avail["requests"] >= out["requests"]
   assert avail["pass"] is True and avail["burn_slow"] == 0.0
   assert slo["alerts_firing"] == []
+  # The quantile-SLO verdict (flight recorder): p99 judged from the
+  # pooled native histogram, percentile-true — the block must carry the
+  # quantile, the threshold, and the measured window quantile. The
+  # per-scene table rides along (bounded; every dry scene scored).
+  q99 = slo["objectives"]["latency_p99"]
+  assert q99["quantile"] == 0.99 and q99["threshold_ms"] == 1000.0
+  assert q99["quantile_ms"] is not None and q99["quantile_ms"] > 0
+  assert q99["requests"] >= out["requests"]
+  assert q99["pass"] in (True, False)  # judged, not skipped
+  per_scene = slo["per_scene"]
+  assert per_scene["scenes"] >= 1
+  assert isinstance(per_scene["failing"], list)
 
 
 def test_serve_load_trace_dry_smoke():
@@ -209,8 +222,14 @@ def test_serve_load_chaos_dry_smoke():
   assert out["chaos_failed_requests"] is not None
   # The verdict block judges the chaos window too (objective, attained,
   # burn rates, pass/fail — whether the fleet RODE OUT the faults).
+  # Quantile objectives are scored by their windowed quantile instead of
+  # a fractional attainment.
   slo = out["slo"]
   for obj in slo["objectives"].values():
-    assert {"target", "attained", "burn_fast", "burn_slow",
-            "pass"} <= set(obj)
+    if "quantile" in obj:
+      assert {"quantile", "threshold_ms", "quantile_ms", "burn_fast",
+              "burn_slow", "pass"} <= set(obj)
+    else:
+      assert {"target", "attained", "burn_fast", "burn_slow",
+              "pass"} <= set(obj)
   assert slo["objectives"]["availability"]["requests"] >= out["requests"]

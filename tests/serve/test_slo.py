@@ -688,7 +688,7 @@ def test_router_metrics_drop_non_additive_slo_gauges():
 
   class MetricsTransport:
     def request(self, method, url, body=None, headers=None, timeout=30.0):
-      assert url.endswith("/metrics")
+      assert url.endswith("/metrics?exemplars=1")
       return 200, {"Content-Type": "text/plain"}, text.encode()
 
   router = Router({"b1": "h1:1", "b2": "h2:2"},

@@ -133,6 +133,37 @@ def test_alert_hook_without_slo_rejected():
               "--duration", "0.1"])
 
 
+def test_slo_quantile_without_slo_rejected():
+  """The quantile objective only acts through the SLO tracker."""
+  with pytest.raises(SystemExit, match=r"require\(s\) SLO tracking"):
+    cli.main(["serve", "--no-slo", "--slo-quantile", "0.99",
+              "--duration", "0.1"])
+
+
+def test_slo_per_scene_without_quantile_rejected():
+  """The per-scene objective IS the quantile one; dangling it would
+  silently judge nothing."""
+  with pytest.raises(SystemExit, match="--slo-per-scene requires"):
+    cli.main(["serve", "--slo-per-scene", "--duration", "0.1"])
+
+
+def test_tsdb_knobs_without_interval_rejected():
+  """Ring knobs only act with sampling on."""
+  with pytest.raises(SystemExit, match=r"require\(s\) --tsdb-interval-s"):
+    cli.main(["serve", "--tsdb-points", "64", "--duration", "0.1"])
+  with pytest.raises(SystemExit, match="--tsdb-points requires"):
+    cli.main(["cluster", "--backends", "1", "--tsdb-points", "64"])
+
+
+def test_ship_knobs_without_url_rejected():
+  """Shipper knobs only act with a sink configured."""
+  with pytest.raises(SystemExit, match=r"require\(s\) --ship-url"):
+    cli.main(["serve", "--ship-spool-dir", "/tmp/spool",
+              "--duration", "0.1"])
+  with pytest.raises(SystemExit, match=r"require\(s\) --ship-url"):
+    cli.main(["serve", "--ship-interval-s", "5", "--duration", "0.1"])
+
+
 @pytest.mark.parametrize("flag", ["--supervise", "--rolling-restart"])
 def test_cluster_supervision_requires_a_local_pool(flag):
   """--join fronts backends some OTHER supervisor owns; this process

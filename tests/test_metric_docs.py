@@ -20,6 +20,8 @@ import pathlib
 import re
 
 from mpi_vision_tpu.obs import prom
+from mpi_vision_tpu.obs import ship as ship_mod
+from mpi_vision_tpu.obs import tsdb as tsdb_mod
 from mpi_vision_tpu.obs.slo import SloConfig, SloTracker
 from mpi_vision_tpu.serve.cluster.router import Router
 from mpi_vision_tpu.serve.metrics import ServeMetrics
@@ -32,7 +34,7 @@ README = pathlib.Path(__file__).parent.parent / "README.md"
 # `mpi_from_net_output`); `mpi_serve_` (prefix mention) ends in '_' and
 # is filtered below; `mpi_slo_*` (wildcard) never matches because '*'
 # precedes the closing backtick.
-_TOKEN = re.compile(r"`(mpi_(?:serve|slo|cluster|train)_[a-z0-9_]+)`")
+_TOKEN = re.compile(r"`(mpi_(?:serve|slo|cluster|train|obs)_[a-z0-9_]+)`")
 
 
 def _serve_families() -> set[str]:
@@ -45,14 +47,23 @@ def _serve_families() -> set[str]:
 
 
 def _slo_families() -> set[str]:
-  tracker = SloTracker(SloConfig(), clock=lambda: 0.0)
-  tracker.record(ok=True, latency_s=0.01)
+  # Quantile + per-scene objectives ON so their families count as
+  # exposed (they are conditional, like the breaker families above).
+  tracker = SloTracker(SloConfig(quantile=0.99, per_scene=True),
+                       clock=lambda: 0.0)
+  tracker.record(ok=True, latency_s=0.01, scene_id="s0")
   return {metric.name for metric in tracker.registry()._metrics}
 
 
 def _cluster_families() -> set[str]:
   router = Router(clock=lambda: 0.0)
   return {metric.name for metric in router._cluster_registry()._metrics}
+
+
+def _obs_families() -> set[str]:
+  # The flight-recorder families are always exposed (zeros while off).
+  return ({metric.name for metric in tsdb_mod.registry(None)._metrics}
+          | {metric.name for metric in ship_mod.registry(None)._metrics})
 
 
 def _train_families() -> set[str]:
@@ -63,7 +74,7 @@ def _train_families() -> set[str]:
 
 def _exposed_families() -> set[str]:
   return (_serve_families() | _slo_families() | _cluster_families()
-          | _train_families())
+          | _train_families() | _obs_families())
 
 
 def _documented_families() -> set[str]:

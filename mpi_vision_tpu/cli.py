@@ -18,6 +18,12 @@ export cell 18). These commands make the same flow scriptable:
     (``--mpi-dir``), or MPIs predicted by a trained checkpoint
     (``--ckpt``, the train -> serve bridge; ``--reload-ckpt-s`` keeps
     watching the store and live-swaps scenes on new publishes).
+  * ``train-queue`` — drain a durable on-disk training job queue under
+    supervision (train/queue.py + train/supervisor.py): each job runs as
+    an isolated ``train --ckpt`` subprocess with wedge detection,
+    budgeted retries, poison-job quarantine, SIGTERM preemption requeue,
+    and (``--publish``) live scene publish into a ``serve
+    --reload-ckpt-s`` watch store.
   * ``cluster`` — run the multi-host routing tier (serve/cluster/): a
     consistent-hash, replication-aware router over a pool of serve
     backends (``--backends N`` spawns a local pool; ``--join`` fronts
@@ -79,7 +85,8 @@ def cmd_train(args: argparse.Namespace) -> dict:
         ("--stall-timeout-s", args.stall_timeout_s > 0),
         ("--metrics-port", args.metrics_port is not None),
         ("--metrics-log", bool(args.metrics_log)),
-        ("--event-log", bool(args.event_log))) if on]
+        ("--event-log", bool(args.event_log)),
+        ("--inject-fault", bool(args.inject_fault))) if on]
     if wants_ckpt:
       raise SystemExit(
           f"{', '.join(wants_ckpt)} require(s) --ckpt <dir>")
@@ -87,6 +94,16 @@ def cmd_train(args: argparse.Namespace) -> dict:
     # The port file is only ever written by the metrics listener; a
     # supervisor waiting on it would hang forever.
     raise SystemExit("--metrics-port-file requires --metrics-port")
+  fault_source = None
+  if args.inject_fault:
+    # Parse at the door: a typo'd fault spec must fail the invocation,
+    # not silently arm nothing (the chaos drill would then "pass").
+    from mpi_vision_tpu.train import faultinject as fault_lib
+
+    try:
+      fault_source = fault_lib.build_source(args.inject_fault)
+    except fault_lib.FaultSpecError as e:
+      raise SystemExit(str(e))
 
   root = args.dataset
   if args.synthetic:
@@ -265,7 +282,9 @@ def cmd_train(args: argparse.Namespace) -> dict:
 
     store = CheckpointStore(
         os.path.abspath(args.ckpt),
-        keep=args.keep if args.keep is not None else 3, events=ev)
+        keep=args.keep if args.keep is not None else 3, events=ev,
+        fault_hook=(fault_source.store_hook
+                    if fault_source is not None else None))
     if args.async_save:
       # Background-thread serialization: the step loop keeps training
       # while the previous state hashes/serializes/fsyncs; the loop
@@ -304,6 +323,7 @@ def cmd_train(args: argparse.Namespace) -> dict:
             resume="auto" if args.resume else "never",
             nan_guard=None if args.nan_guard is False else NanGuard(),
             watchdog=watchdog, preemption=preemption,
+            fault_source=fault_source,
             on_epoch=log_epoch, telemetry=telemetry, events=ev, log=_log)
     finally:
       if metrics_httpd is not None:
@@ -443,10 +463,17 @@ def cmd_serve(args: argparse.Namespace) -> dict:
   if args.tsdb_interval_s <= 0:
     wants_tsdb = [flag for flag, on in (
         ("--tsdb-points", args.tsdb_points is not None),
-        ("--tsdb-max-series", args.tsdb_max_series is not None)) if on]
+        ("--tsdb-max-series", args.tsdb_max_series is not None),
+        ("--tsdb-compact-after-s", args.tsdb_compact_after_s is not None),
+        ("--tsdb-compact-stride",
+         args.tsdb_compact_stride is not None)) if on]
     if wants_tsdb:
       raise SystemExit(
           f"{', '.join(wants_tsdb)} require(s) --tsdb-interval-s > 0")
+  if (args.tsdb_compact_stride is not None
+      and args.tsdb_compact_after_s is None):
+    # The stride only acts on points past the age threshold.
+    raise SystemExit("--tsdb-compact-stride requires --tsdb-compact-after-s")
   if not args.ship_url:
     wants_ship = [flag for flag, on in (
         ("--ship-interval-s", args.ship_interval_s is not None),
@@ -521,7 +548,11 @@ def cmd_serve(args: argparse.Namespace) -> dict:
                     else defaults.max_points),
         max_series=(args.tsdb_max_series
                     if args.tsdb_max_series is not None
-                    else defaults.max_series))
+                    else defaults.max_series),
+        compact_after_s=args.tsdb_compact_after_s,
+        compact_stride=(args.tsdb_compact_stride
+                        if args.tsdb_compact_stride is not None
+                        else defaults.compact_stride))
   ship = None
   if args.ship_url:
     from mpi_vision_tpu.obs import ship as ship_lib
@@ -780,6 +811,179 @@ def cmd_serve(args: argparse.Namespace) -> dict:
   }
 
 
+def cmd_train_queue(args: argparse.Namespace) -> dict:
+  import signal
+  import threading
+
+  # Every knob is validated at the door: the monitor loop swallows tick
+  # exceptions by design, so a lazily-raised ValueError would leave
+  # supervision silently dead (the cluster subcommand's rule).
+  if args.concurrency < 1:
+    raise SystemExit(f"--concurrency must be >= 1, got {args.concurrency}")
+  if args.probe_s <= 0:
+    raise SystemExit(f"--probe-s must be > 0, got {args.probe_s}")
+  if args.probe_timeout_s <= 0:
+    raise SystemExit(
+        f"--probe-timeout-s must be > 0, got {args.probe_timeout_s}")
+  if args.wedge_after < 1:
+    raise SystemExit(f"--wedge-after must be >= 1, got {args.wedge_after}")
+  if args.restart_budget < 1:
+    raise SystemExit(
+        f"--restart-budget must be >= 1, got {args.restart_budget}")
+  if args.budget_window_s <= 0:
+    raise SystemExit(
+        f"--budget-window-s must be > 0, got {args.budget_window_s}")
+  if args.lease_s <= 0:
+    raise SystemExit(f"--lease-s must be > 0, got {args.lease_s}")
+  if args.startup_grace_s < 0:
+    # A negative grace silently disables the compile headroom and every
+    # healthy trainer's first compile reads as a wedge.
+    raise SystemExit(
+        f"--startup-grace-s must be >= 0, got {args.startup_grace_s}")
+  if args.publish_keep < 1:
+    raise SystemExit(f"--publish-keep must be >= 1, got {args.publish_keep}")
+  if not args.slo:
+    # SLO knobs only act through the tracker; silently dropping the
+    # objectives the operator asked for is the dangling-flag failure
+    # mode this repo guards against everywhere.
+    wants_slo = [flag for flag, on in (
+        ("--slo-availability", args.slo_availability is not None),
+        ("--slo-step-latency-ms",
+         args.slo_step_latency_ms is not None)) if on]
+    if wants_slo:
+      raise SystemExit(
+          f"{', '.join(wants_slo)} require(s) SLO tracking (drop --no-slo)")
+  specs = []
+  for raw in args.submit:
+    try:
+      spec = json.loads(raw)
+    except ValueError as e:
+      raise SystemExit(f"--submit is not valid JSON ({e}): {raw!r}")
+    if not isinstance(spec, dict):
+      raise SystemExit(f"--submit must be a JSON object, got {raw!r}")
+    specs.append(spec)
+
+  from mpi_vision_tpu.obs import events as events_mod
+  from mpi_vision_tpu.train.queue import JobQueue
+  from mpi_vision_tpu.train.supervisor import TrainSupervisor
+
+  events = events_mod.EventLog(
+      sink=events_mod.file_sink(args.event_log) if args.event_log else None)
+  queue = JobQueue(os.path.abspath(args.root), lease_s=args.lease_s,
+                   events=events)
+  from mpi_vision_tpu.train.queue import JobQueueError
+
+  for spec in specs:
+    try:
+      job_id = queue.submit(spec, job_id=spec.pop("id", None))
+    except (ValueError, JobQueueError) as e:
+      # Same validate-at-the-door contract as every other knob: a bad
+      # or duplicate job id is a clean exit, not a traceback.
+      raise SystemExit(f"--submit rejected: {e}")
+    _log(f"train-queue: submitted {job_id}")
+
+  publish_store = None
+  if args.publish:
+    from mpi_vision_tpu.ckpt import CheckpointStore
+
+    publish_store = CheckpointStore(os.path.abspath(args.publish),
+                                    keep=args.publish_keep, events=events)
+  slo = None
+  if args.slo:
+    from mpi_vision_tpu.obs import SloConfig
+    from mpi_vision_tpu.obs.slo import SloTracker
+
+    try:
+      slo = SloTracker(SloConfig(
+          availability_target=(args.slo_availability
+                               if args.slo_availability is not None
+                               else 0.99),
+          latency_threshold_s=(args.slo_step_latency_ms
+                               if args.slo_step_latency_ms is not None
+                               else 60000.0) / 1e3))
+    except ValueError as e:
+      # Same validate-at-the-door contract as every other knob.
+      raise SystemExit(f"bad SLO knob: {e}")
+
+  supervisor = TrainSupervisor(
+      queue, work_root=args.work or os.path.join(args.root, "work"),
+      publish_store=publish_store, concurrency=args.concurrency,
+      probe_s=args.probe_s, probe_timeout_s=args.probe_timeout_s,
+      wedge_after=args.wedge_after, startup_grace_s=args.startup_grace_s,
+      restart_budget=args.restart_budget,
+      budget_window_s=args.budget_window_s, slo=slo, events=events,
+      log=_log)
+  _log(f"train-queue: supervising {args.root} (concurrency "
+       f"{args.concurrency}, probe every {args.probe_s:g}s, budget "
+       f"{args.restart_budget} retries / {args.budget_window_s:g}s, "
+       f"wedge after {args.wedge_after} stalled probes"
+       + (f"; publishing to {args.publish}" if args.publish else "") + ")")
+
+  stop_event = threading.Event()
+
+  def _on_signal(signum, frame):  # noqa: ARG001 - stdlib signature
+    stop_event.set()
+    try:
+      _log(f"train-queue: received {signal.Signals(signum).name}; "
+           "preempting running jobs")
+    except Exception:  # noqa: BLE001 - e.g. reentrant stderr write
+      pass
+
+  previous_handlers = {}
+  for sig in (signal.SIGTERM, signal.SIGINT):
+    try:
+      previous_handlers[sig] = signal.signal(sig, _on_signal)
+    except (ValueError, OSError):
+      pass
+
+  t0 = time.time()
+  drained = None
+  try:
+    if args.drain:
+      # should_stop keeps a draining run interruptible: SIGTERM/SIGINT
+      # land in the next tick cycle instead of being swallowed until
+      # the drain finishes or times out.
+      drained = supervisor.run_until_drained(
+          timeout_s=args.duration if args.duration > 0 else 600.0,
+          should_stop=stop_event.is_set)
+    else:
+      supervisor.start()
+      stop_event.wait(args.duration if args.duration > 0 else None)
+  finally:
+    # SIGTERM semantics end to end: running jobs are SIGTERM'd (the
+    # train CLI saves a preempt checkpoint) and requeued with no budget
+    # spent, so the next supervisor resumes them bit-exactly.
+    supervisor.stop(preempt=True)
+    for sig, handler in previous_handlers.items():
+      signal.signal(sig, handler)
+    _log("train-queue: stopped; running jobs preempted back to the queue")
+
+  snap = supervisor.snapshot()
+  out = {
+      "command": "train-queue",
+      "root": queue.root,
+      "seconds": round(time.time() - t0, 1),
+      "jobs": snap["queue"]["counts"],
+      "spawns": snap["spawns"],
+      "completes": snap["completes"],
+      "failures": snap["failures"],
+      "wedges": snap["wedges"],
+      "requeues": snap["requeues"],
+      "quarantines": snap["quarantines"],
+      "preemptions": snap["preemptions"],
+      "publishes": snap["publishes"],
+      "publish_errors": snap["publish_errors"],
+      "spec_rejects": snap["spec_rejects"],
+      "events_emitted": events.emitted,
+      **({"drained": drained} if drained is not None else {}),
+  }
+  if slo is not None:
+    from mpi_vision_tpu.obs.slo import verdict
+
+    out["slo"] = verdict(slo.snapshot())
+  return out
+
+
 def cmd_cluster(args: argparse.Namespace) -> dict:
   import signal
   import threading
@@ -1034,6 +1238,13 @@ def build_parser() -> argparse.ArgumentParser:
                       "stalls) to this file; requires --ckpt")
   t.add_argument("--export-html", default="",
                  help="write a viewer HTML of a validation MPI here")
+  t.add_argument("--inject-fault", action="append", default=[],
+                 metavar="SPEC",
+                 help="arm one scheduled fault (repeatable): "
+                      "crash@step=N[,hard] / nan@step=N / preempt@step=N "
+                      "/ hang@step=N,seconds=S / crash@save=I,stage=... / "
+                      "corrupt@save=I — the train-queue chaos grammar "
+                      "(train/faultinject.py); requires --ckpt")
   t.set_defaults(fn=cmd_train)
 
   e = sub.add_parser("export-viewer",
@@ -1247,6 +1458,15 @@ def build_parser() -> argparse.ArgumentParser:
                  help="series cap for the whole ring (default 4096; "
                       "overflow counted, never fatal); requires "
                       "--tsdb-interval-s")
+  s.add_argument("--tsdb-compact-after-s", type=float, default=None,
+                 help="thin ring points older than this to a coarser "
+                      "stride instead of evicting them, so /debug/tsdb "
+                      "keeps ~stride-times longer history in the same "
+                      "byte budget; requires --tsdb-interval-s")
+  s.add_argument("--tsdb-compact-stride", type=int, default=None,
+                 help="keep ~one old point per stride sampling "
+                      "intervals (default 8); requires "
+                      "--tsdb-compact-after-s")
   s.add_argument("--ship-url", default="",
                  help="POST telemetry batches (rotated event-log "
                       "segments, SLO alert edges, incremental tsdb "
@@ -1271,6 +1491,78 @@ def build_parser() -> argparse.ArgumentParser:
                       "(scrape storms cost one snapshot render per "
                       "window; <= 0 renders per scrape)")
   s.set_defaults(fn=cmd_serve)
+
+  q = sub.add_parser(
+      "train-queue",
+      help="drain a durable training job queue under supervision "
+           "(train/queue.py + train/supervisor.py): crash-safe multi-job "
+           "ingest with wedge detection, budgeted retries, poison-job "
+           "quarantine, SIGTERM preemption requeue, and live scene "
+           "publish into a serve --reload-ckpt-s watch store")
+  q.add_argument("--root", required=True,
+                 help="queue directory (atomic JSON job specs; shared "
+                      "by every worker draining this queue)")
+  q.add_argument("--work", default="",
+                 help="per-job isolation root (ckpt/, logs, metrics "
+                      "port files; default <root>/work)")
+  q.add_argument("--submit", action="append", default=[], metavar="JSON",
+                 help="enqueue one job spec before supervising "
+                      "(repeatable); a JSON object, optionally with an "
+                      "'id' key (e.g. '{\"epochs\": 1, \"img_size\": 32, "
+                      "\"num_planes\": 4, \"seed\": 7}')")
+  q.add_argument("--publish", default="",
+                 help="republish each completed job's checkpoint into "
+                      "this store (byte-identical arrays, next step "
+                      "number) — point a serve --ckpt ... "
+                      "--reload-ckpt-s backend at it and new scenes go "
+                      "live with zero dropped requests")
+  q.add_argument("--publish-keep", type=int, default=8,
+                 help="published checkpoints retained by GC")
+  q.add_argument("--concurrency", type=int, default=1,
+                 help="training attempts in flight at once")
+  q.add_argument("--probe-s", type=float, default=1.0,
+                 help="supervision tick / health-probe period")
+  q.add_argument("--probe-timeout-s", type=float, default=2.0,
+                 help="per-probe /healthz budget")
+  q.add_argument("--wedge-after", type=int, default=6,
+                 help="consecutive probes without step-counter progress "
+                      "that declare a live trainer wedged (SIGKILL + "
+                      "requeue)")
+  q.add_argument("--startup-grace-s", type=float, default=120.0,
+                 help="spawn-time grace before wedge counting starts "
+                      "(XLA compile headroom)")
+  q.add_argument("--restart-budget", type=int, default=3,
+                 help="per-job retries allowed inside --budget-window-s "
+                      "before the job is quarantined as poison "
+                      "(crash-loop containment; the queue keeps "
+                      "draining)")
+  q.add_argument("--budget-window-s", type=float, default=300.0,
+                 help="the restart-budget window")
+  q.add_argument("--lease-s", type=float, default=60.0,
+                 help="heartbeat staleness after which a dead worker's "
+                      "leased job is requeued (never lost)")
+  q.add_argument("--drain", action="store_true",
+                 help="exit once every job is terminal (done / failed / "
+                      "quarantined) instead of supervising forever")
+  q.add_argument("--duration", type=float, default=0.0,
+                 help="seconds to run (drain timeout with --drain); "
+                      "<= 0 runs until interrupted (600s drain default)")
+  q.add_argument("--event-log", default="",
+                 help="append one JSON line per queue lifecycle event "
+                      "(submitted/leased/started/done/requeued/wedged/"
+                      "quarantined/published) to this file")
+  q.add_argument("--slo", action=argparse.BooleanOptionalAction,
+                 default=True,
+                 help="track training-queue SLOs in the obs/slo.py "
+                      "engine: job-attempt success availability + "
+                      "observed step-latency objectives")
+  q.add_argument("--slo-availability", type=float, default=None,
+                 help="attempt-success objective (default 0.99); "
+                      "requires SLO tracking")
+  q.add_argument("--slo-step-latency-ms", type=float, default=None,
+                 help="step-latency objective threshold (default 60000); "
+                      "requires SLO tracking")
+  q.set_defaults(fn=cmd_train_queue)
 
   c = sub.add_parser(
       "cluster",

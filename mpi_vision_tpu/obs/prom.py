@@ -109,6 +109,12 @@ class Registry:
   def __init__(self):
     self._metrics: list[Metric] = []
 
+  def extend(self, other: "Registry") -> "Registry":
+    """Append every family of ``other`` into this registry (one joint
+    exposition; the caller owns name uniqueness across the two)."""
+    self._metrics.extend(other._metrics)
+    return self
+
   def counter(self, name: str, help: str, value=None,
               labels: dict | None = None) -> Metric:
     m = Metric(name, "counter", help)

@@ -191,6 +191,17 @@ def burn_rate(bad: int, total: int, target: float) -> float:
   return (bad / total) / (1.0 - target)
 
 
+def worst_exemplar(hist) -> dict | None:
+  """The largest-valued trace exemplar across a histogram's buckets —
+  the request an operator chasing a quantile alert wants to click
+  through first (resolvable at ``/debug/traces`` while the ring holds
+  it). None when no recorded latency carried a trace id."""
+  if hist is None or not hist.exemplars:
+    return None
+  tid, value = max(hist.exemplars.values(), key=lambda pair: pair[1])
+  return {"trace_id": tid, "value_ms": round(value * 1e3, 3)}
+
+
 class SloTracker:
   """Sliding-window SLO accounting + burn-rate alerting over requests.
 
@@ -262,22 +273,30 @@ class SloTracker:
     return key
 
   def record(self, ok: bool, latency_s: float | None = None,
-             count: int = 1, scene_id: str | None = None) -> None:
+             count: int = 1, scene_id: str | None = None,
+             trace_id: str | None = None, availability: bool = True) -> None:
     """Account ``count`` request outcomes.
 
     ``ok=False`` consumes availability budget; ``latency_s`` (completed
     requests only) additionally scores the latency objective and — with
     the quantile objective on — lands in the window's native histogram
     (``scene_id`` additionally in the bounded per-scene one).
+    ``trace_id`` becomes the latency's bucket exemplar, so a quantile
+    alert carries a worst-offender trace resolvable at /debug/traces.
+    ``availability=False`` scores ONLY the latency objective — for
+    streams whose success accounting rides separate events (the train
+    queue: attempt outcomes are the availability signal; per-step
+    latency samples must not dilute it with good events).
     """
     with self._lock:
       bucket, rotated = self._bucket_locked(self._clock())
-      bucket.total += count
-      self.total += count
       bad = not ok
-      if bad:
-        bucket.bad += count
-        self.bad += count
+      if availability:
+        bucket.total += count
+        self.total += count
+        if bad:
+          bucket.bad += count
+          self.bad += count
       if latency_s is not None:
         bucket.lat_total += count
         if latency_s > self.config.latency_threshold_s:
@@ -285,14 +304,14 @@ class SloTracker:
           bad = True
         if bucket.hist is not None:
           for _ in range(count):
-            bucket.hist.record(latency_s)
+            bucket.hist.record(latency_s, exemplar=trace_id)
           if self.config.per_scene and scene_id is not None:
             key = self._scene_key_locked(scene_id)
             scene_hist = bucket.scenes.get(key)
             if scene_hist is None:
               scene_hist = bucket.scenes[key] = hist_mod.NativeHistogram()
             for _ in range(count):
-              scene_hist.record(latency_s)
+              scene_hist.record(latency_s, exemplar=trace_id)
       # The full alert evaluation walks the whole bucket ring; this is
       # the serving hot path (every completed request lands here), so
       # only run it when an edge is actually possible: a bad event can
@@ -492,10 +511,14 @@ class SloTracker:
         alert.fired += 1
         alert.since = now
         transitions.append(name)
+        exemplar = worst_exemplar(slow_hist) or worst_exemplar(fast_hist)
         callbacks.append((name, True, {
             **detail_base,
             "fast_ms": round(fast_q * 1e3, 3),
-            "slow_ms": round(slow_q * 1e3, 3)}))
+            "slow_ms": round(slow_q * 1e3, 3),
+            # The worst offender's trace id rides the fire edge so the
+            # page links straight to a recorded /debug/traces entry.
+            **({"exemplar": exemplar} if exemplar is not None else {})}))
     elif fast_q is None or fast_q <= thr_s:
       alert.firing = False
       alert.cleared += 1
@@ -526,7 +549,7 @@ class SloTracker:
     q_val = hist.quantile(q) if hist is not None else None
     over = (round(hist.fraction_over(thr_s) * count)
             if hist is not None and count else 0)
-    return {
+    out = {
         "window_s": window_s,
         "requests": count,
         "bad": over,
@@ -535,6 +558,10 @@ class SloTracker:
         "quantile_ms": (round(q_val * 1e3, 3)
                         if q_val is not None else None),
     }
+    exemplar = worst_exemplar(hist)
+    if exemplar is not None:
+      out["exemplar"] = exemplar
+    return out
 
   def snapshot(self) -> dict:
     """The ``/stats`` ``slo`` block (JSON-ready)."""

@@ -47,11 +47,21 @@ class TsdbConfig:
   ``interval_s`` is the sampling cadence; ``max_points`` bounds each
   series' ring (``interval_s * max_points`` of history — 10 s * 512 ~=
   85 min at the defaults); ``max_series`` bounds the whole recorder.
+
+  Compaction (ROADMAP flight-recorder follow-on): with
+  ``compact_after_s`` set, points older than it are *thinned* to one
+  kept point per ``compact_stride * interval_s`` instead of scrolling
+  off the ring — old history trades resolution for span, so the same
+  ``max_points`` byte budget covers roughly ``compact_stride`` times
+  more wall time at coarse grain while the recent window stays
+  full-resolution. None disables (classic pure ring).
   """
 
   interval_s: float = 10.0
   max_points: int = 512
   max_series: int = 4096
+  compact_after_s: float | None = None
+  compact_stride: int = 8
 
   def __post_init__(self):
     if self.interval_s <= 0:
@@ -60,6 +70,13 @@ class TsdbConfig:
       raise ValueError(f"max_points must be >= 1, got {self.max_points}")
     if self.max_series < 1:
       raise ValueError(f"max_series must be >= 1, got {self.max_series}")
+    if self.compact_after_s is not None and self.compact_after_s <= 0:
+      raise ValueError(
+          f"compact_after_s must be > 0, got {self.compact_after_s}")
+    if self.compact_stride < 2:
+      # 1 would "compact" to the identity and silently disable the knob.
+      raise ValueError(
+          f"compact_stride must be >= 2, got {self.compact_stride}")
 
 
 class TsdbRecorder:
@@ -88,6 +105,12 @@ class TsdbRecorder:
     self.samples = 0
     self.sample_errors = 0
     self.dropped_series = 0
+    self.compacted_points = 0
+    # Compaction cadence: at most one point per series crosses the age
+    # cutoff per sampling tick, so sweeping every sample would rescan
+    # O(all resident points) under the lock for nothing — one sweep per
+    # stride drops the same points at 1/stride the cost.
+    self._compact_countdown = self.config.compact_stride
 
   # -- sampling ------------------------------------------------------------
 
@@ -126,7 +149,41 @@ class TsdbRecorder:
           ring.append((ts, float(value)))
           touched += 1
       self.samples += 1
+      if self.config.compact_after_s is not None:
+        self._compact_countdown -= 1
+        if self._compact_countdown <= 0:
+          self._compact_countdown = self.config.compact_stride
+          self._compact_locked(ts)
     return touched
+
+  def _compact_locked(self, now: float) -> None:
+    """Thin every ring's old tail to the coarse stride (idempotent).
+
+    Points with ``ts < now - compact_after_s`` keep only one sample per
+    ``compact_stride * interval_s`` of wall time (the oldest in each
+    stride window survives — its timestamp anchors the window, so a
+    re-run keeps the same points and compaction converges). Recent
+    points are untouched.
+    """
+    cutoff = now - self.config.compact_after_s
+    stride_s = self.config.compact_stride * self.config.interval_s
+    for key, ring in self._series.items():
+      if not ring or ring[0][0] >= cutoff:
+        continue  # nothing old enough
+      kept: list = []
+      last_kept_old: float | None = None
+      dropped = 0
+      for ts, value in ring:
+        if ts >= cutoff:
+          kept.append((ts, value))
+        elif last_kept_old is None or ts - last_kept_old >= stride_s:
+          kept.append((ts, value))
+          last_kept_old = ts
+        else:
+          dropped += 1
+      if dropped:
+        self._series[key] = deque(kept, maxlen=self.config.max_points)
+        self.compacted_points += dropped
 
   def _loop(self) -> None:
     while not self._stop.wait(self.config.interval_s):
@@ -217,6 +274,9 @@ class TsdbRecorder:
           "samples": self.samples,
           "sample_errors": self.sample_errors,
           "dropped_series": self.dropped_series,
+          "compacted_points": self.compacted_points,
+          "compact_after_s": self.config.compact_after_s,
+          "compact_stride": self.config.compact_stride,
       }
 
 
@@ -248,6 +308,9 @@ def registry(stats: dict | None) -> prom.Registry:
   reg.counter(p + "dropped_series_total",
               "New series refused at the max_series cap.",
               stats.get("dropped_series", 0))
+  reg.counter(p + "compacted_points_total",
+              "Old points thinned to the coarse stride (downsampling).",
+              stats.get("compacted_points", 0))
   reg.gauge(p + "series", "Series resident in the ring.",
             stats.get("series", 0))
   reg.gauge(p + "points", "Points resident across all series.",

@@ -145,7 +145,11 @@ class ServeMetrics:
         entry[2] = max(entry[2], latency_s)
         entry[3].append(latency_s)
     if self.slo is not None:
-      self.slo.record(ok=True, latency_s=latency_s, scene_id=scene_id)
+      # trace_id rides into the SLO windows' native histograms too, so
+      # quantile alerts (global AND per-scene) carry a worst-offender
+      # exemplar resolvable at /debug/traces.
+      self.slo.record(ok=True, latency_s=latency_s, scene_id=scene_id,
+                      trace_id=trace_id)
 
   def record_error(self, kind: str, count: int = 1) -> None:
     """``count`` requests failed with a ``kind``-class error.

@@ -33,6 +33,7 @@ import time
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from mpi_vision_tpu.obs import hist as hist_mod
 from mpi_vision_tpu.obs import prom
 from mpi_vision_tpu.obs.events import file_sink as _file_sink
 
@@ -59,6 +60,12 @@ class TrainMetrics:
     self._lock = threading.Lock()
     self._t0 = clock()
     self._recent = collections.deque(maxlen=STEP_WINDOW)  # (wall_s, examples)
+    # Native histograms (obs/hist.py, the ROADMAP flight-recorder
+    # follow-on): percentile-TRUE step/save latency quantiles over the
+    # whole run, mergeable across trainers exactly like the serve-side
+    # request histograms.
+    self._hist_step = hist_mod.NativeHistogram()
+    self._hist_save = hist_mod.NativeHistogram()
     self.steps = 0
     self.examples = 0
     self.step_seconds = 0.0
@@ -106,6 +113,7 @@ class TrainMetrics:
       if lr is not None:
         self.last_lr = float(lr)
       self._recent.append((float(wall_s), int(examples)))
+      self._hist_step.record(float(wall_s))
     self._emit({"event": "train_step", "step": int(step),
                 "loss": round(float(loss), 6),
                 "wall_ms": round(float(wall_s) * 1e3, 3),
@@ -120,6 +128,7 @@ class TrainMetrics:
       self.ckpt_save_bytes += int(nbytes)
       self.last_save_s = float(seconds)
       self.last_save_bytes = int(nbytes)
+      self._hist_save.record(float(seconds))
     self._emit({"event": "ckpt_save", "step": int(step),
                 "seconds": round(float(seconds), 6), "bytes": int(nbytes),
                 **({"reason": reason} if reason else {})})
@@ -152,6 +161,8 @@ class TrainMetrics:
       recent_wall = sum(w for w, _ in self._recent)
       recent_examples = sum(n for _, n in self._recent)
       recent = sorted(w for w, _ in self._recent)
+      p50 = self._hist_step.quantile(0.5)
+      p99 = self._hist_step.quantile(0.99)
       out = {
           "uptime_s": round(uptime, 3),
           "steps": self.steps,
@@ -175,11 +186,19 @@ class TrainMetrics:
           "preemptions": self.preemptions,
           "restores": self.restores,
           "sink_errors": self.sink_errors,
+          # Whole-run JSON snapshots of the native histograms: what the
+          # registry renders and what a pool aggregator merges exactly.
+          "step_latency_hist": self._hist_step.snapshot(),
+          "save_latency_hist": self._hist_save.snapshot(),
       }
       if recent:
-        mid = recent[len(recent) // 2]
-        out["step_ms"] = {"p50": round(mid * 1e3, 3),
-                          "max": round(recent[-1] * 1e3, 3)}
+        # Percentile-true quantiles off the native histogram (whole-run,
+        # ~9% worst-case relative error); max stays the recent window's
+        # observed extreme.
+        out["step_ms"] = {
+            "p50": None if p50 is None else round(p50 * 1e3, 3),
+            "p99": None if p99 is None else round(p99 * 1e3, 3),
+            "max": round(recent[-1] * 1e3, 3)}
       return out
 
   def registry(self, snapshot: dict | None = None) -> prom.Registry:
@@ -226,6 +245,23 @@ class TrainMetrics:
     reg.counter(p + "restores_total",
                 "Checkpoint restores (resume + rollbacks).",
                 snap["restores"])
+    # Native-histogram families (exact cross-trainer merges, per-bucket
+    # resolution) + the percentile-true quantile gauges read off them.
+    hist_mod.add_family(
+        reg, p + "step_latency_nativehist",
+        "Optimizer-step wall time, native exponential buckets.",
+        [({}, snap.get("step_latency_hist"))])
+    hist_mod.add_family(
+        reg, p + "ckpt_save_latency_nativehist",
+        "Checkpoint save wall time, native exponential buckets.",
+        [({}, snap.get("save_latency_hist"))])
+    q_gauge = reg.gauge(
+        p + "step_quantile_seconds",
+        "Whole-run step wall time at quantile q, estimated from the "
+        "native histogram (NaN while idle).")
+    for q in hist_mod.QUANTILES:
+      q_gauge.sample(hist_mod.quantile_of(snap.get("step_latency_hist"), q),
+                     {"q": hist_mod.q_label(q)})
     return reg
 
   def metrics_text(self) -> str:
@@ -265,9 +301,15 @@ class _TrainMetricsHandler(BaseHTTPRequestHandler):
       self._send(json.dumps(self.metrics.snapshot()).encode())
     elif path == "/healthz":
       snap = self.metrics.snapshot()
+      # steps/saves/last_step_ms ride along for the queue supervisor:
+      # one GET gives it the progress counters for wedge detection
+      # (saves count too — epoch-boundary checkpoint I/O is progress,
+      # not a hang) and the step wall time for the latency SLO.
       self._send(json.dumps({"status": "ok", "role": "train",
                              "steps": snap["steps"],
-                             "step": snap["step"]}).encode())
+                             "step": snap["step"],
+                             "saves": snap["ckpt"]["saves"],
+                             "last_step_ms": snap["last_step_ms"]}).encode())
     elif path == "/debug/events" and self.events is not None:
       # Same query surface as the serve/router handlers: ?kind= filters,
       # ?recent=N bounds (400 on a non-integer N).

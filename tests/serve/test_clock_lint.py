@@ -31,7 +31,10 @@ import mpi_vision_tpu.obs
 import mpi_vision_tpu.serve
 import mpi_vision_tpu.serve.cluster
 import mpi_vision_tpu.serve.edge
+import mpi_vision_tpu.train.faultinject
 import mpi_vision_tpu.train.loop
+import mpi_vision_tpu.train.queue
+import mpi_vision_tpu.train.supervisor
 import mpi_vision_tpu.train.telemetry
 
 _CLOCK_CALL = re.compile(r"\btime\.(time|monotonic|perf_counter)\s*\(")
@@ -49,6 +52,11 @@ def _linted_sources():
     yield from _package_sources(pkg)
   yield pathlib.Path(mpi_vision_tpu.train.loop.__file__)
   yield pathlib.Path(mpi_vision_tpu.train.telemetry.__file__)
+  # The training queue tier (PR 12): lease timestamps, retry backoff
+  # floors, wedge/grace windows — all injected-clock territory.
+  yield pathlib.Path(mpi_vision_tpu.train.queue.__file__)
+  yield pathlib.Path(mpi_vision_tpu.train.supervisor.__file__)
+  yield pathlib.Path(mpi_vision_tpu.train.faultinject.__file__)
 
 
 def test_no_bare_clock_calls_in_serve_obs_ckpt_train():
@@ -73,7 +81,9 @@ def test_lint_covers_the_ckpt_package_and_train_loop():
   assert {"ckpt/store.py", "ckpt/guards.py", "ckpt/faultinject.py",
           "ckpt/watch.py", "ckpt/background.py", "serve/faultinject.py",
           "serve/engine.py", "serve/scheduler.py", "serve/metrics.py",
-          "train/loop.py", "train/telemetry.py", "cluster/router.py",
+          "train/loop.py", "train/telemetry.py", "train/queue.py",
+          "train/supervisor.py", "train/faultinject.py",
+          "cluster/router.py",
           "cluster/ring.py", "cluster/pool.py", "cluster/supervisor.py",
           "edge/cache.py", "edge/lattice.py", "edge/warp.py",
           "obs/slo.py", "obs/events.py", "obs/trace.py",

@@ -1055,3 +1055,106 @@ def test_flight_recorder_acceptance(tmp_path):
   finally:
     httpd.shutdown()
     svc.close()
+
+
+# --- PR 12 satellites: tsdb compaction + SLO exemplars --------------------
+
+
+class TestTsdbCompaction:
+
+  def _recorder(self, clock, compact_after_s=4.0, stride=4, max_points=64):
+    state = {"i": 0}
+
+    def collect():
+      state["i"] += 1
+      return f"# TYPE m gauge\nm {state['i']}\n"
+
+    return tsdb_mod.TsdbRecorder(collect, tsdb_mod.TsdbConfig(
+        interval_s=1.0, max_points=max_points,
+        compact_after_s=compact_after_s, compact_stride=stride),
+        clock=clock)
+
+  def test_old_points_thin_to_the_stride_recent_stay_full(self):
+    clock = FakeClock(100.0)
+    rec = self._recorder(clock, compact_after_s=4.0, stride=4)
+    for _ in range(16):
+      rec.sample()
+      clock.advance(1.0)
+    pts = rec.query("m")["series"][0]["points"]
+    ts = [p[0] for p in pts]
+    cutoff = max(ts) - 4.0  # the LAST sample's compaction cutoff
+    old = [t for t in ts if t < cutoff]
+    recent = [t for t in ts if t >= cutoff]
+    # Recent window keeps every 1s sample; the old tail is >= stride*interval
+    # apart (thinned, not evicted — the oldest timestamp survives).
+    assert len(recent) >= 3
+    assert min(ts) == 100.0
+    assert all(b - a >= 4.0 for a, b in zip(old, old[1:]))
+    assert rec.stats()["compacted_points"] > 0
+    # Idempotent: re-sampling does not re-thin already-compacted history
+    # below the stride spacing.
+    before = [p[0] for p in rec.query("m")["series"][0]["points"]
+              if p[0] < cutoff]
+    rec.sample()
+    after = [p[0] for p in rec.query("m")["series"][0]["points"]
+             if p[0] < cutoff]
+    assert before[0] == after[0]
+
+  def test_compaction_extends_history_span_in_the_same_budget(self):
+    # max_points comfortably above the stride (the realistic shape —
+    # 512 vs 8 in production): the sweep cadence is amortized to one
+    # per stride samples, and the thinned tail still outlives the
+    # plain ring by ~stride x.
+    clock_a, clock_b = FakeClock(100.0), FakeClock(100.0)
+    plain = self._recorder(clock_a, compact_after_s=None, max_points=16)
+    compact = self._recorder(clock_b, compact_after_s=4.0, stride=4,
+                             max_points=16)
+    for _ in range(64):
+      plain.sample()
+      compact.sample()
+      clock_a.advance(1.0)
+      clock_b.advance(1.0)
+    span = lambda r: (lambda p: p[-1][0] - p[0][0])(
+        r.query("m")["series"][0]["points"])
+    assert span(compact) >= 2 * span(plain)  # same budget, longer history
+    assert len(compact.query("m")["series"][0]["points"]) <= 16
+
+  def test_config_validation(self):
+    with pytest.raises(ValueError, match="compact_after_s"):
+      tsdb_mod.TsdbConfig(compact_after_s=0)
+    with pytest.raises(ValueError, match="compact_stride"):
+      tsdb_mod.TsdbConfig(compact_after_s=10.0, compact_stride=1)
+
+
+class TestSloExemplars:
+
+  def test_per_scene_snapshot_carries_the_worst_offender_trace(self):
+    t = SloTracker(_qcfg(), clock=FakeClock())
+    for i in range(30):
+      t.record(ok=True, latency_s=0.01, scene_id="a", trace_id=f"t{i:02d}")
+    t.record(ok=True, latency_s=0.7, scene_id="a", trace_id="worst")
+    t.record(ok=True, latency_s=0.3, scene_id="a", trace_id="meh")
+    snap = t.snapshot()
+    ex = snap["per_scene"]["a"]["slow"]["exemplar"]
+    assert ex["trace_id"] == "worst"
+    assert ex["value_ms"] == pytest.approx(700.0)
+    # The global quantile objective carries it too.
+    assert snap["objectives"]["latency_p99"]["slow"][
+        "exemplar"]["trace_id"] == "worst"
+
+  def test_quantile_alert_fire_edge_links_the_exemplar(self):
+    alerts = []
+    t = SloTracker(_qcfg(), clock=FakeClock(),
+                   on_alert=lambda n, f, d: alerts.append((n, f, d)))
+    for i in range(20):
+      t.record(ok=True, latency_s=0.9, scene_id="b", trace_id=f"bad{i}")
+    assert "latency_p99:b" in t.alerts_firing()
+    fire = next(d for n, f, d in alerts if n == "latency_p99:b" and f)
+    assert fire["exemplar"]["trace_id"].startswith("bad")
+
+  def test_no_trace_ids_means_no_exemplar_key(self):
+    t = SloTracker(_qcfg(), clock=FakeClock())
+    for _ in range(20):
+      t.record(ok=True, latency_s=0.01, scene_id="a")
+    snap = t.snapshot()
+    assert "exemplar" not in snap["per_scene"]["a"]["slow"]

@@ -85,6 +85,7 @@ def test_train_ckpt_overwrite(tmp_path, capsys):
     ["train", "--synthetic", "--metrics-port", "0"],
     ["train", "--synthetic", "--metrics-log", "/tmp/m.jsonl"],
     ["train", "--synthetic", "--event-log", "/tmp/e.jsonl"],
+    ["train", "--synthetic", "--inject-fault", "crash@step=1"],
     ["serve", "--ckpt-scenes", "3"],
     ["serve", "--ckpt-dataset", "/data/re10k"],
     ["serve", "--reload-ckpt-s", "5"],
@@ -185,6 +186,72 @@ def test_cluster_bad_supervision_knobs_rejected():
   with pytest.raises(SystemExit, match="--wedge-after must be"):
     cli.main(["cluster", "--backends", "1", "--supervise",
               "--wedge-after", "0"])
+
+
+def test_bad_fault_spec_rejected_at_the_door(tmp_path):
+  """A typo'd --inject-fault must fail the invocation, not silently arm
+  nothing (the chaos drill would then 'pass' by testing nothing)."""
+  with pytest.raises(SystemExit, match="fault spec"):
+    cli.main(["train", "--synthetic", "--ckpt", str(tmp_path / "c"),
+              "--inject-fault", "boom@step=1"])
+
+
+def test_tsdb_compaction_knobs_guarded():
+  """Compaction knobs only act through the ring (and the stride only
+  past the age threshold)."""
+  with pytest.raises(SystemExit, match=r"require\(s\) --tsdb-interval-s"):
+    cli.main(["serve", "--tsdb-compact-after-s", "60", "--duration", "0.1"])
+  with pytest.raises(SystemExit,
+                     match="--tsdb-compact-stride requires"):
+    cli.main(["serve", "--tsdb-interval-s", "1", "--tsdb-compact-stride",
+              "4", "--duration", "0.1"])
+
+
+@pytest.mark.parametrize("argv,msg", [
+    (["train-queue", "--root", "/tmp/q", "--concurrency", "0"],
+     "--concurrency must be"),
+    (["train-queue", "--root", "/tmp/q", "--probe-s", "0"],
+     "--probe-s must be"),
+    (["train-queue", "--root", "/tmp/q", "--probe-timeout-s", "0"],
+     "--probe-timeout-s must be"),
+    (["train-queue", "--root", "/tmp/q", "--wedge-after", "0"],
+     "--wedge-after must be"),
+    (["train-queue", "--root", "/tmp/q", "--restart-budget", "0"],
+     "--restart-budget must be"),
+    (["train-queue", "--root", "/tmp/q", "--budget-window-s", "0"],
+     "--budget-window-s must be"),
+    (["train-queue", "--root", "/tmp/q", "--lease-s", "0"],
+     "--lease-s must be"),
+    (["train-queue", "--root", "/tmp/q", "--startup-grace-s", "-1"],
+     "--startup-grace-s must be"),
+    (["train-queue", "--root", "/tmp/q", "--publish-keep", "0"],
+     "--publish-keep must be"),
+    (["train-queue", "--root", "/tmp/q", "--no-slo",
+      "--slo-step-latency-ms", "500"], r"require\(s\) SLO tracking"),
+    (["train-queue", "--root", "/tmp/q", "--no-slo",
+      "--slo-availability", "0.9"], r"require\(s\) SLO tracking"),
+    (["train-queue", "--root", "/tmp/q", "--submit", "not json"],
+     "--submit is not valid JSON"),
+    (["train-queue", "--root", "/tmp/q", "--submit", "[1, 2]"],
+     "--submit must be a JSON object"),
+])
+def test_train_queue_bad_knobs_rejected(argv, msg):
+  """Queue knobs are validated at the door: the supervisor's monitor
+  loop swallows tick exceptions by design, so a lazily-raised
+  ValueError would leave supervision silently dead (the cluster rule)."""
+  with pytest.raises(SystemExit, match=msg):
+    cli.main(argv)
+
+
+def test_train_queue_bad_job_id_rejected(tmp_path):
+  """Bad or duplicate job ids fail the same validate-at-the-door way as
+  every other knob — a clean SystemExit, not a traceback."""
+  root = str(tmp_path / "q")
+  with pytest.raises(SystemExit, match="--submit rejected"):
+    cli.main(["train-queue", "--root", root, "--submit", '{"id": 5}'])
+  with pytest.raises(SystemExit, match="--submit rejected"):
+    cli.main(["train-queue", "--root", root,
+              "--submit", '{"id": "has space"}'])
 
 
 def test_negative_save_every_rejected(tmp_path):

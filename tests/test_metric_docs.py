@@ -25,6 +25,7 @@ from mpi_vision_tpu.obs import tsdb as tsdb_mod
 from mpi_vision_tpu.obs.slo import SloConfig, SloTracker
 from mpi_vision_tpu.serve.cluster.router import Router
 from mpi_vision_tpu.serve.metrics import ServeMetrics
+from mpi_vision_tpu.train.supervisor import queue_registry
 from mpi_vision_tpu.train.telemetry import TrainMetrics
 
 README = pathlib.Path(__file__).parent.parent / "README.md"
@@ -72,9 +73,16 @@ def _train_families() -> set[str]:
   return {metric.name for metric in tm.registry()._metrics}
 
 
+def _train_queue_families() -> set[str]:
+  # The training-queue supervisor's families off a bare snapshot (the
+  # registry is a pure function of it, like tsdb/ship above).
+  return {metric.name for metric in queue_registry({})._metrics}
+
+
 def _exposed_families() -> set[str]:
   return (_serve_families() | _slo_families() | _cluster_families()
-          | _train_families() | _obs_families())
+          | _train_families() | _train_queue_families()
+          | _obs_families())
 
 
 def _documented_families() -> set[str]:
@@ -103,6 +111,7 @@ def test_doc_scan_actually_finds_families():
   assert "mpi_serve_requests_total" in docs
   assert "mpi_slo_burn_rate" in docs
   assert "mpi_train_steps_total" in docs
+  assert "mpi_train_queue_quarantines_total" in docs
   assert "mpi_cluster_backend_up" in docs
   assert not any(t.endswith("_") for t in docs)
   assert len(_exposed_families()) > 40

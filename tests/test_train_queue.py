@@ -1,0 +1,681 @@
+"""Training job queue + supervisor: the state machine on fakes.
+
+Everything here runs on fake clocks, launchers, and transports — no
+subprocesses, no JAX — so the whole lease/requeue/quarantine machine is
+pinned in milliseconds. The real-subprocess acceptance arc lives in
+``tests/test_train_queue_arc.py``; the chaos bench's ``--dry`` decision
+path is registered tier-1 here (in-process, fake time).
+"""
+
+import json
+import signal
+
+import pytest
+
+from mpi_vision_tpu.obs.events import EventLog
+from mpi_vision_tpu.train import faultinject as fi
+from mpi_vision_tpu.train.queue import (
+    JobQueue,
+    JobQueueError,
+    LeaseLostError,
+)
+from mpi_vision_tpu.train.supervisor import (
+    JobSpecError,
+    SubprocessLauncher,
+    TrainSupervisor,
+)
+
+
+class FakeClock:
+  def __init__(self, t=1000.0):
+    self.t = t
+
+  def __call__(self):
+    return self.t
+
+  def sleep(self, seconds):
+    self.t += max(float(seconds), 0.0)
+
+
+# --- queue lifecycle ------------------------------------------------------
+
+
+def test_submit_lease_complete_roundtrip(tmp_path):
+  clock = FakeClock()
+  events = EventLog(clock=clock)
+  q = JobQueue(str(tmp_path), lease_s=30.0, clock=clock, events=events)
+  jid = q.submit({"epochs": 1}, job_id="a")
+  assert jid == "a" and q.get("a").state == "queued"
+  job = q.lease("w1")
+  assert job.id == "a" and job.state == "leased"
+  assert q.lease("w2") is None  # single job, already claimed
+  q.mark_running("a", "w1", attempt=0)
+  assert q.get("a").attempts == 1
+  q.complete("a", "w1", {"ok": True})
+  assert q.get("a").state == "done"
+  assert q.drained()
+  assert events.count("training_job_done") == 1
+  # The record is one atomic JSON file a fresh reader can reload whole.
+  reloaded = JobQueue(str(tmp_path), clock=clock)
+  assert reloaded.get("a").record["result"] == {"ok": True}
+
+
+def test_lease_respects_backoff_floor_and_fifo(tmp_path):
+  clock = FakeClock()
+  q = JobQueue(str(tmp_path), clock=clock)
+  q.submit({}, job_id="old")
+  clock.t += 1.0
+  q.submit({}, job_id="new")
+  job = q.lease("w")
+  assert job.id == "old"  # FIFO by creation time
+  q.mark_running("old", "w", 0)
+  q.requeue("old", "w", "crash", not_before_unix_s=clock() + 10.0)
+  assert q.lease("w").id == "new"  # old is cooling off
+  q.requeue("new", "w", "crash", not_before_unix_s=clock() + 5.0)
+  assert q.lease("w") is None
+  clock.t += 10.1
+  assert q.lease("w").id == "old"
+
+
+def test_dead_worker_lease_expires_and_requeues(tmp_path):
+  clock = FakeClock()
+  events = EventLog(clock=clock)
+  q = JobQueue(str(tmp_path), lease_s=10.0, clock=clock, events=events)
+  q.submit({}, job_id="a")
+  q.lease("w1")
+  q.mark_running("a", "w1", 0)
+  assert q.reap_expired() == []  # heartbeat fresh
+  clock.t += 9.0
+  q.heartbeat("a", "w1")
+  clock.t += 9.0
+  assert q.reap_expired() == []  # refreshed in time
+  clock.t += 10.1
+  assert q.reap_expired() == ["a"]  # the worker died: requeued, not lost
+  record = q.get("a").record
+  assert record["state"] == "queued" and record["lease"] is None
+  assert q.leases_expired == 1
+  assert events.count("training_job_lease_expired") == 1
+  # The dead worker's late write is refused: its lease is gone.
+  with pytest.raises(LeaseLostError):
+    q.heartbeat("a", "w1")
+  with pytest.raises(LeaseLostError):
+    q.complete("a", "w1")
+  # A new worker resumes it (attempts carries across workers).
+  job = q.lease("w2")
+  q.mark_running("a", "w2", job.attempts)
+  assert q.get("a").attempts == 2
+
+
+def test_quarantine_is_terminal_until_readmitted(tmp_path):
+  clock = FakeClock()
+  events = EventLog(clock=clock)
+  q = JobQueue(str(tmp_path), clock=clock, events=events)
+  q.submit({}, job_id="p")
+  q.lease("w")
+  q.mark_running("p", "w", 0)
+  q.quarantine("p", "w", "crash-loop")
+  assert q.get("p").state == "quarantined"
+  assert q.lease("w") is None and q.drained()
+  assert events.count("training_job_quarantined") == 1
+  q.readmit("p")
+  assert q.get("p").state == "queued"
+  assert q.lease("w").id == "p"
+
+
+def test_queue_guards(tmp_path):
+  q = JobQueue(str(tmp_path), clock=FakeClock())
+  with pytest.raises(ValueError, match="lease_s"):
+    JobQueue(str(tmp_path), lease_s=0)
+  with pytest.raises(ValueError, match="must be a dict"):
+    q.submit("nope")
+  with pytest.raises(ValueError, match="job id"):
+    q.submit({}, job_id="bad/../id")
+  q.submit({}, job_id="dup")
+  with pytest.raises(JobQueueError, match="already exists"):
+    q.submit({}, job_id="dup")
+  with pytest.raises(JobQueueError, match="not quarantined/failed"):
+    q.readmit("dup")
+
+
+# --- fault grammar --------------------------------------------------------
+
+
+def test_fault_grammar_roundtrip():
+  spec = fi.parse_fault("crash@step=7,hard,attempt=0")
+  assert spec == {"kind": "crash", "attempt": 0, "step": 7, "hard": True}
+  assert fi.format_fault(spec) == "crash@step=7,hard,attempt=0"
+  assert fi.parse_fault("corrupt@save=1,mode=garble")["mode"] == "garble"
+  assert fi.parse_fault("hang@step=2,seconds=9.5")["seconds"] == 9.5
+  for bad in ("crash", "crash@", "boom@step=1", "crash@step=1,save=2",
+              "nan@save=1", "corrupt@step=1", "crash@step=x",
+              "crash@step=1,zorp=3"):
+    with pytest.raises(fi.FaultSpecError):
+      fi.parse_fault(bad)
+
+
+def test_malformed_fault_entries_are_spec_errors_not_loops():
+  """JSON job specs can carry dict or garbage fault entries: they must
+  raise FaultSpecError (-> terminal spec-reject at the launcher), never
+  a bare KeyError/TypeError that would strand the job in a
+  lease-reap-respawn loop the restart budget cannot see."""
+  for bad in (5, "crash@step=1", {"kind": "crash"},
+              [{"kind": "crash"}], [{"step": 1}], [5], [None]):
+    with pytest.raises(fi.FaultSpecError):
+      fi.applicable(bad, 0)
+    with pytest.raises(fi.FaultSpecError):
+      fi.build_source(bad)
+  # Valid dict entries (the JSON spec form) still work.
+  assert fi.applicable([{"kind": "crash", "step": 1, "hard": True}],
+                       0) == ["crash@step=1,hard"]
+  # A typo'd key must REJECT, not silently vanish in the round-trip —
+  # a dropped "atempt" gate turns a one-shot crash into a poison job.
+  with pytest.raises(fi.FaultSpecError, match="atempt"):
+    fi.applicable([{"kind": "crash", "step": 1, "atempt": 0}], 0)
+
+
+def test_launcher_rejects_malformed_faults_terminally(tmp_path):
+  launcher = SubprocessLauncher(str(tmp_path))
+  queue = JobQueue(str(tmp_path / "q"), clock=FakeClock())
+  queue.submit({"faults": [{"kind": "crash"}]}, job_id="garbage")
+  with pytest.raises(JobSpecError):
+    launcher.argv(queue.get("garbage"), 0, False)
+
+
+def test_fault_attempt_gating():
+  faults = ["crash@step=1,hard,attempt=0", "nan@step=2"]
+  assert fi.applicable(faults, 0) == ["crash@step=1,hard,attempt=0",
+                                      "nan@step=2"]
+  assert fi.applicable(faults, 1) == ["nan@step=2"]  # gated crash dropped
+  assert fi.build_source(faults, attempt=1).on_step(1) is None
+  assert fi.build_source(faults, attempt=0).on_step(1) is not None
+  assert fi.build_source(["crash@step=1,attempt=2"], attempt=0) is None
+
+
+# --- supervisor over fakes ------------------------------------------------
+
+
+class FakeHandle:
+  def __init__(self, port=9):
+    self.rc = None
+    self.kills = []
+    self.ckpt_dir = "<fake>"
+    self.port = port
+    self.health = {"status": "ok", "steps": 0, "last_step_ms": 25.0}
+    self.term_exits_clean = False
+
+  def poll(self):
+    return self.rc
+
+  def kill(self, sig):
+    self.kills.append(int(sig))
+    if sig == signal.SIGTERM and self.term_exits_clean:
+      self.rc = 0
+    else:
+      self.rc = -int(sig)
+
+  def metrics_address(self):
+    return f"127.0.0.1:{self.port}"
+
+
+class FakeLauncher:
+  def __init__(self):
+    self.spawned = []
+    self.handles = {}
+    self.reject = set()
+
+  def __call__(self, job, attempt, resume):
+    if job.id in self.reject:
+      raise JobSpecError("bad spec")
+    handle = FakeHandle(port=9000 + len(self.spawned))
+    self.spawned.append((job.id, attempt, resume))
+    self.handles[(job.id, attempt)] = handle
+    return handle
+
+
+class FakeTransport:
+  """Keyed by the probed address (a probe of job A answered with job
+  B's counters would reset the wrong stall clock)."""
+
+  def __init__(self, launcher):
+    self.launcher = launcher
+
+  def request(self, method, url, body=None, headers=None, timeout=None):
+    for handle in self.launcher.handles.values():
+      if (handle.rc is None
+          and url == f"http://{handle.metrics_address()}/healthz"):
+        return 200, {}, json.dumps(handle.health).encode()
+    raise ConnectionError("down")
+
+
+class FakePublish:
+  def __init__(self):
+    self.calls = []
+
+  def publish_from(self, src_root, meta_extra=None):
+    self.calls.append((src_root, meta_extra))
+    return len(self.calls) - 1, 0
+
+
+def _sup(tmp_path, **kwargs):
+  clock = kwargs.pop("clock", FakeClock())
+  events = EventLog(clock=clock)
+  queue = JobQueue(str(tmp_path), lease_s=60.0, clock=clock, events=events)
+  launcher = FakeLauncher()
+  defaults = dict(restart_budget=2, budget_window_s=600.0,
+                  backoff_base_s=1.0, backoff_mult=2.0, backoff_max_s=8.0,
+                  wedge_after=3, startup_grace_s=5.0)
+  defaults.update(kwargs)
+  supervisor = TrainSupervisor(
+      queue, launcher=launcher, transport=FakeTransport(launcher),
+      events=events, clock=clock, sleep=clock.sleep, **defaults)
+  return clock, queue, launcher, supervisor, events
+
+
+def test_crash_loop_quarantined_at_exactly_the_budget(tmp_path):
+  clock, queue, launcher, sup, events = _sup(tmp_path, restart_budget=2)
+  queue.submit({}, job_id="poison")
+  sup.tick()
+  assert launcher.spawned == [("poison", 0, False)]
+  for attempt in (0, 1, 2):
+    launcher.handles[("poison", attempt)].rc = 1
+    sup.tick()          # detect the crash (first retry is immediate,
+    clock.t += 10.0     # later ones back off; jump past any backoff)
+    sup.tick()
+  # 1 first attempt + 2 budgeted retries, then containment.
+  assert queue.get("poison").state == "quarantined"
+  assert queue.get("poison").attempts == 3
+  assert sup.quarantines_total == 1 and sup.failures_total == 3
+  assert [s[2] for s in launcher.spawned] == [False, True, True]  # resumes
+  assert events.count("training_job_quarantined") == 1
+  # Containment, not collapse: a sibling submitted later still drains.
+  queue.submit({}, job_id="good")
+  sup.tick()
+  launcher.handles[("good", 0)].rc = 0
+  sup.tick()
+  assert queue.get("good").state == "done"
+
+
+def test_backoff_between_repeat_failures(tmp_path):
+  clock, queue, launcher, sup, _ = _sup(tmp_path, restart_budget=3,
+                                        backoff_base_s=1.0)
+  queue.submit({}, job_id="flappy")
+  sup.tick()
+  launcher.handles[("flappy", 0)].rc = 1
+  sup.tick()  # failure 1: immediate retry (streak 1 -> backoff(0)=0)
+  assert ("flappy", 1, True) in launcher.spawned
+  launcher.handles[("flappy", 1)].rc = 1
+  sup.tick()  # failure 2: 1s backoff — not runnable yet
+  assert queue.get("flappy").state == "queued"
+  sup.tick()
+  assert len(launcher.spawned) == 2  # still cooling
+  clock.t += 1.1
+  sup.tick()
+  assert launcher.spawned[-1] == ("flappy", 2, True)
+
+
+def test_wedged_trainer_is_sigkilled_and_requeued(tmp_path):
+  clock, queue, launcher, sup, events = _sup(tmp_path, wedge_after=2)
+  queue.submit({}, job_id="stuck")
+  sup.tick()
+  handle = launcher.handles[("stuck", 0)]
+  handle.health = {"status": "ok", "steps": 4, "last_step_ms": 25.0}
+  sup.tick()  # progress observed: stall counter resets
+  sup.tick()  # stall 1
+  sup.tick()  # stall 2 -> wedged: SIGKILL + requeue (+ immediate respawn)
+  assert handle.kills == [signal.SIGKILL]
+  assert sup.wedges_total == 1 and sup.failures_total == 1
+  assert events.count("training_job_wedged") == 1
+  assert launcher.spawned[-1] == ("stuck", 1, True)
+
+
+def test_startup_grace_tolerates_slow_first_compile(tmp_path):
+  clock, queue, launcher, sup, _ = _sup(tmp_path, wedge_after=2,
+                                        startup_grace_s=30.0)
+  queue.submit({}, job_id="cold")
+  sup.tick()
+  handle = launcher.handles[("cold", 0)]
+  handle.health = {"status": "garbage"}  # listener not answering yet
+  for _ in range(10):  # way past wedge_after, inside the grace window
+    clock.t += 1.0
+    sup.tick()
+  assert sup.wedges_total == 0 and handle.kills == []
+  clock.t += 30.0  # grace expired, still no health: now it counts
+  sup.tick()
+  sup.tick()
+  assert sup.wedges_total == 1
+
+
+def test_preempt_requeues_without_spending_budget(tmp_path):
+  clock, queue, launcher, sup, events = _sup(tmp_path, restart_budget=1)
+  queue.submit({}, job_id="a")
+  sup.tick()
+  handle = launcher.handles[("a", 0)]
+  handle.term_exits_clean = True  # the CLI's preempt save + clean exit
+  assert sup.preempt(drain_timeout_s=1.0) == ["a"]
+  record = queue.get("a").record
+  assert record["state"] == "queued"
+  assert record["history"][-1]["counted"] is False  # no budget spent
+  assert sup.preemptions_total == 1 and sup.failures_total == 0
+  assert events.count("training_job_preempt") == 1
+  # The next tick resumes it and it completes.
+  sup.tick()
+  assert launcher.spawned[-1] == ("a", 1, True)
+  launcher.handles[("a", 1)].rc = 0
+  sup.tick()
+  assert queue.get("a").state == "done"
+
+
+def test_completed_job_publishes_into_the_watch_store(tmp_path):
+  clock, queue, launcher, sup, events = _sup(tmp_path)
+  publish = FakePublish()
+  sup.publish_store = publish
+  queue.submit({}, job_id="a")
+  sup.tick()
+  launcher.handles[("a", 0)].rc = 0
+  sup.tick()
+  assert publish.calls == [("<fake>", {"job": "a"})]
+  assert queue.get("a").record["result"]["published_step"] == 0
+  assert sup.publishes_total == 1
+  assert events.count("training_job_published") == 1
+
+
+def test_bad_spec_fails_terminally_without_stalling_the_queue(tmp_path):
+  clock, queue, launcher, sup, _ = _sup(tmp_path)
+  queue.submit({}, job_id="bad")
+  queue.submit({}, job_id="good")
+  launcher.reject.add("bad")
+  sup.tick()
+  assert queue.get("bad").state == "failed"
+  assert sup.spec_rejects_total == 1
+  launcher.handles[("good", 0)].rc = 0
+  sup.tick()
+  assert queue.get("good").state == "done" and queue.drained()
+
+
+def test_slo_scores_attempts_and_step_latency(tmp_path):
+  from mpi_vision_tpu.obs.slo import SloConfig, SloTracker
+
+  clock, queue, launcher, sup, _ = _sup(tmp_path, restart_budget=1)
+  slo = SloTracker(SloConfig(latency_threshold_s=0.1), clock=clock)
+  sup.slo = slo
+  queue.submit({}, job_id="a")
+  sup.tick()
+  handle = launcher.handles[("a", 0)]
+  sup.tick()  # first healthy probe: liveness baseline, no latency sample
+  handle.health = {"status": "ok", "steps": 1, "last_step_ms": 250.0}
+  sup.tick()  # a real step delta, 250ms > 100ms threshold: latency-bad
+  handle.rc = 0
+  sup.tick()  # attempt succeeded: availability-good
+  snap = slo.snapshot()
+  assert snap["objectives"]["latency"]["slow"]["bad"] == 1
+  # Step samples score ONLY latency: availability is attempt outcomes
+  # alone (one completed attempt == one good event), so a healthy job's
+  # steady step stream cannot dilute a sibling's crash-loop out of the
+  # availability burn rate.
+  assert snap["objectives"]["availability"]["slow"]["requests"] == 1
+  assert snap["objectives"]["availability"]["slow"]["bad"] == 0
+  assert snap["objectives"]["latency"]["slow"]["requests"] == 1
+  # The scrape surface joins the queue + SLO families (Registry.extend).
+  text = sup.metrics_text()
+  assert "mpi_train_queue_spawns_total" in text
+  assert "mpi_slo_attainment" in text
+
+
+def test_readmitted_job_gets_a_fresh_restart_budget(tmp_path):
+  clock, queue, launcher, sup, _ = _sup(tmp_path, restart_budget=1)
+  queue.submit({}, job_id="p")
+  sup.tick()
+  for attempt in (0, 1):
+    launcher.handles[("p", attempt)].rc = 1
+    sup.tick()
+    clock.t += 10.0
+    sup.tick()
+  assert queue.get("p").state == "quarantined"
+  assert queue.get("p").attempts == 2  # 1 + budget
+  queue.readmit("p")
+  # The operator override promises a FRESH budget: the next failure must
+  # retry, not instantly re-quarantine off the exhausted old one.
+  sup.tick()
+  launcher.handles[("p", 2)].rc = 1
+  sup.tick()
+  # Fresh budget: the failure RETRIED (the first retry is immediate, so
+  # the same tick respawned it as attempt 3) instead of re-quarantining.
+  assert launcher.spawned[-1] == ("p", 3, True)
+  assert queue.get("p").state == "running"
+  launcher.handles[("p", 3)].rc = 1
+  sup.tick()
+  assert queue.get("p").state == "quarantined"  # fresh budget exhausted
+  assert sup.quarantines_total == 2
+
+
+def test_supervisor_guards():
+  with pytest.raises(ValueError, match="concurrency"):
+    TrainSupervisor(object(), launcher=lambda *a: None, concurrency=0)
+  with pytest.raises(ValueError, match="restart_budget"):
+    TrainSupervisor(object(), launcher=lambda *a: None, restart_budget=0)
+  with pytest.raises(ValueError, match="wedge_after"):
+    TrainSupervisor(object(), launcher=lambda *a: None, wedge_after=0)
+  with pytest.raises(ValueError, match="launcher or a work_root"):
+    TrainSupervisor(object())
+
+
+def test_queue_registry_families(tmp_path):
+  clock, queue, launcher, sup, _ = _sup(tmp_path)
+  queue.submit({}, job_id="a")
+  sup.tick()
+  text = sup.metrics_text()
+  assert 'mpi_train_queue_jobs{state="running"} 1' in text
+  assert "mpi_train_queue_spawns_total 1" in text
+  assert "mpi_train_queue_quarantines_total 0" in text
+
+
+# --- the subprocess launcher's argv (no spawn) ----------------------------
+
+
+def test_launcher_argv_isolation_and_faults(tmp_path):
+  launcher = SubprocessLauncher(str(tmp_path))
+  queue = JobQueue(str(tmp_path / "q"), clock=FakeClock())
+  queue.submit({"epochs": 2, "img_size": 32, "num_planes": 4, "seed": 7,
+                "faults": ["crash@step=1,hard,attempt=0"]}, job_id="j1")
+  job = queue.get("j1")
+  argv0 = launcher.argv(job, attempt=0, resume=False)
+  assert "--ckpt" in argv0 and str(tmp_path / "j1" / "ckpt") in argv0
+  assert "--resume" not in argv0
+  assert "--inject-fault" in argv0  # attempt 0 carries its gated fault
+  assert "--no-vgg-loss" in argv0 and "--no-valid" in argv0
+  argv1 = launcher.argv(job, attempt=1, resume=True)
+  assert "--resume" in argv1
+  assert "--inject-fault" not in argv1  # the gate filtered it out
+  queue.submit({"epochs": "two"}, job_id="j2")
+  with pytest.raises(JobSpecError, match="epochs"):
+    launcher.argv(queue.get("j2"), 0, False)
+
+
+# --- chaos bench, dry decision path (tier-1 registration) -----------------
+
+
+def test_chaos_bench_dry_smoke():
+  """The full chaos drill — poison quarantined at exactly its budget,
+  wedge killed and retried, crash-once resumed, everything else drained
+  and published — on the scripted fakes, in fake time."""
+  import importlib.util
+  import os
+
+  path = os.path.join(os.path.dirname(os.path.dirname(
+      os.path.abspath(__file__))), "bench", "train_queue.py")
+  spec = importlib.util.spec_from_file_location("bench_train_queue", path)
+  mod = importlib.util.module_from_spec(spec)
+  spec.loader.exec_module(mod)
+  out = mod.run_dry(budget=1)
+  assert out["metric"] == "train_queue_chaos" and out["dry"] is True
+  assert out["drained"] is True and out["value"] == 3
+  assert out["jobs"]["quarantined"] == 1
+  assert out["poison_attempts"] == 1 + out["restart_budget"]
+  assert out["wedges"] == 1 and out["publishes"] == 3
+  assert out["slo"]["objectives"]["availability"]["requests"] > 0
+
+
+# --- review-round pins ----------------------------------------------------
+
+
+def test_orphaned_claim_ages_out_and_job_stays_leasable(tmp_path):
+  """A claimer killed between creating its claim file and leasing must
+  not make the job permanently unleasable: the claim ages out after
+  lease_s (requeued-never-lost applies to the claim protocol too)."""
+  clock = FakeClock()
+  q = JobQueue(str(tmp_path), lease_s=10.0, clock=clock)
+  q.submit({}, job_id="a")
+  # Forge a crashed peer's orphan claim.
+  with open(q._claim_path("a"), "w") as fh:
+    json.dump({"owner": "dead", "ts_unix_s": clock()}, fh)
+  assert q.lease("w") is None  # fresh claim: a live peer, back off
+  clock.t += 10.1
+  job = q.lease("w")  # stale claim removed, job claimed normally
+  assert job is not None and job.id == "a"
+
+
+def test_completion_after_lease_reaped_is_skipped_not_crashed(tmp_path):
+  """A tick that outlived lease_s may find its finished job already
+  reaped: completion (and publish) must be skipped for the new owner,
+  never crash the tick or double-publish."""
+  clock = FakeClock()
+  events = EventLog(clock=clock)
+  queue = JobQueue(str(tmp_path), lease_s=5.0, clock=clock, events=events)
+  launcher = FakeLauncher()
+  sup = TrainSupervisor(queue, launcher=launcher,
+                        transport=FakeTransport(launcher), events=events,
+                        clock=clock, sleep=clock.sleep)
+  publish = FakePublish()
+  sup.publish_store = publish
+  queue.submit({}, job_id="a")
+  sup.tick()
+  clock.t += 6.0  # the supervisor stalled past lease_s
+  launcher.handles[("a", 0)].rc = 0
+  sup.tick()  # reap_expired requeues "a" first, then the exit lands
+  # The reaper's requeue stands: the stale attempt neither completed
+  # the job nor published its checkpoint (the same tick may have
+  # legitimately re-leased it as a fresh attempt — that is recovery,
+  # not completion).
+  assert queue.get("a").state != "done"
+  assert publish.calls == []               # no publish for a lost lease
+  assert sup.completes_total == 0 and sup.tick_errors == 0
+
+
+def test_run_until_drained_is_interruptible(tmp_path):
+  clock, queue, launcher, sup, _ = _sup(tmp_path)
+  queue.submit({}, job_id="never-finishes")
+  stops = iter([False, True])
+  assert sup.run_until_drained(timeout_s=100.0,
+                               should_stop=lambda: next(stops)) is False
+
+
+def test_stale_claim_takeover_is_single_winner(tmp_path, monkeypatch):
+  """Two workers judging the same orphan claim stale must not both win
+  it: the takeover is an atomic rename, so the loser backs off instead
+  of unlinking the winner's fresh claim (double-lease guard)."""
+  clock = FakeClock()
+  q = JobQueue(str(tmp_path), lease_s=10.0, clock=clock)
+  q.submit({}, job_id="a")
+  with open(q._claim_path("a"), "w") as fh:
+    json.dump({"owner": "dead", "ts_unix_s": clock()}, fh)
+  clock.t += 10.1
+  # Simulate the loser: the orphan vanished under us (peer renamed it).
+  import mpi_vision_tpu.train.queue as qmod
+  def rename_lost(src, dst):
+    raise OSError("vanished: a peer won the takeover")
+  monkeypatch.setattr(qmod.os, "rename", rename_lost)
+  assert q.lease("slow-worker") is None  # backs off, no double lease
+  monkeypatch.undo()
+  assert q.lease("fast-worker").id == "a"  # recovery still works
+
+
+def test_stale_takeover_restores_a_freshly_relinked_claim(tmp_path,
+                                                          monkeypatch):
+  """The takeover rename must verify what it moved: a peer may complete
+  its own takeover and link a FRESH claim between our staleness read and
+  the rename — stealing that claim would double-lease the job."""
+  import os
+
+  clock = FakeClock()
+  q = JobQueue(str(tmp_path), lease_s=10.0, clock=clock)
+  q.submit({}, job_id="a")
+  with open(q._claim_path("a"), "w") as fh:
+    json.dump({"owner": "dead", "ts_unix_s": clock()}, fh)
+  clock.t += 10.1
+  import mpi_vision_tpu.train.queue as qmod
+  real_rename = qmod.os.rename
+  raced = {"done": False}
+  def racing_rename(src, dst):
+    if src == q._claim_path("a") and not raced["done"]:
+      raced["done"] = True
+      # The peer finished its takeover and linked a FRESH claim here.
+      with open(src, "w") as fh:
+        json.dump({"owner": "peer", "ts_unix_s": clock()}, fh)
+    real_rename(src, dst)
+  monkeypatch.setattr(qmod.os, "rename", racing_rename)
+  assert q.lease("slow") is None  # backed off, nothing stolen
+  # The peer's fresh claim is back in place, still guarding the job.
+  with open(q._claim_path("a")) as fh:
+    assert json.load(fh)["owner"] == "peer"
+
+
+def test_sweep_spares_a_live_peers_inflight_write(tmp_path):
+  clock = FakeClock()
+  q = JobQueue(str(tmp_path), clock=clock)
+  import os
+  live = str(tmp_path / f".tmp-job-x-{os.getpid()+0}-deadbeef")
+  # Our own pid counts as dead (fresh construction), so fake a LIVE
+  # peer with pid 1 (init: always alive) and a dead one with an
+  # implausible pid.
+  peer = str(tmp_path / ".tmp-job-y-1-deadbeef")
+  dead = str(tmp_path / ".tmp-job-z-999999999-deadbeef")
+  for p in (peer, dead):
+    open(p, "w").close()
+  JobQueue(str(tmp_path), clock=clock)  # construction sweeps
+  assert os.path.exists(peer)      # live peer's write untouched
+  assert not os.path.exists(dead)  # crashed writer's junk removed
+  os.unlink(peer)
+
+
+def test_mark_running_lease_loss_kills_the_spawn(tmp_path):
+  """A spawn slower than lease_s whose job was reaped mid-launch must
+  kill the fresh process, not leak it unsupervised."""
+  clock, queue, launcher, sup, _ = _sup(tmp_path)
+  queue.submit({}, job_id="a")
+  real_mark = queue.mark_running
+  orphans = []
+  def slow_mark(job_id, owner, attempt, detail=None):
+    queue.mark_running = real_mark  # only the FIRST spawn is slow
+    orphans.append(launcher.handles[(job_id, attempt)])
+    clock.t += 120.0           # the spawn outlived lease_s (60)
+    queue.reap_expired()       # another worker's reaper took the job
+    return real_mark(job_id, owner, attempt, detail=detail)
+  queue.mark_running = slow_mark
+  sup.tick()
+  assert signal.SIGKILL in orphans[0].kills  # the orphan was killed
+  # The reaper's requeue stood at the instant of loss; the same tick
+  # then re-leased the job as a fresh, properly-owned attempt.
+  assert sup.running() == ["a"]
+  assert queue.get("a").state == "running"
+  fresh = launcher.handles[("a", 0)]
+  assert fresh is not orphans[0] and fresh.kills == []
+
+
+def test_run_until_drained_contains_tick_errors(tmp_path):
+  clock, queue, launcher, sup, _ = _sup(tmp_path)
+  queue.submit({}, job_id="a")
+  boom = {"n": 0}
+  real_tick = sup.tick
+  def flaky_tick():
+    if boom["n"] == 0:
+      boom["n"] += 1
+      raise OSError("transient NFS sadness")
+    real_tick()
+  # After the one flaky tick, real ticks run the job to completion.
+  def finish_soon():
+    real_tick()
+    for handle in launcher.handles.values():
+      handle.rc = 0
+  sup.tick = lambda: (flaky_tick() if boom["n"] == 0 else finish_soon())
+  assert sup.run_until_drained(timeout_s=50.0) is True
+  assert sup.tick_errors == 1

@@ -1,0 +1,248 @@
+"""The training-queue acceptance arc (ISSUE 12's signature pin).
+
+ONE module-scoped drill over REAL ``cli train`` subprocesses: a 3-job
+queue holding
+
+  * ``good``   — a clean job (the uninterrupted digest baseline),
+  * ``poison`` — hard-SIGKILLs itself before step 0 on EVERY attempt
+    (the crash-looper), and
+  * ``victim`` — hard-SIGKILLs itself mid-run on attempt 0 only (the
+    kill-and-resume case; same spec + seed as ``good``),
+
+drained by a ``TrainSupervisor`` publishing completed checkpoints into a
+watch store that an in-process ``--reload-ckpt-s`` serving stack
+(``CheckpointWatcher`` -> ``scenes_from_checkpoint`` -> ``swap_scenes``,
+the serve CLI's reload path in miniature) swaps live under constant
+render traffic. The pins, each its own test over the one shared run:
+
+  * the poison job is quarantined at EXACTLY its restart budget while
+    the sibling jobs complete;
+  * the SIGKILLed-then-requeued victim's final checkpoint digest is
+    bit-identical to the uninterrupted run's;
+  * both completed checkpoints are published and served live with zero
+    dropped requests across the swap.
+"""
+
+import hashlib
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from _cpu_mesh import hardened_env  # noqa: E402
+
+SPEC = {"epochs": 1, "img_size": 32, "num_planes": 4,
+        "synthetic_scenes": 2, "save_every": 1, "seed": 7}
+RESTART_BUDGET = 1  # poison: 1 first attempt + 1 retry, then quarantine
+
+
+def _digest(ckpt_root: str) -> str:
+  """sha256 over the newest checkpoint's arrays, read back from disk
+  (the bench/train_resume.py digest contract)."""
+  from mpi_vision_tpu.ckpt import CheckpointStore
+
+  restored = CheckpointStore(ckpt_root).restore()
+  assert restored is not None, f"no checkpoint under {ckpt_root}"
+  h = hashlib.sha256()
+  for key in sorted(restored.arrays):
+    arr = np.asarray(restored.arrays[key], order="C")
+    h.update(key.encode())
+    h.update(str(arr.dtype).encode())
+    h.update(arr.tobytes())
+  return h.hexdigest()
+
+
+@pytest.fixture(scope="module")
+def arc(tmp_path_factory):
+  from mpi_vision_tpu.ckpt import CheckpointStore, CheckpointWatcher
+  from mpi_vision_tpu.ckpt.export import scenes_from_checkpoint
+  from mpi_vision_tpu.obs.events import EventLog
+  from mpi_vision_tpu.obs.slo import SloConfig, SloTracker
+  from mpi_vision_tpu.serve import RenderService
+  from mpi_vision_tpu.train.queue import JobQueue
+  from mpi_vision_tpu.train.supervisor import (
+      SubprocessLauncher,
+      TrainSupervisor,
+  )
+
+  root = tmp_path_factory.mktemp("train_queue_arc")
+  env = hardened_env(1)
+  # Share the suite's persistent XLA cache so reruns skip the compiles.
+  env["JAX_COMPILATION_CACHE_DIR"] = os.path.join(REPO, ".jax_cache")
+
+  events = EventLog(capacity=1024)
+  queue = JobQueue(str(root / "queue"), lease_s=120.0, events=events)
+  queue.submit(dict(SPEC), job_id="good")
+  # The poison job crashes before its first step ever runs, so it never
+  # compiles a train step — keep its model tiny too (16px): both its
+  # spawns are pure process+init overhead.
+  queue.submit({**SPEC, "seed": 3, "img_size": 16, "synthetic_scenes": 1,
+                "faults": ["crash@step=0,hard"]}, job_id="poison")
+  queue.submit({**SPEC, "faults": ["crash@step=1,hard,attempt=0"]},
+               job_id="victim")
+  publish = CheckpointStore(str(root / "publish"), keep=8, events=events)
+  slo = SloTracker(SloConfig(latency_threshold_s=60.0))
+  supervisor = TrainSupervisor(
+      queue, launcher=SubprocessLauncher(str(root / "work"), env=env),
+      publish_store=publish, concurrency=2, probe_s=0.25,
+      probe_timeout_s=2.0, wedge_after=200, startup_grace_s=120.0,
+      restart_budget=RESTART_BUDGET, budget_window_s=600.0,
+      backoff_base_s=0.1, backoff_max_s=0.5, slo=slo, events=events)
+  supervisor.start()
+
+  # Serving side: once the FIRST publish lands, stand up the serve CLI's
+  # --reload-ckpt-s machinery in miniature and hammer it with renders
+  # while the remaining publishes swap scenes live.
+  deadline = time.monotonic() + 240.0
+  while publish.latest_step() is None and time.monotonic() < deadline:
+    time.sleep(0.1)
+  assert publish.latest_step() is not None, (
+      "no job published within the deadline; events: "
+      f"{events.snapshot(recent=40)['events']}")
+  first_step = publish.latest_step()
+  scenes, info = scenes_from_checkpoint(str(root / "publish"), scenes=1,
+                                        stable_ids=True)
+  svc = RenderService(max_batch=4, max_wait_ms=0.5, use_mesh=False,
+                      resilience=None)
+  for sid, rgba, depths, k in scenes:
+    svc.add_scene(sid, rgba, depths, k)
+  scene_ids = [s[0] for s in scenes]
+
+  last_bake: list = []
+
+  def reload_step(step):
+    new_scenes, _ = scenes_from_checkpoint(str(root / "publish"), scenes=1,
+                                           stable_ids=True)
+    svc.swap_scenes({sid: (rgba, depths, k)
+                     for sid, rgba, depths, k in new_scenes}, prebake=True)
+    last_bake[:] = new_scenes
+
+  watcher = CheckpointWatcher(publish, reload_step, poll_s=0.2,
+                              initial_step=first_step).start()
+  stop = threading.Event()
+  failures: list = []
+  completed = [0]
+
+  def hammer():
+    i = 0
+    pose = np.eye(4, dtype=np.float32)
+    while not stop.is_set():
+      i += 1
+      pose[0, 3] = 0.001 * (i % 7)
+      try:
+        img = svc.render(scene_ids[0], pose, timeout=60)
+        assert img.shape[-1] == 3
+      except BaseException as e:  # noqa: BLE001 - ANY failure is the bug
+        failures.append(e)
+        return
+      completed[0] += 1
+      # Throttled: constant coverage across the swaps without starving
+      # the training subprocesses of the box's one core.
+      time.sleep(0.02)
+
+  threads = [threading.Thread(target=hammer, daemon=True)
+             for _ in range(1)]
+  for t in threads:
+    t.start()
+
+  while time.monotonic() < deadline:
+    with supervisor._lock:
+      busy = bool(supervisor._running)
+    if not busy and queue.drained():
+      break
+    time.sleep(0.1)
+  # Let the watcher observe the final publish under load, then wind down.
+  final_deadline = time.monotonic() + 10.0
+  while (watcher.seen_step != publish.latest_step()
+         and time.monotonic() < final_deadline):
+    time.sleep(0.1)
+  stop.set()
+  for t in threads:
+    t.join(30)
+  supervisor.stop()
+  watcher.stop()
+
+  yield {
+      "root": root, "queue": queue, "supervisor": supervisor,
+      "publish": publish, "events": events, "slo": slo, "svc": svc,
+      "watcher": watcher, "failures": failures,
+      "completed": completed[0], "scene_ids": scene_ids,
+      "first_step": first_step, "last_bake": last_bake,
+  }
+  svc.close()
+
+
+def test_queue_drained_with_poison_quarantined_at_exact_budget(arc):
+  queue = arc["queue"]
+  assert queue.drained(), queue.counts()
+  assert queue.get("good").state == "done"
+  assert queue.get("victim").state == "done"
+  poison = queue.get("poison")
+  assert poison.state == "quarantined", poison.record
+  # EXACTLY the budget: 1 first attempt + RESTART_BUDGET retries.
+  assert poison.attempts == 1 + RESTART_BUDGET
+  assert arc["supervisor"].quarantines_total == 1
+  assert arc["events"].count("training_job_quarantined") == 1
+  text = arc["supervisor"].metrics_text()
+  assert "mpi_train_queue_quarantines_total 1" in text
+
+
+def test_sigkilled_then_requeued_job_is_bit_exact(arc):
+  root = arc["root"]
+  victim = arc["queue"].get("victim")
+  # It really died by SIGKILL once and was requeued + resumed.
+  assert victim.attempts == 2
+  assert any(h["event"] == "requeued" for h in victim.record["history"])
+  assert victim.record["history"][-1]["event"] == "done"
+  base = _digest(str(root / "work" / "good" / "ckpt"))
+  resumed = _digest(str(root / "work" / "victim" / "ckpt"))
+  assert resumed == base, (
+      "SIGKILL-mid-job + requeue + resume diverged from the "
+      "uninterrupted sibling (same spec, same seed)")
+
+
+def test_completed_jobs_published_and_served_live_with_zero_drops(arc):
+  from mpi_vision_tpu.serve import RenderService
+
+  publish = arc["publish"]
+  # Both completed jobs published (monotone steps), quarantined one did
+  # not.
+  assert len(publish.steps()) == 2, publish.steps()
+  assert arc["supervisor"].publishes_total == 2
+  assert arc["supervisor"].publish_errors == 0
+  # The second publish was swapped in live by the watcher...
+  assert arc["watcher"].snapshot()["reloads"] >= 1
+  assert arc["watcher"].seen_step == publish.latest_step()
+  # ...with ZERO dropped requests under constant traffic.
+  assert not arc["failures"], f"renders failed: {arc['failures'][:3]}"
+  assert arc["completed"] > 0
+  # And the pixels now serving provably come from the NEWEST publish:
+  # the live service's render matches a service that only ever saw the
+  # final reload's bake.
+  got = arc["svc"].render(arc["scene_ids"][0],
+                          np.eye(4, dtype=np.float32))
+  assert arc["last_bake"], "watcher never delivered a reload bake"
+  with RenderService(max_batch=2, max_wait_ms=0.5, use_mesh=False,
+                     resilience=None) as fresh:
+    sid, rgba, depths, k = arc["last_bake"][0]
+    fresh.add_scene(sid, rgba, depths, k)
+    np.testing.assert_array_equal(got, fresh.render(
+        sid, np.eye(4, dtype=np.float32)))
+
+
+def test_queue_slos_scored_in_the_slo_engine(arc):
+  snap = arc["slo"].snapshot()
+  avail = snap["objectives"]["availability"]["slow"]
+  # EXACTLY the 5 attempt outcomes: good ok, poison bad x2, victim bad +
+  # ok. Step-latency samples score only the latency objective — they
+  # must not dilute the crash-loop out of the availability burn rate.
+  assert avail["requests"] == 5, avail
+  assert avail["bad"] == 3, avail
+  assert snap["objectives"]["latency"]["slow"]["bad"] == 0

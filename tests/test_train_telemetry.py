@@ -92,7 +92,12 @@ def test_snapshot_and_registry_agree():
                           "last_save_bytes": 1024}
   assert snap["nan_rollbacks"] == 1 and snap["preemptions"] == 1
   assert snap["restores"] == 1
-  assert snap["step_ms"]["p50"] == pytest.approx(20.0)
+  # Percentile-true off the native histogram: within one ~19%-wide
+  # exponential bucket of the (constant) 20 ms truth.
+  assert snap["step_ms"]["p50"] == pytest.approx(20.0, rel=0.1)
+  assert snap["step_ms"]["p99"] == pytest.approx(20.0, rel=0.15)
+  assert snap["step_latency_hist"]["count"] == 5
+  assert snap["save_latency_hist"]["count"] == 1
 
   families = parse_metrics_text(tm.registry(snap).render())
 
@@ -282,8 +287,11 @@ def test_metrics_server_scrapes_live_training_loop(tmp_path):
     stats = json.loads(_scrape(port, "/stats"))
     assert stats["steps"] == 1
     health = json.loads(_scrape(port, "/healthz"))
-    assert health == {"status": "ok", "role": "train", "steps": 1,
-                      "step": 1}
+    assert health["status"] == "ok" and health["role"] == "train"
+    assert health["steps"] == 1 and health["step"] == 1
+    # The queue supervisor reads both off one probe: the step counter
+    # for wedge detection, the step wall time for the latency SLO.
+    assert health["last_step_ms"] > 0
   finally:
     release.set()
     worker.join(120)
@@ -296,3 +304,34 @@ def test_metrics_server_scrapes_live_training_loop(tmp_path):
   events = json.loads(_scrape(port, "/debug/events"))
   assert events["by_kind"].get("ckpt_save", 0) == result["report"]["saves"]
   httpd.shutdown()
+
+
+def test_native_histogram_families_and_quantile_gauges():
+  """PR 12 satellite: step/save latencies ride obs/hist.NativeHistogram —
+  percentile-true p50/p99 in the snapshot and `/metrics`, exact-merge
+  bucket families next to the classic counters."""
+  from mpi_vision_tpu.obs import hist as hist_mod
+
+  tm = TrainMetrics(clock=FakeClock())
+  for wall in (0.01, 0.01, 0.01, 0.01, 0.5):  # one slow tail step
+    tm.record_step(1, loss=0.1, wall_s=wall)
+  tm.record_save(5, seconds=0.2, nbytes=10)
+  snap = tm.snapshot()
+  assert snap["step_ms"]["p50"] == pytest.approx(10.0, rel=0.1)
+  assert snap["step_ms"]["p99"] == pytest.approx(500.0, rel=0.15)
+  text = tm.registry(snap).render()
+  families = parse_metrics_text(text)
+  hist = families["mpi_train_step_latency_nativehist"]
+  count = hist["samples"][("mpi_train_step_latency_nativehist_count", ())]
+  assert count == 5
+  assert ("mpi_train_ckpt_save_latency_nativehist_count", ()) in \
+      families["mpi_train_ckpt_save_latency_nativehist"]["samples"]
+  q = families["mpi_train_step_quantile_seconds"]["samples"]
+  p99 = q[("mpi_train_step_quantile_seconds", (("q", "0.99"),))]
+  assert p99 == pytest.approx(0.5, rel=0.15)
+  # The gauge agrees with the snapshot's own quantile (one source).
+  assert p99 * 1e3 == pytest.approx(snap["step_ms"]["p99"], rel=1e-6)
+  # Exposition snapshots merge exactly across trainers (pool semantics).
+  merged = hist_mod.merge([snap["step_latency_hist"],
+                           snap["step_latency_hist"]])
+  assert merged.count == 10

@@ -47,6 +47,18 @@ killed every time it comes back up until its ``--restart-budget``
 quarantines it; the JSON then records restarts, containment (the
 quarantine), and post-quarantine throughput.
 
+``--tiled-ab`` measures the tile-granular serving path
+(``serve/tiles.py``): the SAME closed-loop load over ONE high-res
+depth-stratified scene, once through a tiled service (fixed tile grid,
+frustum-culled crops, content-culled planes) and once through the
+monolithic path, in one process. The pose pool pans/tilts a narrow-FOV
+camera across the scene so frusta touch a *fraction* of the tiles —
+the Tiled-MPI serving shape — and the JSON line carries both arms, the
+p50/throughput ratios, the tiles touched/culled accounting, and the
+PINNED parity block: the full-coverage (identity) pose must render
+bit-exactly equal in both arms or the run aborts. ``--tiled-ab --dry``
+is the tier-1 smoke.
+
 ``--inflight N`` sets the streaming-pipeline window (concurrent
 in-flight batches; 1 = the legacy blocking dispatch) and the JSON gains
 the pipeline accounting: ``dispatch_gap`` (device idle between
@@ -110,6 +122,25 @@ def build_parser() -> argparse.ArgumentParser:
                   help="edge view-cell translation pitch (--edge/"
                        "--edge-ab); the bench default is finer than the "
                        "serve default so warps show next to exact hits")
+  ap.add_argument("--tiled-ab", action="store_true",
+                  help="run the load twice — tile-granular service "
+                       "(frustum-culled crops) vs monolithic — over one "
+                       "high-res depth-stratified scene with a panning "
+                       "narrow-FOV pose pool, and emit one "
+                       "serve_load_tiled_ab JSON line with both arms, "
+                       "the tile accounting, and the pinned bit-exact "
+                       "full-coverage parity check")
+  ap.add_argument("--tile-size", type=int, default=64,
+                  help="tile edge in pixels for the tiled arm "
+                       "(--tiled-ab; dry mode shrinks it with the scene)")
+  ap.add_argument("--tiled-regions", type=int, default=4,
+                  help="depth-staircase regions per scene axis "
+                       "(--tiled-ab; see synthetic_tiled_scene)")
+  ap.add_argument("--fov-scale", type=float, default=2.0,
+                  help="target-camera focal length as a multiple of the "
+                       "scene width (--tiled-ab): > 1 narrows the FOV so "
+                       "pan/tilt poses view a fraction of the scene — "
+                       "the frustum-culling workload")
   ap.add_argument("--zipf-poses", type=int, default=0,
                   help="draw poses Zipf-distributed from a pool of this "
                        "many fixed poses (rank r with p ~ 1/r^s) instead "
@@ -644,6 +675,210 @@ def inprocess_run(args, inflight: int, edge: bool = False) -> dict:
   return record
 
 
+def look_pose(pan_rad: float, tilt_rad: float) -> np.ndarray:
+  """A pure-rotation 'look' pose: pan about y, then tilt about x.
+
+  Rotation is depth-independent (K R K^-1 — no parallax), so a pan of
+  θ shifts every plane's taps by ~fx·tanθ: with a narrow FOV the
+  frustum walks clean off parts of the scene, which is exactly the
+  fraction-of-tiles-touched workload the tiled path exists for.
+  """
+  import math
+
+  c, s = math.cos(pan_rad), math.sin(pan_rad)
+  ry = np.array([[c, 0, s], [0, 1, 0], [-s, 0, c]], np.float32)
+  c2, s2 = math.cos(tilt_rad), math.sin(tilt_rad)
+  rx = np.array([[1, 0, 0], [0, c2, -s2], [0, s2, c2]], np.float32)
+  pose = np.eye(4, dtype=np.float32)
+  pose[:3, :3] = ry @ rx
+  return pose
+
+
+# The --tiled-ab pose pool (pan, tilt) in radians, tuned for the
+# default --fov-scale 2.0 (half-FOV ~14 deg): index 0 is the pinned
+# full-coverage pose; the rest touch decreasing tile fractions, down to
+# a corner view. Zipf-ranked below so the traffic shape has a hot
+# partially-culled view plus a tail — like a viewer orbiting a room.
+_TILED_POOL = ((0.0, 0.0), (0.25, 0.0), (-0.25, 0.15), (0.35, 0.2),
+               (-0.3, -0.25), (0.15, -0.1), (0.45, 0.35), (0.0, 0.3))
+
+
+def tiled_run(args, tile: "int | None") -> tuple[dict, dict]:
+  """One measured closed-loop window over the depth-stratified scene —
+  tiled service when ``tile`` is an int, monolithic when None. Returns
+  ``(record, parity_frames)`` where ``parity_frames`` maps pool index
+  -> rendered frame for the cross-arm parity checks."""
+  from mpi_vision_tpu.core import camera
+  from mpi_vision_tpu.obs import slo as slo_mod
+  from mpi_vision_tpu.serve import RenderService
+  from mpi_vision_tpu.serve.server import synthetic_tiled_scene
+
+  use_mesh = {"auto": None, "on": True, "off": False}[args.sharded]
+  layers, depths, k = synthetic_tiled_scene(
+      "tiled_scene", height=args.img_size, width=args.img_size,
+      planes=args.num_planes, regions=args.tiled_regions, seed=args.seed)
+  if args.fov_scale != 1.0:
+    fx = args.fov_scale * args.img_size
+    k = np.asarray(camera.intrinsics_matrix(
+        fx, fx, args.img_size / 2.0, args.img_size / 2.0), np.float32)
+  svc = RenderService(
+      cache_bytes=args.cache_mb << 20, max_batch=args.max_batch,
+      max_wait_ms=args.max_wait_ms, max_inflight=args.inflight,
+      method=args.method, use_mesh=use_mesh, tile=tile,
+      slo=slo_window_config(args.duration))
+  svc.add_scene("tiled_scene", layers, depths, k)
+  arm = f"tiled (tile {tile})" if tile is not None else "monolithic"
+  _log(f"serve_load: tiled-ab arm [{arm}] — scene "
+       f"{args.img_size}x{args.img_size}x{args.num_planes}, "
+       f"fov-scale {args.fov_scale}, engine {svc.engine.describe()}")
+
+  # Dry mode (the tier-1 smoke) halves the pose pool and skips the warm
+  # burst: the smoke pins the contract, not the speedup, and tier-1
+  # seconds are the scarce resource.
+  tiled_pool = _TILED_POOL[:4] if args.dry else _TILED_POOL
+  pool = [look_pose(p, t) for p, t in tiled_pool]
+  weights = 1.0 / np.arange(1, len(pool) + 1, dtype=np.float64) ** 1.1
+  # Hot rank = the half-coverage pan (index 1); the pinned full-coverage
+  # pose rides in the tail so both arms keep compiling/serving it.
+  order = [o for o in (1, 3, 2, 5, 4, 0, 7, 6) if o < len(pool)]
+  cumulative = np.cumsum(weights / weights.sum())
+
+  svc.warmup()
+  # Compile pass: every pool signature once (bucket 1), then an
+  # unmeasured burst of the closed loop so the hot signatures' larger
+  # batch buckets compile outside the measured window.
+  parity_frames = {i: svc.render("tiled_scene", pool[i], timeout=600)
+                   for i in range(len(pool))}
+  if not args.dry:
+    warm_stop = threading.Event()
+
+    def warm_worker(idx: int) -> None:
+      rng = np.random.default_rng([args.seed, 99, idx])
+      while not warm_stop.is_set():
+        pose = pool[order[int(np.searchsorted(cumulative, rng.random()))]]
+        svc.render("tiled_scene", pose, timeout=600)
+
+    warm_threads = [threading.Thread(target=warm_worker, args=(i,),
+                                     daemon=True)
+                    for i in range(args.concurrency)]
+    for t in warm_threads:
+      t.start()
+    time.sleep(min(args.duration / 2.0, 4.0))
+    warm_stop.set()
+    for t in warm_threads:
+      t.join(60)
+  svc.metrics.reset()
+  svc.scheduler.reset_gap_clock()
+  _log(f"serve_load: tiled-ab arm [{arm}] warm; measuring "
+       f"{args.duration:g}s")
+
+  stop = threading.Event()
+  errors: list[Exception] = []
+  counts = [0] * args.concurrency
+
+  def worker(idx: int) -> None:
+    rng = np.random.default_rng(args.seed + 1 + idx)
+    while not stop.is_set():
+      pose = pool[order[int(np.searchsorted(cumulative, rng.random()))]]
+      try:
+        svc.render("tiled_scene", pose, timeout=600)
+      except Exception as e:  # noqa: BLE001 - clean arms: abort on failure
+        errors.append(e)
+        return
+      counts[idx] += 1
+
+  threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+             for i in range(args.concurrency)]
+  t0 = time.perf_counter()
+  for t in threads:
+    t.start()
+  time.sleep(args.duration)
+  stop.set()
+  for t in threads:
+    t.join(60)
+  elapsed = time.perf_counter() - t0
+  stats = svc.stats()
+  svc.close()
+  if errors:
+    raise SystemExit(f"serve_load: tiled-ab worker failed: {errors[0]!r}")
+  total = sum(counts)
+  if total == 0:
+    raise SystemExit("serve_load: no requests completed in the window")
+  lat = stats["latency_ms"] or {}
+  rps = total / elapsed
+  record = {
+      "arm": "tiled" if tile is not None else "full",
+      "renders_per_sec": round(rps, 3),
+      "p50_ms": lat.get("p50"),
+      "p99_ms": lat.get("p99"),
+      "requests": total,
+      "batches": stats["batches"],
+      "mean_batch_size": stats["mean_batch_size"],
+      "device": stats["engine"]["platform"],
+      "slo": slo_mod.verdict(stats.get("slo")),
+  }
+  if tile is not None:
+    record["tiles"] = stats["tiles"]
+    record["tile_cache"] = stats["tile_cache"]
+  return record, parity_frames
+
+
+def tiled_ab_main(args) -> int:
+  """The tiled-vs-monolithic A/B: one depth-stratified scene, one
+  panning narrow-FOV pose pool, two measured arms in one process. The
+  parity block is PINNED: the full-coverage pose (identity — every tile
+  touched, every plane kept) must render bit-exactly equal through both
+  paths, or the run aborts; culled poses report their max abs pixel
+  difference (conservative frustum + zero-padded sampling keep it at
+  float-rounding scale)."""
+  tiled, tiled_frames = tiled_run(args, args.tile_size)
+  full, full_frames = tiled_run(args, None)
+  bit_exact = bool(np.array_equal(tiled_frames[0], full_frames[0]))
+  culled_diff = max(
+      float(np.abs(tiled_frames[i] - full_frames[i]).max())
+      for i in range(1, len(tiled_frames)))
+  if not bit_exact:
+    raise SystemExit(
+        "serve_load: PINNED parity failure — the full-coverage pose "
+        "rendered differently through the tiled path (max abs diff "
+        f"{float(np.abs(tiled_frames[0] - full_frames[0]).max()):g})")
+  tiles = tiled.get("tiles") or {}
+  total = tiles.get("tiled_requests") or 0
+  speedup = (full["p50_ms"] / tiled["p50_ms"]
+             if tiled["p50_ms"] and full["p50_ms"] else None)
+  record = {
+      "metric": "serve_load_tiled_ab",
+      "value": round(speedup, 4) if speedup is not None else None,
+      "unit": "x_p50_full_over_tiled",
+      "p50_ms_tiled": tiled["p50_ms"],
+      "p50_ms_full": full["p50_ms"],
+      "throughput_x": (round(tiled["renders_per_sec"]
+                             / full["renders_per_sec"], 4)
+                       if full["renders_per_sec"] else None),
+      "tile": args.tile_size,
+      "tiles_total": (-(-args.img_size // args.tile_size)) ** 2,
+      "parity": {
+          "full_coverage_bit_exact": bit_exact,
+          "culled_pose_max_abs_diff": culled_diff,
+      },
+      "tiles_touched_mean": tiles.get("mean_touched"),
+      "tiles_culled_frac": (round(
+          tiles.get("culled_total", 0)
+          / max((tiles.get("culled_total", 0)
+                 + tiles.get("rendered_total", 0)), 1), 4)
+          if total else None),
+      "fov_scale": args.fov_scale,
+      "img_size": args.img_size,
+      "num_planes": args.num_planes,
+      "tiled": tiled,
+      "full": full,
+      "device": tiled["device"],
+      "dry": bool(args.dry),
+  }
+  print(json.dumps(record))
+  return 0
+
+
 def ab_main(args) -> int:
   """The pipelined-vs-blocking A/B: the same closed-loop load, once at
   ``--inflight`` and once at window 1 (the legacy blocking dispatch), in
@@ -730,8 +965,17 @@ def main(argv=None) -> int:
     args.img_size = min(args.img_size, 32)
     args.num_planes = min(args.num_planes, 4)
     args.cluster_backends = min(args.cluster_backends, 3)
+    args.tile_size = min(args.tile_size, max(8, args.img_size // 4))
   if args.inflight < 1:
     raise SystemExit(f"--inflight must be >= 1, got {args.inflight}")
+  if args.tile_size < 8:
+    raise SystemExit(f"--tile-size must be >= 8, got {args.tile_size}")
+  if args.tiled_ab:
+    if args.chaos or args.ab or args.edge_ab or args.cluster or args.edge:
+      raise SystemExit("--tiled-ab compares clean in-process arms; it "
+                       "does not combine with --chaos/--ab/--edge-ab/"
+                       "--edge/--cluster")
+    return tiled_ab_main(args)
   if args.chaos_crashloop and not args.cluster:
     raise SystemExit("--chaos-crashloop drills the multi-host tier; "
                      "add --cluster")

@@ -482,6 +482,16 @@ def cmd_serve(args: argparse.Namespace) -> dict:
         ("--ship-spool-mb", args.ship_spool_mb is not None)) if on]
     if wants_ship:
       raise SystemExit(f"{', '.join(wants_ship)} require(s) --ship-url")
+  if not args.tiled:
+    # Tile knobs only act through the tiled registry; silently serving
+    # monolithic scenes would drop the frustum culling / per-tile cache
+    # granularity the operator asked for.
+    wants_tiled = [flag for flag, on in (
+        ("--tile-size", args.tile_size is not None),) if on]
+    if wants_tiled:
+      raise SystemExit(f"{', '.join(wants_tiled)} require(s) --tiled")
+  if args.tile_size is not None and args.tile_size < 8:
+    raise SystemExit(f"--tile-size must be >= 8, got {args.tile_size}")
   if not args.edge_cache:
     # Edge knobs only act through the edge cache; silently ignoring them
     # would drop the fidelity/budget bounds the user asked for.
@@ -629,10 +639,18 @@ def cmd_serve(args: argparse.Namespace) -> dict:
       # service, never fatal.
       subprocess.run([*_argv, json.dumps(record)], check=True, timeout=60)
 
+  convention = None
+  if args.convention == "exact":
+    from mpi_vision_tpu.core.sampling import Convention
+
+    convention = Convention.EXACT
   svc = RenderService(
       cache_bytes=args.cache_mb << 20, max_batch=args.max_batch,
       max_wait_ms=args.max_wait_ms, max_inflight=max_inflight,
       max_inflight_cap=args.max_inflight_cap,
+      tile=((args.tile_size if args.tile_size is not None else 64)
+            if args.tiled else None),
+      convention=convention,
       method=args.method, use_mesh=use_mesh, edge=edge,
       max_queue=args.max_queue, resilience=resilience,
       cpu_fallback=args.cpu_fallback, tracer=tracer,
@@ -842,6 +860,14 @@ def cmd_train_queue(args: argparse.Namespace) -> dict:
         f"--startup-grace-s must be >= 0, got {args.startup_grace_s}")
   if args.publish_keep < 1:
     raise SystemExit(f"--publish-keep must be >= 1, got {args.publish_keep}")
+  if args.metrics_port is not None and args.metrics_port < 0:
+    raise SystemExit(
+        f"--metrics-port must be >= 0 (0 = ephemeral), got "
+        f"{args.metrics_port}")
+  if args.metrics_port_file and args.metrics_port is None:
+    # The port file only acts through the listener; the usual
+    # dangling-flag guard.
+    raise SystemExit("--metrics-port-file requires --metrics-port")
   if not args.slo:
     # SLO knobs only act through the tracker; silently dropping the
     # objectives the operator asked for is the dangling-flag failure
@@ -919,6 +945,24 @@ def cmd_train_queue(args: argparse.Namespace) -> dict:
        f"wedge after {args.wedge_after} stalled probes"
        + (f"; publishing to {args.publish}" if args.publish else "") + ")")
 
+  metrics_httpd = None
+  metrics_port = None
+  if args.metrics_port is not None:
+    from mpi_vision_tpu.train.supervisor import make_queue_metrics_server
+
+    # The queue's own scrape surface (the serve endpoints an operator
+    # already knows): /metrics renders the mpi_train_queue_* registry,
+    # /stats the snapshot, /healthz the drain/quarantine headline.
+    metrics_httpd = make_queue_metrics_server(
+        supervisor, events=events, host="127.0.0.1", port=args.metrics_port)
+    metrics_port = metrics_httpd.server_address[1]
+    if args.metrics_port_file:
+      _write_port_file(args.metrics_port_file, metrics_port)
+    threading.Thread(target=metrics_httpd.serve_forever,
+                     name="train-queue-metrics", daemon=True).start()
+    _log(f"train-queue: metrics on http://127.0.0.1:{metrics_port} "
+         "(/metrics /stats /healthz /debug/events)")
+
   stop_event = threading.Event()
 
   def _on_signal(signum, frame):  # noqa: ARG001 - stdlib signature
@@ -954,6 +998,9 @@ def cmd_train_queue(args: argparse.Namespace) -> dict:
     # train CLI saves a preempt checkpoint) and requeued with no budget
     # spent, so the next supervisor resumes them bit-exactly.
     supervisor.stop(preempt=True)
+    if metrics_httpd is not None:
+      metrics_httpd.shutdown()
+      metrics_httpd.server_close()
     for sig, handler in previous_handlers.items():
       signal.signal(sig, handler)
     _log("train-queue: stopped; running jobs preempted back to the queue")
@@ -975,6 +1022,8 @@ def cmd_train_queue(args: argparse.Namespace) -> dict:
       "publish_errors": snap["publish_errors"],
       "spec_rejects": snap["spec_rejects"],
       "events_emitted": events.emitted,
+      **({"metrics_port": metrics_port} if metrics_port is not None
+         else {}),
       **({"drained": drained} if drained is not None else {}),
   }
   if slo is not None:
@@ -982,6 +1031,53 @@ def cmd_train_queue(args: argparse.Namespace) -> dict:
 
     out["slo"] = verdict(slo.snapshot())
   return out
+
+
+def cmd_ship_sink(args: argparse.Namespace) -> dict:
+  import signal
+  import threading
+
+  if args.port < 0:
+    raise SystemExit(f"--port must be >= 0 (0 = ephemeral), got {args.port}")
+
+  from mpi_vision_tpu.obs.ship import make_sink_server
+
+  server, sink = make_sink_server(os.path.abspath(args.dir),
+                                  host="127.0.0.1", port=args.port)
+  port = server.server_address[1]
+  if args.port_file:
+    _write_port_file(args.port_file, port)
+  threading.Thread(target=server.serve_forever, name="ship-sink",
+                   daemon=True).start()
+  _log(f"ship-sink: collecting on http://127.0.0.1:{port} -> {args.dir} "
+       "(POST batches; /healthz /stats)")
+
+  stop_event = threading.Event()
+
+  def _on_signal(signum, frame):  # noqa: ARG001 - stdlib signature
+    stop_event.set()
+
+  previous_handlers = {}
+  for sig in (signal.SIGTERM, signal.SIGINT):
+    try:
+      previous_handlers[sig] = signal.signal(sig, _on_signal)
+    except (ValueError, OSError):
+      pass
+  t0 = time.time()
+  try:
+    stop_event.wait(args.duration if args.duration > 0 else None)
+  finally:
+    server.shutdown()
+    server.server_close()
+    for sig, handler in previous_handlers.items():
+      signal.signal(sig, handler)
+    _log("ship-sink: stopped")
+  return {
+      "command": "ship-sink",
+      "port": port,
+      "seconds": round(time.time() - t0, 1),
+      **sink.stats(),
+  }
 
 
 def cmd_cluster(args: argparse.Namespace) -> dict:
@@ -1018,6 +1114,14 @@ def cmd_cluster(args: argparse.Namespace) -> dict:
     raise SystemExit(f"--wedge-after must be >= 1, got {args.wedge_after}")
   if args.tsdb_points is not None and args.tsdb_interval_s <= 0:
     raise SystemExit("--tsdb-points requires --tsdb-interval-s > 0")
+  if args.route_cell < 0:
+    raise SystemExit(f"--route-cell must be >= 0, got {args.route_cell}")
+  if args.route_rot_bucket_deg is not None and args.route_cell <= 0:
+    # The rotation bucket only acts through cell routing.
+    raise SystemExit("--route-rot-bucket-deg requires --route-cell > 0")
+  if args.route_rot_bucket_deg is not None and args.route_rot_bucket_deg <= 0:
+    raise SystemExit(
+        f"--route-rot-bucket-deg must be > 0, got {args.route_rot_bucket_deg}")
 
   pool = None
   supervisor = None
@@ -1059,6 +1163,10 @@ def cmd_cluster(args: argparse.Namespace) -> dict:
         health_timeout_s=args.health_timeout_s,
         retry_budget_ratio=args.retry_budget,
         load_aware=args.load_aware, tsdb=router_tsdb,
+        route_cell=args.route_cell,
+        route_rot_bucket_deg=(args.route_rot_bucket_deg
+                              if args.route_rot_bucket_deg is not None
+                              else 10.0),
         metrics_ttl_s=args.metrics_ttl_ms / 1e3, tracer=tracer)
     if args.supervise or args.rolling_restart:
       # Lifecycle decisions share the router's event log so one
@@ -1316,6 +1424,23 @@ def build_parser() -> argparse.ArgumentParser:
   s.add_argument("--method", default="fused",
                  choices=("fused", "scan", "assoc"),
                  help="per-view render method (core/render.py)")
+  s.add_argument("--tiled", action=argparse.BooleanOptionalAction,
+                 default=False,
+                 help="tile-granular scenes (serve/tiles.py): split every "
+                      "scene into a fixed tile grid, render only the "
+                      "frustum-touched crop with content-free planes "
+                      "culled (bit-exact to the monolithic render when "
+                      "the frustum covers all tiles), cache/evict baked "
+                      "data per tile, and live-reload only tiles whose "
+                      "digests changed")
+  s.add_argument("--tile-size", type=int, default=None,
+                 help="tile edge in pixels (default 64); requires --tiled")
+  s.add_argument("--convention", default="ref", choices=("ref", "exact"),
+                 help="sampling convention: 'ref' reproduces the "
+                      "reference exactly (its axis swap is benign on "
+                      "square frames only); 'exact' is correct for "
+                      "non-square scenes — recommended for --tiled "
+                      "room-scale panoramas")
   s.add_argument("--sharded", default="auto", choices=("auto", "on", "off"),
                  help="shard view batches over the device mesh "
                       "(auto: when >1 device is visible)")
@@ -1551,6 +1676,14 @@ def build_parser() -> argparse.ArgumentParser:
                  help="append one JSON line per queue lifecycle event "
                       "(submitted/leased/started/done/requeued/wedged/"
                       "quarantined/published) to this file")
+  q.add_argument("--metrics-port", type=int, default=None,
+                 help="expose the supervisor's mpi_train_queue_* "
+                      "registry on this localhost port (/metrics, "
+                      "/stats, /healthz, /debug/events; 0 = ephemeral "
+                      "— see --metrics-port-file)")
+  q.add_argument("--metrics-port-file", default="",
+                 help="write the bound metrics port here (atomic "
+                      "rename); requires --metrics-port")
   q.add_argument("--slo", action=argparse.BooleanOptionalAction,
                  default=True,
                  help="track training-queue SLOs in the obs/slo.py "
@@ -1563,6 +1696,25 @@ def build_parser() -> argparse.ArgumentParser:
                  help="step-latency objective threshold (default 60000); "
                       "requires SLO tracking")
   q.set_defaults(fn=cmd_train_queue)
+
+  k = sub.add_parser(
+      "ship-sink",
+      help="run the telemetry collector (obs/ship.py receiver): a "
+           "stdlib HTTP listener accepting the shipper's POSTed JSON "
+           "batches and writing each durably into a directory — point "
+           "a serve --ship-url backend at it and the off-host leg runs "
+           "end to end with no external collector")
+  k.add_argument("--dir", required=True,
+                 help="batch directory (one batch-NNNNNNNN.json per "
+                      "delivered batch, atomic rename; numbering "
+                      "resumes over an existing directory)")
+  k.add_argument("--port", type=int, default=0,
+                 help="listen port (0 = ephemeral — see --port-file)")
+  k.add_argument("--port-file", default="",
+                 help="write the bound port here (atomic rename)")
+  k.add_argument("--duration", type=float, default=0.0,
+                 help="seconds to run; <= 0 runs until interrupted")
+  k.set_defaults(fn=cmd_ship_sink)
 
   c = sub.add_parser(
       "cluster",
@@ -1646,6 +1798,18 @@ def build_parser() -> argparse.ArgumentParser:
                       "keep serving; implies the --supervise monitor "
                       "loop (a failed step's backend must be retried); "
                       "requires --backends")
+  c.add_argument("--route-cell", type=float, default=0.0,
+                 help="view-cell translation pitch for tile-granular "
+                      "routing: quantize each request's pose and place "
+                      "it by its (scene, cell) ring key, spreading a hot "
+                      "scene over many backends while giving every cell "
+                      "a deterministic home whose edge/tile caches stay "
+                      "warm (reroutes counted in "
+                      "mpi_cluster_cell_reroutes_total); <= 0 keeps "
+                      "scene-level placement")
+  c.add_argument("--route-rot-bucket-deg", type=float, default=None,
+                 help="view-cell rotation pitch in degrees (default 10); "
+                      "requires --route-cell > 0")
   c.add_argument("--retry-budget", type=float, default=0.1,
                  help="failover tokens earned per routed request "
                       "(token-bucket retry budget: a fleet brownout "

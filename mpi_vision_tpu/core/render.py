@@ -66,15 +66,24 @@ def warp_coordinates(
     height: int,
     width: int,
     convention: Convention = Convention.REF_HOMOGRAPHY,
+    src_height: int | None = None,
+    src_width: int | None = None,
 ) -> jnp.ndarray:
   """Normalized (0, 1) source-sampling coords for a target grid.
 
   ``homographies``: ``[..., 3, 3]`` -> coords ``[..., H, W, 2]``.
+
+  ``src_height``/``src_width`` decouple the *sampled* image's dims from
+  the target grid's (tile-cropped sources, serve/tiles.py): the grid
+  spans the target frame, the normalization spans the source. Defaults
+  keep the historical target==source behavior bit-exactly.
   """
   grid = jnp.moveaxis(geometry.homogeneous_grid(height, width), 0, -1)  # [H,W,3]
   pts = geometry.apply_homography(grid, homographies)
   xy = geometry.from_homogeneous(pts)
-  return sampling.normalize_pixel_coords(xy, height, width, convention)
+  return sampling.normalize_pixel_coords(
+      xy, height if src_height is None else src_height,
+      width if src_width is None else src_width, convention)
 
 
 def warp_planes(
@@ -101,6 +110,8 @@ def render_views(
     intrinsics: jnp.ndarray,
     convention: Convention = Convention.REF_HOMOGRAPHY,
     method: str = "fused",
+    tgt_intrinsics: jnp.ndarray | None = None,
+    out_hw: tuple[int, int] | None = None,
     **render_kwargs,
 ) -> jnp.ndarray:
   """Render a batch of V target views of ONE scene.
@@ -112,12 +123,21 @@ def render_views(
   with batch = V, so a V-view batch is element-for-element the same
   computation as V single renders (micro-batched serving relies on that
   to return bit-identical images whatever batch a request lands in).
+
+  ``tgt_intrinsics``/``out_hw`` support tile-cropped sources
+  (serve/tiles.py): the MPI may be a crop of the scene (with the crop
+  correction folded into ``intrinsics``) while the rendered frame keeps
+  the full target geometry. Defaults preserve the historical
+  source==target behavior bit-exactly.
   """
   v = tgt_poses.shape[0]
   planes = jnp.broadcast_to(rgba_layers[None], (v,) + rgba_layers.shape)
   k = jnp.broadcast_to(jnp.asarray(intrinsics)[None], (v, 3, 3))
+  k_t = (None if tgt_intrinsics is None else
+         jnp.broadcast_to(jnp.asarray(tgt_intrinsics)[None], (v, 3, 3)))
   return render_mpi(planes, tgt_poses, depths, k, convention=convention,
-                    method=method, **render_kwargs)
+                    method=method, tgt_intrinsics=k_t, out_hw=out_hw,
+                    **render_kwargs)
 
 
 def render_mpi(
@@ -132,6 +152,8 @@ def render_mpi(
     check: bool = True,
     plan: tuple[int, int] | int | None | object = _PLAN_UNSET,
     adj_plan: tuple | None | object = _PLAN_UNSET,
+    tgt_intrinsics: jnp.ndarray | None = None,
+    out_hw: tuple[int, int] | None = None,
 ) -> jnp.ndarray:
   """Render a novel view from an MPI. The reference's ``mpi_render_view_torch``.
 
@@ -165,16 +187,29 @@ def render_mpi(
     adj_plan: for 'fused_pallas' with ``check=False`` — the ``plan_fused``
       backward plan, enabling the Pallas backward for jitted callers
       (None keeps the XLA backward — correct, slower).
+    tgt_intrinsics: optional ``[B, 3, 3]`` target intrinsics (defaults to
+      the source's, as in the reference). Tile-cropped sources
+      (serve/tiles.py) pass the crop-corrected source intrinsics in
+      ``intrinsics`` and the original camera here.
+    out_hw: optional ``(H_t, W_t)`` rendered-frame dims when they differ
+      from the MPI's (cropped sources); default renders at the MPI's own
+      dims — the historical behavior, bit-exact.
 
   Returns:
-    ``[B, H, W, 3]`` rendered view.
+    ``[B, H_t, W_t, 3]`` rendered view (``H_t, W_t`` default to the
+    MPI's dims).
 
   Reference: utils.py:267-294.
   """
   planes = rgba_layers if planes_leading else jnp.moveaxis(rgba_layers, 3, 0)
   _, _, h, w, _ = planes.shape
+  th, tw = (h, w) if out_hw is None else (int(out_hw[0]), int(out_hw[1]))
 
   if method == "fused_pallas":
+    if tgt_intrinsics is not None or out_hw is not None:
+      raise ValueError(
+          "method='fused_pallas' does not support tgt_intrinsics/out_hw "
+          "(tile-cropped sources); use an XLA method ('fused'/'scan').")
     from mpi_vision_tpu.kernels import render_pallas
     homs = render_pallas.pixel_homographies(
         tgt_pose, depths, intrinsics, h, w, convention)    # [P, B, 3, 3]
@@ -196,17 +231,20 @@ def render_mpi(
     return jnp.moveaxis(out, 1, -1)
 
   with jax.named_scope("render/homographies"):
-    homs = plane_homographies(tgt_pose, depths, intrinsics)  # [P, B, 3, 3]
+    homs = plane_homographies(tgt_pose, depths, intrinsics,
+                              tgt_intrinsics=tgt_intrinsics)  # [P, B, 3, 3]
 
   if method != "fused":
     with jax.named_scope("render/warp"):
-      coords = warp_coordinates(homs, h, w, convention)
+      coords = warp_coordinates(homs, th, tw, convention,
+                                src_height=h, src_width=w)
       warped = sampling.bilinear_sample(planes, coords)
     with jax.named_scope("render/composite"):
       return compose.over_composite(warped, method=method)
 
   def warp_one(plane, hom):
-    coords = warp_coordinates(hom, h, w, convention)
+    coords = warp_coordinates(hom, th, tw, convention,
+                              src_height=h, src_width=w)
     return sampling.bilinear_sample(plane, coords)
 
   with jax.named_scope("render/warp_composite_scan"):

@@ -306,6 +306,37 @@ def serve_registry(stats: dict,
             "edge cache.", edge.get("bytes", 0))
   reg.gauge(p + "edge_frames", "Rendered frames resident in the edge "
             "cache.", edge.get("frames", 0))
+  # Tile-granular serving (serve/tiles.py): frustum-cull outcomes + the
+  # per-tile baked cache. Always exposed (zeros while --tiled is off).
+  tiles = stats.get("tiles") or {}
+  reg.counter(p + "tile_requests_total",
+              "Requests rendered through a tile plan (frustum-culled "
+              "crop of a tiled scene).", tiles.get("tiled_requests", 0))
+  reg.counter(p + "tile_touched_total",
+              "Source tiles the request frusta could sample.",
+              tiles.get("touched_total", 0))
+  reg.counter(p + "tile_rendered_total",
+              "Source tiles inside the dispatched crops.",
+              tiles.get("rendered_total", 0))
+  reg.counter(p + "tile_culled_total",
+              "Source tiles skipped by frustum culling.",
+              tiles.get("culled_total", 0))
+  tcache = stats.get("tile_cache") or {}
+  reg.counter(p + "tile_cache_hits_total", "Baked-tile cache hits.",
+              tcache.get("hits", 0))
+  reg.counter(p + "tile_cache_misses_total",
+              "Baked-tile cache misses (per-tile bakes).",
+              tcache.get("misses", 0))
+  reg.counter(p + "tile_cache_evictions_total",
+              "Baked-tile LRU evictions (cold tiles freed while hot "
+              "tiles stay).", tcache.get("evictions", 0))
+  reg.counter(p + "tile_cache_invalidations_total",
+              "Baked tiles dropped because their bytes changed (live "
+              "reload swaps ONLY these).", tcache.get("invalidations", 0))
+  reg.gauge(p + "tile_cache_bytes", "Bytes of baked tiles resident.",
+            tcache.get("bytes", 0))
+  reg.gauge(p + "tile_cache_tiles", "Baked tiles resident.",
+            tcache.get("scenes", 0))
   cache = stats.get("cache") or {}
   reg.counter(p + "cache_hits_total", "Scene-cache hits.",
               cache.get("hits", 0))

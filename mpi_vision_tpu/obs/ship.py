@@ -29,15 +29,18 @@ file); tests drive ``tick()`` directly against a fake sink.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import http.client
 import json
 import os
 import random
+import re
 import threading
 import time
 import urllib.error
 import urllib.request
 from collections import deque
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from mpi_vision_tpu.obs import prom
 from mpi_vision_tpu.serve.resilience import RetryPolicy
@@ -567,3 +570,133 @@ def registry(stats: dict | None) -> prom.Registry:
   reg.gauge(p + "spool_files", "Batches waiting in the disk spool.",
             stats.get("spool_files", 0))
   return reg
+
+
+class _SinkHandler(BaseHTTPRequestHandler):
+  """The collector side of the shipping contract: accept one POSTed
+  JSON batch, durably write it to the sink directory (temp file +
+  atomic rename, the repo-wide publish idiom), and only then answer
+  2xx — the shipper deletes segments on 2xx, so an early OK would be
+  the one way this pipeline could lose telemetry."""
+
+  def __init__(self, sink: "ShipSink", *args, **kwargs):
+    self.sink = sink
+    super().__init__(*args, **kwargs)
+
+  def log_message(self, fmt, *args):  # noqa: A003 - stdlib signature
+    pass
+
+  def _send(self, body: bytes, status: int = 200) -> None:
+    try:
+      self.send_response(status)
+      self.send_header("Content-Type", "application/json")
+      self.send_header("Content-Length", str(len(body)))
+      self.end_headers()
+      self.wfile.write(body)
+    except (BrokenPipeError, ConnectionResetError):
+      self.close_connection = True
+
+  def do_GET(self):  # noqa: N802 - stdlib name
+    if self.path == "/healthz":
+      self._send(json.dumps({"status": "ok", "role": "ship-sink",
+                             **self.sink.stats()}).encode())
+    elif self.path == "/stats":
+      self._send(json.dumps(self.sink.stats()).encode())
+    else:
+      self._send(json.dumps({"error": f"unknown path {self.path}"}).encode(),
+                 status=404)
+
+  def do_POST(self):  # noqa: N802 - stdlib name
+    try:
+      length = int(self.headers.get("Content-Length", "0"))
+    except ValueError:
+      self._send(json.dumps({"error": "bad Content-Length"}).encode(),
+                 status=400)
+      return
+    if length <= 0 or length > self.sink.max_body_bytes:
+      self._send(json.dumps(
+          {"error": f"body must be 1..{self.sink.max_body_bytes} "
+                    "bytes"}).encode(), status=413 if length > 0 else 400)
+      return
+    body = self.rfile.read(length)
+    try:
+      json.loads(body)
+    except ValueError:
+      self.sink.note_reject()
+      self._send(json.dumps({"error": "body is not JSON"}).encode(),
+                 status=400)
+      return
+    try:
+      path = self.sink.accept(body)
+    except OSError as e:
+      # Disk trouble must read as a delivery failure so the shipper
+      # retries/spools — a 2xx here would delete the only copy.
+      self._send(json.dumps({"error": f"sink write failed: {e}"}).encode(),
+                 status=503)
+      return
+    self._send(json.dumps({"ok": True, "stored": os.path.basename(path)})
+               .encode())
+
+
+class ShipSink:
+  """A directory-backed batch store for the collector CLI (`ship-sink`).
+
+  Each accepted batch lands as ``batch-NNNNNNNN.json`` (monotonic
+  sequence, atomic rename). Resuming over an existing directory
+  continues the numbering after the highest resident file, so restarts
+  never overwrite delivered telemetry.
+  """
+
+  def __init__(self, directory: str, max_body_bytes: int = 8 << 20):
+    self.directory = os.path.abspath(directory)
+    os.makedirs(self.directory, exist_ok=True)
+    self.max_body_bytes = int(max_body_bytes)
+    self._lock = threading.Lock()
+    self.received = 0
+    self.rejected = 0
+    self.bytes_received = 0
+    seqs = [int(m.group(1)) for m in
+            (re.match(r"batch-(\d+)\.json$", name)
+             for name in os.listdir(self.directory)) if m]
+    self._seq = max(seqs, default=0)
+
+  def note_reject(self) -> None:
+    with self._lock:
+      self.rejected += 1
+
+  def accept(self, body: bytes) -> str:
+    """Durably store one batch; returns its path (raises OSError on
+    disk failure — the handler maps that to a retryable 503)."""
+    with self._lock:
+      self._seq += 1
+      seq = self._seq
+    path = os.path.join(self.directory, f"batch-{seq:08d}.json")
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+      f.write(body)
+      f.flush()
+      os.fsync(f.fileno())
+    os.replace(tmp, path)
+    with self._lock:
+      self.received += 1
+      self.bytes_received += len(body)
+    return path
+
+  def stats(self) -> dict:
+    with self._lock:
+      return {"dir": self.directory, "received": self.received,
+              "rejected": self.rejected,
+              "bytes_received": self.bytes_received,
+              "next_seq": self._seq + 1}
+
+
+def make_sink_server(directory: str, host: str = "127.0.0.1",
+                     port: int = 0) -> "tuple[ThreadingHTTPServer, ShipSink]":
+  """A ready-to-``serve_forever`` threaded collector for the shipper's
+  POSTed batches (the ``ship-sink`` CLI's engine). Port 0 = ephemeral;
+  the bound port is ``server.server_address[1]``."""
+  sink = ShipSink(directory)
+  handler = functools.partial(_SinkHandler, sink)
+  server = ThreadingHTTPServer((host, port), handler)
+  server.daemon_threads = True
+  return server, sink

@@ -61,6 +61,8 @@ def render_views_sharded(
     axis: str = "data",
     convention: Convention = Convention.REF_HOMOGRAPHY,
     method: str = "fused",
+    tgt_intrinsics: jnp.ndarray | None = None,
+    out_hw: tuple[int, int] | None = None,
     **render_kwargs,
 ) -> jnp.ndarray:
   """Render a batch of V target views, views sharded over a mesh axis.
@@ -118,20 +120,30 @@ def render_views_sharded(
                          separable=bundle["separable"],
                          plan=bundle["plan"], adj_plan=bundle["adj_plan"])
 
-  def local_render(mpi, poses, k):
+  # Tile-cropped sources (serve/tiles.py): the crop-corrected source
+  # intrinsics ride in `intrinsics`, the original camera here, and the
+  # rendered frame keeps the full target dims. Both replicate like the
+  # source intrinsics; None defaults preserve the historical behavior.
+  tgt_k = intrinsics if tgt_intrinsics is None else tgt_intrinsics
+
+  def local_render(mpi, poses, k, k_t):
     # mpi [1, H, W, P, 4] (replicated), poses [V/n, 4, 4].
+    kw = dict(render_kwargs)
+    if tgt_intrinsics is not None or out_hw is not None:
+      # Only the cropped path threads these through: fused_pallas (which
+      # rejects them) and the historical call shapes stay untouched.
+      kw.update(tgt_intrinsics=k_t.reshape(3, 3), out_hw=out_hw)
     return render.render_views(mpi[0], poses, depths, k.reshape(3, 3),
-                               convention=convention, method=method,
-                               **render_kwargs)
+                               convention=convention, method=method, **kw)
 
   # fused_pallas only: pallas_call outputs don't carry the vma metadata the
   # checker needs (each shard's render is fully local, so nothing is lost);
   # every XLA method keeps the replication checker on.
   fn = shard_map(
       local_render, mesh=mesh,
-      in_specs=(P(), P(axis), P()),
+      in_specs=(P(), P(axis), P(), P()),
       out_specs=P(axis), check_vma=(method != "fused_pallas"))
-  return fn(rgba_layers[None], tgt_poses, intrinsics)
+  return fn(rgba_layers[None], tgt_poses, intrinsics, tgt_k)
 
 
 def _fold_plane_shard(shard: jnp.ndarray, axis: str, n: int) -> jnp.ndarray:

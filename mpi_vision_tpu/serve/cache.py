@@ -23,13 +23,23 @@ import numpy as np
 
 @dataclasses.dataclass(frozen=True)
 class BakedScene:
-  """One servable scene, resident on device."""
+  """One servable scene (or tile crop), resident on device.
+
+  ``tgt_intrinsics``/``out_hw`` are the tile-cropped-source fields
+  (serve/tiles.py): the MPI may be a crop of the scene with the crop
+  correction folded into ``intrinsics``, while the rendered frame keeps
+  the original camera (``tgt_intrinsics``) and full dims (``out_hw``).
+  ``None`` (every whole-scene bake) keeps the engine's historical call
+  shape bit-exactly.
+  """
 
   scene_id: str
   rgba_layers: jnp.ndarray  # [H, W, P, 4], planes back-to-front
   depths: jnp.ndarray       # [P], descending (see camera.inv_depths)
   intrinsics: jnp.ndarray   # [3, 3]
   nbytes: int
+  tgt_intrinsics: jnp.ndarray | None = None
+  out_hw: tuple | None = None
 
 
 def bake_scene(scene_id, rgba_layers, depths, intrinsics,
@@ -124,6 +134,17 @@ class SceneCache:
       self._bytes -= scene.nbytes
       self.invalidations += 1
       return True
+
+  def invalidate_prefix(self, prefix: str) -> int:
+    """Drop every entry whose key starts with ``prefix`` (a tiled
+    scene's whole tile set — grid-changing reloads retire every tile id
+    the old grid minted). Returns the number of entries dropped."""
+    with self._lock:
+      keys = [k for k in self._scenes if k.startswith(prefix)]
+      for key in keys:
+        self._bytes -= self._scenes.pop(key).nbytes
+      self.invalidations += len(keys)
+      return len(keys)
 
   def _evict_locked(self) -> None:
     while self._bytes > self.byte_budget and len(self._scenes) > 1:

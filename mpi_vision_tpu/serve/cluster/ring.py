@@ -84,18 +84,39 @@ class HashRing:
   def __contains__(self, backend: str) -> bool:
     return str(backend) in self._backends
 
-  def placement(self, scene_id: str) -> list[str]:
-    """The scene's replica set: first ``replication`` distinct backends
-    clockwise from the scene's ring point, primary first.
+  @staticmethod
+  def placement_key(scene_id: str, tile: object | None = None) -> str:
+    """The ring key for a scene (or one of its tiles / view cells).
+
+    Tile-granular placement (Tiled MPI, PAPERS.md): keying on
+    ``(scene_id, tile)`` spreads one hot scene over MANY backends —
+    each tile/cell lands on its own replica set — instead of pinning
+    the whole scene to one primary. The separator cannot appear in a
+    scene id that passed the HTTP layer's string validation, so
+    ``("a", "1")`` and ``("a\\x1f1", None)`` cannot collide by
+    accident.
+    """
+    if tile is None:
+      return str(scene_id)
+    return f"{scene_id}\x1f{tile}"
+
+  def placement(self, scene_id: str, tile: object | None = None) -> list[str]:
+    """The key's replica set: first ``replication`` distinct backends
+    clockwise from its ring point, primary first.
 
     The order is part of the contract — every router computes the same
-    primary, so a healthy fleet serves each scene from one backend and
-    its cache locality is stable; failover walks the same list.
+    primary, so a healthy fleet serves each key from one backend and
+    its cache locality is stable; failover walks the same list. With
+    ``tile`` (a tile id or view-cell token), placement is per
+    ``(scene, tile)``: a hot scene's tiles spread across the pool, and
+    a given view cell deterministically prefers the one backend whose
+    edge/tile caches already hold it.
     """
     if not self._points:
       return []
     want = min(self.replication, len(self._backends))
-    start = bisect.bisect_left(self._points, (_hash64(str(scene_id)), ""))
+    key = self.placement_key(scene_id, tile)
+    start = bisect.bisect_left(self._points, (_hash64(key), ""))
     out: list[str] = []
     for i in range(len(self._points)):
       backend = self._points[(start + i) % len(self._points)][1]
@@ -105,6 +126,12 @@ class HashRing:
           break
     return out
 
-  def primary(self, scene_id: str) -> str | None:
-    place = self.placement(scene_id)
-    return place[0] if place else None
+  def primary(self, scene_id: str, tile: object | None = None) -> str | None:
+    """``placement(...)[0]`` without the full replica walk: the first
+    ring point clockwise IS the primary (O(log n) — the router's cell
+    reroute accounting calls this per request)."""
+    if not self._points:
+      return None
+    key = self.placement_key(scene_id, tile)
+    start = bisect.bisect_left(self._points, (_hash64(key), ""))
+    return self._points[start % len(self._points)][1]

@@ -41,6 +41,7 @@ import urllib.request
 import uuid
 
 import functools
+import numpy as np
 from concurrent.futures import ThreadPoolExecutor
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -50,6 +51,7 @@ from mpi_vision_tpu.obs import tsdb as tsdb_mod
 from mpi_vision_tpu.obs.events import EventLog
 from mpi_vision_tpu.obs.slo import SloConfig, SloTracker
 from mpi_vision_tpu.obs.trace import NULL_TRACE, NULL_TRACER, Tracer
+from mpi_vision_tpu.serve.edge import lattice as edge_lattice
 from mpi_vision_tpu.serve.resilience import CircuitBreaker, RetryBudget
 from mpi_vision_tpu.serve.cluster.ring import HashRing
 from mpi_vision_tpu.serve.server import _MAX_BODY_BYTES, _inbound_trace_id
@@ -157,6 +159,8 @@ class RouterMetrics:
     self.quarantines: dict[str, int] = {}
     self.load_reroutes = 0
     self.retry_budget_exhausted = 0
+    self.cell_routes = 0
+    self.cell_reroutes = 0
 
   def record_request(self) -> None:
     with self._lock:
@@ -210,6 +214,15 @@ class RouterMetrics:
     with self._lock:
       self.retry_budget_exhausted += 1
 
+  def record_cell_route(self, rerouted: bool) -> None:
+    """One request placed by its ``(scene, view-cell)`` ring key;
+    ``rerouted`` when that key's primary differs from the scene-level
+    primary (the affinity actually moved the request)."""
+    with self._lock:
+      self.cell_routes += 1
+      if rerouted:
+        self.cell_reroutes += 1
+
   def snapshot(self) -> dict:
     with self._lock:
       return {
@@ -226,6 +239,8 @@ class RouterMetrics:
           "quarantines": dict(sorted(self.quarantines.items())),
           "load_reroutes": self.load_reroutes,
           "retry_budget_exhausted": self.retry_budget_exhausted,
+          "cell_routes": self.cell_routes,
+          "cell_reroutes": self.cell_reroutes,
       }
 
 
@@ -329,6 +344,8 @@ class Router:
                retry_budget_initial: float = 10.0,
                load_aware: bool = True, load_ttl_s: float = 5.0,
                load_threshold: int = 4,
+               route_cell: float = 0.0,
+               route_rot_bucket_deg: float = 10.0,
                tsdb: "tsdb_mod.TsdbConfig | tsdb_mod.TsdbRecorder | None" = None,
                slo: "SloConfig | SloTracker | None" = SloConfig(),
                clock=time.monotonic):
@@ -348,6 +365,18 @@ class Router:
     self.load_aware = bool(load_aware)
     self.load_ttl_s = float(load_ttl_s)
     self.load_threshold = int(load_threshold)
+    # Cell/tile-granular routing (serve/tiles.py + the edge lattice):
+    # > 0 quantizes each request's pose into a view cell and places the
+    # request by the (scene, cell) ring key, so a hot scene spreads over
+    # many backends AND a given cell deterministically lands on the one
+    # backend whose edge/tile caches already serve it. 0 keeps the
+    # scene-level placement.
+    self.route_cell = float(route_cell)
+    self.route_rot_bucket_deg = float(route_rot_bucket_deg)
+    if self.route_cell > 0 and self.route_rot_bucket_deg <= 0:
+      raise ValueError(
+          f"route_rot_bucket_deg must be > 0 with cell routing, "
+          f"got {route_rot_bucket_deg}")
     self._clock = clock
     if isinstance(slo, SloTracker):
       self.slo = slo
@@ -527,24 +556,68 @@ class Router:
     self.metrics.record_load_reroute()
     return [best] + [b for b in replicas if b is not best]
 
-  def placement(self, scene_id: str) -> list[str]:
-    """The scene's replica set (backend ids, primary first) — a pure
-    function of the backend set, identical across router replicas."""
+  def placement(self, scene_id: str, cell: str | None = None) -> list[str]:
+    """The scene's (or ``(scene, cell)``'s) replica set (backend ids,
+    primary first) — a pure function of the backend set, identical
+    across router replicas."""
     with self._lock:
-      return self._ring.placement(str(scene_id))
+      return self._ring.placement(str(scene_id), tile=cell)
 
-  def _replicas(self, scene_id: str) -> list[_Backend]:
+  def request_cell(self, req: dict) -> str | None:
+    """The view-cell token for one parsed ``/render`` body, or None.
+
+    None when cell routing is off or the pose is missing/malformed —
+    a request the backend will 400 anyway must not fail in the router's
+    placement math, it just rides the scene-level key.
+    """
+    if self.route_cell <= 0:
+      return None
+    try:
+      pose = np.asarray(req.get("pose"), np.float32)
+      if pose.shape != (4, 4) or not np.isfinite(pose).all():
+        return None
+      cell = edge_lattice.quantize_pose(pose, self.route_cell,
+                                        self.route_rot_bucket_deg)
+    except (TypeError, ValueError):
+      return None
+    return ",".join(str(c) for c in cell)
+
+  def _replicas(self, scene_id: str,
+                cell: str | None = None) -> list[_Backend]:
     with self._lock:
-      return [self._backends[b] for b in self._ring.placement(str(scene_id))
-              if b in self._backends]
+      if cell is None:
+        return [self._backends[b]
+                for b in self._ring.placement(str(scene_id))
+                if b in self._backends]
+      cell_place = self._ring.placement(str(scene_id), tile=cell)
+      out = [self._backends[b] for b in cell_place if b in self._backends]
+      # The scene-level PRIMARY alone feeds the reroute counter —
+      # primary() is the O(log n) first-point lookup, not a second
+      # replica walk.
+      scene_primary = self._ring.primary(str(scene_id))
+    # Affinity accounting: the reroute counter says how often the
+    # (scene, cell) key actually moved the request off the scene-level
+    # primary — the cache-locality dividend an operator watches.
+    self.metrics.record_cell_route(
+        rerouted=bool(cell_place and scene_primary is not None
+                      and cell_place[0] != scene_primary))
+    return out
 
   # -- request path -------------------------------------------------------
 
   def forward_render(self, scene_id: str, body: bytes,
                      accept: str | None = None, trace_id: str | None = None,
                      trace=NULL_TRACE,
-                     if_none_match: str | None = None) -> tuple[int, dict, bytes]:
+                     if_none_match: str | None = None,
+                     cell: str | None = None) -> tuple[int, dict, bytes]:
     """Route one ``/render`` body to the scene's replica set.
+
+    ``cell`` (``request_cell``'s token, when cell routing is on) keys
+    the placement on ``(scene, cell)`` instead of the scene alone: one
+    hot scene spreads over many backends, and every request for a view
+    cell deterministically prefers the backend whose edge/tile caches
+    last served that cell (reroutes counted in
+    ``mpi_cluster_cell_reroutes_total``).
 
     ``if_none_match`` forwards the client's revalidation header so a
     backend's edge cache can answer 304 without rendering — the router
@@ -575,7 +648,7 @@ class Router:
     self.metrics.record_request()
     if self.retry_budget is not None:
       self.retry_budget.deposit()
-    replicas = self._replicas(scene_id)
+    replicas = self._replicas(scene_id, cell=cell)
     if not replicas:
       self._slo_bad()
       raise KeyError("no backends registered")
@@ -1034,6 +1107,13 @@ class Router:
     reg.counter(p + "retry_budget_exhausted_total",
                 "Failover walks stopped by an empty retry budget (503).",
                 snap["retry_budget_exhausted"])
+    reg.counter(p + "cell_routes_total",
+                "Requests placed by their (scene, view-cell) ring key "
+                "(tile-granular routing).", snap["cell_routes"])
+    reg.counter(p + "cell_reroutes_total",
+                "Cell-keyed placements whose primary differed from the "
+                "scene-level primary (affinity moved the request).",
+                snap["cell_reroutes"])
     if self.retry_budget is not None:
       reg.gauge(p + "retry_budget_tokens",
                 "Failover tokens currently in the retry budget.",
@@ -1228,6 +1308,10 @@ class _RouterHandler(BaseHTTPRequestHandler):
       if not isinstance(scene_id, str):
         raise ValueError(
             f"scene_id must be a string, got {type(scene_id).__name__}")
+      if any(ord(c) < 0x20 for c in scene_id):
+        # \x1f is the (scene, tile/cell) ring-key separator — a scene
+        # id carrying it could alias another scene's tile keys.
+        raise ValueError("scene_id must not contain control characters")
     except (KeyError, TypeError, ValueError, json.JSONDecodeError) as e:
       self.router.metrics.record_bad_request()
       self._send_json({"error": f"bad request: {e}"}, status=400,
@@ -1242,7 +1326,8 @@ class _RouterHandler(BaseHTTPRequestHandler):
       status, headers, resp_body = self.router.forward_render(
           scene_id, body, accept=self.headers.get("Accept"),
           trace_id=trace_id, trace=tr,
-          if_none_match=self.headers.get("If-None-Match"))
+          if_none_match=self.headers.get("If-None-Match"),
+          cell=self.router.request_cell(req))
     except KeyError as e:
       tr.finish(error=repr(e))
       self._send_json({"error": str(e)}, status=503, extra_headers=tid_hdr)

@@ -93,6 +93,12 @@ class CachedFrame:
   plane_depth: float      # representative depth for near-miss warps
   etag: str               # strong HTTP ETag (quoted), unique per entry
   nbytes: int
+  # The source tiles this frame's frustum could sample (serve/tiles.py
+  # ids), or None for frames of untiled scenes. A tile-granular reload
+  # drops ONLY the frames whose tile set intersects the changed tiles —
+  # every other frame's bytes are provably untouched, so its strong ETag
+  # survives the swap (``invalidate_tiles``).
+  tiles: frozenset | None = None
 
 
 def _etag(scene_id: str, digest: str, cell: tuple, seq: int) -> str:
@@ -173,7 +179,8 @@ class EdgeFrameCache:
   # -- population ---------------------------------------------------------
 
   def put(self, scene_id: str, digest: str, cell: tuple, pose, frame,
-          intrinsics, plane_depth: float) -> CachedFrame:
+          intrinsics, plane_depth: float,
+          tiles: frozenset | None = None) -> CachedFrame:
     """Insert a freshly rendered frame; first writer wins.
 
     A concurrent miss on the same cell may have populated it already —
@@ -196,7 +203,8 @@ class EdgeFrameCache:
           intrinsics=np.asarray(intrinsics, np.float32).copy(),
           plane_depth=float(plane_depth),
           etag=_etag(str(scene_id), str(digest), tuple(cell), self._seq),
-          nbytes=frame.nbytes + 16 * 4 + 9 * 4)
+          nbytes=frame.nbytes + 16 * 4 + 9 * 4,
+          tiles=None if tiles is None else frozenset(tiles))
       self._entries[key] = entry
       self._by_scene.setdefault((entry.scene_id, entry.digest),
                                 {})[entry.cell] = entry
@@ -260,6 +268,29 @@ class EdgeFrameCache:
               for scene_key, cells in self._by_scene.items()
               if scene_key[0] == sid
               for entry in cells.values()]
+      for key in keys:
+        self._drop_locked(key)
+      self.invalidations += len(keys)
+      return len(keys)
+
+  def invalidate_tiles(self, scene_id: str, changed_tiles) -> int:
+    """Drop only the frames whose recorded tile set intersects
+    ``changed_tiles`` (a tile-granular live reload changed those bytes).
+
+    Frames recording a disjoint tile set are provably untouched — their
+    pixels are a function of tiles that did not change — so they stay
+    resident WITH their strong ETags (the partial-reload acceptance
+    pin). Frames with no tile record (``tiles=None``) drop
+    conservatively. Returns the number of frames dropped.
+    """
+    sid = str(scene_id)
+    changed = frozenset(changed_tiles)
+    with self._lock:
+      keys = [(entry.scene_id, entry.digest, entry.cell)
+              for scene_key, cells in self._by_scene.items()
+              if scene_key[0] == sid
+              for entry in cells.values()
+              if entry.tiles is None or (entry.tiles & changed)]
       for key in keys:
         self._drop_locked(key)
       self.invalidations += len(keys)

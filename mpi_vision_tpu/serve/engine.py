@@ -166,18 +166,28 @@ class RenderEngine:
     # engine state: with overlapped batches prefer the per-handle
     # ``InFlightBatch.timings`` — this field is a convenience snapshot.
     self.last_timings = {"h2d_s": 0.0, "compute_s": 0.0, "readback_s": 0.0}
+    # The trailing (tgt_k, out_hw) pair carries tile-cropped sources
+    # (serve/tiles.py): tgt_k is the original camera when the MPI is a
+    # crop (None for whole-scene bakes — the historical call, kept
+    # bit-exact), out_hw the full target dims (static: it shapes the
+    # output, so it is part of the jit cache key like the MPI shape).
     if self.use_mesh:
       from mpi_vision_tpu.parallel import mesh as pmesh
 
       self._mesh = pmesh.make_mesh(devices=self.devices)
-      render_fn = lambda mpi, poses, depths, k: pmesh.render_views_sharded(  # noqa: E731
-          mpi, poses, depths, k, self._mesh,
-          convention=self.convention, method=self.method)
+      render_fn = lambda mpi, poses, depths, k, tgt_k, out_hw: (  # noqa: E731
+          pmesh.render_views_sharded(
+              mpi, poses, depths, k, self._mesh,
+              convention=self.convention, method=self.method,
+              tgt_intrinsics=tgt_k, out_hw=out_hw))
     else:
       self._mesh = None
-      render_fn = lambda mpi, poses, depths, k: render.render_views(  # noqa: E731
-          mpi, poses, depths, k,
-          convention=self.convention, method=self.method)
+      def render_fn(mpi, poses, depths, k, tgt_k, out_hw):
+        kw = {} if tgt_k is None else {"tgt_intrinsics": tgt_k,
+                                       "out_hw": out_hw}
+        return render.render_views(mpi, poses, depths, k,
+                                   convention=self.convention,
+                                   method=self.method, **kw)
     # Donate the pose buffer to the dispatch on every non-CPU backend:
     # each batch's pose array is freshly transferred and never read
     # again on the host, so the executable can reuse its bytes — one
@@ -186,9 +196,10 @@ class RenderEngine:
     # every tier-1/bench pipelined run), so it keeps the plain jit
     # (poses are tiny there anyway).
     if self.devices[0].platform != "cpu":
-      self._render_jit = jax.jit(render_fn, donate_argnums=(1,))
+      self._render_jit = jax.jit(render_fn, donate_argnums=(1,),
+                                 static_argnums=(5,))
     else:
-      self._render_jit = jax.jit(render_fn)
+      self._render_jit = jax.jit(render_fn, static_argnums=(5,))
 
   def batch_bucket(self, v: int) -> int:
     """Padded batch size dispatched for a logical batch of ``v``."""
@@ -259,8 +270,12 @@ class RenderEngine:
           poses_dev = jax.device_put(poses, self.devices[0])
       t1 = self._clock()
       with jax.profiler.TraceAnnotation("serve:compute_enqueue"):
+        tgt_k = getattr(scene, "tgt_intrinsics", None)
+        out_hw = getattr(scene, "out_hw", None)
         out = self._render_jit(scene.rgba_layers, poses_dev,
-                               scene.depths, scene.intrinsics)
+                               scene.depths, scene.intrinsics,
+                               tgt_k, None if out_hw is None
+                               else tuple(out_hw))
     except BaseException:
       self._release_slot()
       raise

@@ -96,6 +96,14 @@ class ServeMetrics:
       self.dispatch_gap_max_s = 0.0
       self.out_of_order_completions = 0
       self.abandoned_batches = 0
+      # Tile-granular accounting (serve/tiles.py): how many source tiles
+      # each frustum touched / the crop rendered / the cull skipped.
+      # tiled_requests counts requests that went through a tile plan at
+      # all, so the ratios stay meaningful on mixed fleets.
+      self.tiled_requests = 0
+      self.tiles_touched = 0
+      self.tiles_rendered = 0
+      self.tiles_culled = 0
       # Per-scene latency breakdown (hot-scene regression hunting):
       # scene -> [count, sum_s, max_s, deque(recent latencies)].
       self._per_scene: dict = {}
@@ -252,6 +260,16 @@ class ServeMetrics:
           self.phase_seconds[key] += phase_s
           self._hist_phase[key].record(phase_s)
 
+  def record_tiles(self, touched: int, rendered: int, total: int) -> None:
+    """One request's frustum-cull outcome against a tiled scene:
+    ``touched`` tiles the frustum can sample, ``rendered`` tiles inside
+    the dispatched crop, ``total - rendered`` culled outright."""
+    with self._lock:
+      self.tiled_requests += 1
+      self.tiles_touched += int(touched)
+      self.tiles_rendered += int(rendered)
+      self.tiles_culled += max(int(total) - int(rendered), 0)
+
   def record_warp_pose_error(self, trans: float, rot_deg: float,
                              trace_id: str | None = None) -> None:
     """One edge warp-serve's pose error (how far the served frame's
@@ -327,6 +345,15 @@ class ServeMetrics:
                       if self.dispatch_gaps else None),
                   "max_ms": round(self.dispatch_gap_max_s * 1e3, 3),
               },
+          },
+          "tiles": {
+              "tiled_requests": self.tiled_requests,
+              "touched_total": self.tiles_touched,
+              "rendered_total": self.tiles_rendered,
+              "culled_total": self.tiles_culled,
+              "mean_touched": (round(
+                  self.tiles_touched / self.tiled_requests, 3)
+                  if self.tiled_requests else None),
           },
           # Native-histogram snapshots (JSON-ready, obs/hist.py): the
           # source for the mpi_serve_*_nativehist families, the request

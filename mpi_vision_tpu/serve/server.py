@@ -63,13 +63,17 @@ import threading
 import time
 import urllib.parse
 import zlib
+from collections import OrderedDict as _OrderedDict
 from concurrent.futures import TimeoutError as FuturesTimeoutError
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 
 from mpi_vision_tpu.core import camera
 from mpi_vision_tpu.core.camera import inv_depths
+from mpi_vision_tpu.core.sampling import Convention  # noqa: F401 - API re-export
 from mpi_vision_tpu.obs import prom
 from mpi_vision_tpu.obs import ship as ship_mod
 from mpi_vision_tpu.obs import tsdb as tsdb_mod
@@ -83,6 +87,7 @@ from mpi_vision_tpu.obs.trace import (
     new_trace_id,
 )
 from mpi_vision_tpu.serve import cache as cache_mod
+from mpi_vision_tpu.serve import tiles as tiles_mod
 from mpi_vision_tpu.serve.edge import EdgeConfig, EdgeFrameCache, warp_frame
 from mpi_vision_tpu.serve.edge.lattice import pose_error
 from mpi_vision_tpu.serve.engine import RenderEngine
@@ -126,6 +131,39 @@ def synthetic_scene(scene_id: str, height: int = 256, width: int = 256,
   return layers, depths, k
 
 
+def synthetic_tiled_scene(scene_id: str, height: int = 512,
+                          width: int = 512, planes: int = 32,
+                          regions: int = 3, band: int | None = None,
+                          seed: int = 0):
+  """A depth-stratified procedural scene — the tiled-serving workload.
+
+  ``synthetic_scene`` content, but each of ``regions x regions`` spatial
+  blocks keeps alpha only on a contiguous band of ``band`` planes — the
+  structure Tiled MPI exploits: real scenes put each image region's
+  content in a narrow depth range, so a frustum touching few tiles
+  needs few planes. The band is a left-to-right depth STAIRCASE (column
+  0 holds the nearest slab, the last column the farthest — a room wall
+  receding to one side), so a pan that excludes some columns excludes
+  their depth slabs too. Plane RGB is left intact everywhere (the
+  farthest plane composites unconditionally); only alpha is masked,
+  which is exactly the property the plane cull keys on.
+  """
+  layers, depths, k = synthetic_scene(scene_id, height, width, planes,
+                                      seed=seed)
+  if band is None:
+    band = max(planes // max(regions, 1), 1)
+  ry = -(-height // regions)
+  rx = -(-width // regions)
+  span = max(planes - band, 0)
+  for i in range(regions):
+    for j in range(regions):
+      lo = round(j * span / max(regions - 1, 1))
+      keep = set(range(lo, min(lo + band, planes)))
+      drop = [p for p in range(planes) if p not in keep]
+      layers[i * ry:(i + 1) * ry, j * rx:(j + 1) * rx][..., drop, 3] = 0.0
+  return layers, depths, k
+
+
 class RenderService:
   """The in-process serving API (the HTTP layer is a thin shell on this).
 
@@ -140,6 +178,20 @@ class RenderService:
       grows while growing keeps improving the dispatch-gap metric,
       capped at ``max_inflight_cap``.
     max_inflight_cap: hard ceiling for ``max_inflight="auto"``.
+    tile: tile edge in pixels (``serve/tiles.py``). None (default)
+      serves monolithic scenes exactly as before. An int splits every
+      registered scene into a fixed tile grid: requests render only the
+      frustum-touched crop with content-free planes culled (bit-exact
+      to the monolithic render when the frustum covers every tile), the
+      baked cache holds/evicts/invalidates per tile, live reloads swap
+      only tiles whose digests changed, and the edge frame cache drops
+      only frames that depended on a changed tile.
+    convention: coordinate convention for the engine (None keeps the
+      engine default, the reference's REF_HOMOGRAPHY). Non-square tiled
+      scenes (room-scale panoramas) should pass ``Convention.EXACT`` —
+      the reference convention's axis swap is only benign on square
+      frames, and the tile planner faithfully reproduces whichever
+      convention the engine renders with.
     edge: the pose-quantized edge frame cache (``serve/edge/``): None
       (default) serves every request through the scheduler as before;
       an ``EdgeConfig`` caches finished frames per view cell, serves
@@ -209,7 +261,8 @@ class RenderService:
   def __init__(self, cache_bytes: int = 2 << 30, max_batch: int = 8,
                max_wait_ms: float = 2.0, max_inflight: "int | str" = 4,
                max_inflight_cap: int = 16,
-               method: str = "fused",
+               method: str = "fused", tile: int | None = None,
+               convention: "Convention | None" = None,
                use_mesh: bool | None = None, max_queue: int = 1024,
                engine: RenderEngine | None = None,
                resilience: ResilienceConfig | None = ResilienceConfig(),
@@ -241,14 +294,29 @@ class RenderService:
           f"max_inflight must be an int or 'auto', got {max_inflight!r}")
     elif max_inflight < 1:
       raise ValueError(f"max_inflight must be >= 1, got {max_inflight}")
+    if tile is not None and tile < 8:
+      # Below 8 px the crop-correction affines degenerate (1-px crops
+      # divide by zero under the reference conventions) and the per-tile
+      # bookkeeping dwarfs the pixels it manages.
+      raise ValueError(f"tile must be >= 8 pixels, got {tile}")
+    if tile is not None and method == "fused_pallas":
+      # render_mpi rejects tgt_intrinsics/out_hw for the Pallas kernel,
+      # so every CULLED render would 500 while full-coverage warmup
+      # succeeds — fail the misconfiguration at construction instead.
+      raise ValueError(
+          "tile-granular serving requires an XLA method "
+          "('fused'/'scan'/'assoc'); method='fused_pallas' cannot "
+          "render cropped sources")
+    self.tile = int(tile) if tile is not None else None
     self._clock = clock
     # The engine's own window must not be the bottleneck under retries
     # (an abandoned attempt can briefly hold a slot next to its retry's)
     # nor under adaptive growth (size it for the cap, not the start).
     engine_window = max_inflight_cap if adaptive_inflight else max_inflight
+    engine_kw = {} if convention is None else {"convention": convention}
     self.engine = engine if engine is not None else RenderEngine(
         method=method, use_mesh=use_mesh,
-        max_inflight=max(8, 2 * engine_window))
+        max_inflight=max(8, 2 * engine_window), **engine_kw)
     self.cache = cache_mod.SceneCache(byte_budget=cache_bytes)
     self.metrics = ServeMetrics()
     self.events = events if events is not None else EventLog()
@@ -289,6 +357,29 @@ class RenderService:
         if self.fallback_engine is not None else None)
     self._scene_data: dict[str, tuple] = {}
     self._scene_lock = threading.Lock()
+    # Tile-granular serving state (serve/tiles.py): per-scene tiling
+    # metadata (digests, plane masks, grid — all guarded by
+    # _scene_lock), a per-TILE baked LRU (its own cache so tile bytes /
+    # evictions are first-class accounting, and so a live reload can
+    # invalidate exactly the changed tiles), and a small bounded memo of
+    # assembled crops so the steady-state hit path pays one dict lookup
+    # instead of K device concats per request.
+    self._tile_meta: dict[str, tiles_mod.TileMeta] = {}
+    self._tile_cache = (cache_mod.SceneCache(byte_budget=cache_bytes)
+                        if self.tile is not None else None)
+    self._fallback_tile_cache = (
+        cache_mod.SceneCache(byte_budget=cache_bytes)
+        if self.tile is not None and self.fallback_engine is not None
+        else None)
+    self._crop_memo: "OrderedDict[str, cache_mod.BakedScene]" = \
+        _OrderedDict()
+    self._crop_memo_bytes = 0
+    # A quarter of the baked-cache allowance: each memo entry duplicates
+    # its crop's device bytes ON TOP of the tiles it was concatenated
+    # from, so the memo gets a bounded supplement, not a second full
+    # budget (total tiled residency <= 1.25x --cache-mb).
+    self._crop_memo_budget = max(int(cache_bytes) // 4, 1)
+    self._crop_lock = threading.Lock()
     # The edge frame cache (serve/edge/): per-scene generation counters
     # make the params digest change on every add_scene/swap_scenes, so a
     # live reload orphans every cached cell of the old pixels; the base
@@ -312,6 +403,8 @@ class RenderService:
     self.scheduler = MicroBatcher(
         self.engine, self._get_scene, metrics=self.metrics,
         max_batch=max_batch, max_wait_ms=max_wait_ms,
+        batch_keyer=(self._tile_batch_key
+                     if self.tile is not None else None),
         max_queue=max_queue, max_inflight=max_inflight,
         adaptive_inflight=adaptive_inflight,
         max_inflight_cap=max_inflight_cap if adaptive_inflight else None,
@@ -395,18 +488,84 @@ class RenderService:
 
   def add_scene(self, scene_id: str, rgba_layers, depths,
                 intrinsics) -> None:
-    """Register a scene (host arrays); it bakes lazily on first request."""
+    """Register a scene (host arrays); it bakes lazily on first request.
+
+    With tiling on, the scene is split into its tile grid here (per-tile
+    digests + plane masks) and a re-registration invalidates ONLY the
+    tiles whose bytes changed — the same diff live reloads use.
+    """
     entry = (np.asarray(rgba_layers, np.float32),
              np.asarray(depths, np.float32),
              np.asarray(intrinsics, np.float32))
+    sid = str(scene_id)
+    if tiles_mod.KEY_SEP in sid:
+      # The tile/crop batch- and cache-key separator: a scene id
+      # carrying it would alias tile keys (the HTTP layer rejects all
+      # control characters for the same reason).
+      raise ValueError("scene_id must not contain '\\x1f'")
+    if self.tile is not None:
+      self._publish_tiled(sid, entry)
+      return
     with self._scene_lock:
-      self._scene_data[str(scene_id)] = entry
+      self._scene_data[sid] = entry
       # New content under this id: a fresh generation makes every edge
       # frame digest of the old pixels unreachable.
-      self._scene_gen[str(scene_id)] = \
-          self._scene_gen.get(str(scene_id), 0) + 1
+      self._scene_gen[sid] = self._scene_gen.get(sid, 0) + 1
     if self.edge is not None:
-      self.edge.invalidate_scene(str(scene_id))
+      self.edge.invalidate_scene(sid)
+
+  def _publish_tiled(self, sid: str, entry: tuple) -> list[tuple[int, int]]:
+    """Publish (or re-publish) one scene into the tiled registry and
+    invalidate exactly the tiles whose bytes changed. Returns the
+    changed tile ids (every tile for a first publish or a grid/geometry
+    change)."""
+    meta = tiles_mod.TileMeta.build(entry[0], entry[1], entry[2],
+                                    self.tile)
+    with self._scene_lock:
+      old = self._tile_meta.get(sid)
+      self._scene_data[sid] = entry
+      self._tile_meta[sid] = meta
+    if old is None:
+      changed = [(i, j) for i in range(meta.grid.rows)
+                 for j in range(meta.grid.cols)]
+      # First publish under this id: nothing valid can be cached, but a
+      # stale same-id residue from a pre-tiling registration must go.
+      self._tile_cache.invalidate_prefix(sid + tiles_mod.KEY_SEP)
+      if self._fallback_tile_cache is not None:
+        self._fallback_tile_cache.invalidate_prefix(sid + tiles_mod.KEY_SEP)
+      self.cache.invalidate(sid)
+      self._purge_crop_memo(sid)
+      if self.edge is not None:
+        self.edge.invalidate_scene(sid)
+      return changed
+    changed = old.changed_tiles(meta)
+    all_changed = len(changed) == len(meta.grid) or old.grid != meta.grid
+    for (i, j) in (changed if not all_changed else []):
+      key = tiles_mod.tile_cache_key(sid, i, j)
+      self._tile_cache.invalidate(key)
+      if self._fallback_tile_cache is not None:
+        self._fallback_tile_cache.invalidate(key)
+    if all_changed:
+      # Grid or geometry changed: every old tile id is dead, and even
+      # frames that touched no tile may depend on the camera — sweep
+      # everything under this scene.
+      self._tile_cache.invalidate_prefix(sid + tiles_mod.KEY_SEP)
+      if self._fallback_tile_cache is not None:
+        self._fallback_tile_cache.invalidate_prefix(sid + tiles_mod.KEY_SEP)
+    if changed:
+      self._purge_crop_memo(sid)
+    if self.edge is not None and changed:
+      if all_changed:
+        self.edge.invalidate_scene(sid)
+      else:
+        self.edge.invalidate_tiles(sid, changed)
+    return changed
+
+  def _purge_crop_memo(self, sid: str) -> None:
+    with self._crop_lock:
+      for key in [k for k in self._crop_memo
+                  if k.startswith(sid + tiles_mod.KEY_SEP)]:
+        self._crop_memo_bytes -= self._crop_memo.pop(key).nbytes
 
   def add_synthetic_scenes(self, n: int, height: int = 256, width: int = 256,
                            planes: int = 16, seed: int = 0) -> list[str]:
@@ -422,7 +581,37 @@ class RenderService:
     with self._scene_lock:
       return sorted(self._scene_data)
 
+  def _tile_batch_key(self, scene_id: str, pose) -> tuple[str, dict | None]:
+    """The scheduler's batch-key hook for tiled services: frustum-cull
+    the request into a ``TileSignature`` so it batches only with
+    requests sharing its exact render plan. Untiled scenes (an
+    ``--mpi-dir`` scene living next to tiled ones) pass through on the
+    plain scene id."""
+    with self._scene_lock:
+      meta = self._tile_meta.get(scene_id)
+    if meta is None:
+      return scene_id, None
+    sig = meta.plan(np.asarray(pose, np.float32)[None],
+                    self.engine.convention)
+    # No metrics here: the scheduler records the attrs only for
+    # requests it actually ENQUEUES, so breaker fast-fails and
+    # queue-full rejections never skew the cull ratios.
+    return (scene_id + tiles_mod.KEY_SEP + sig.token(), {
+        "tiles_touched": sig.tiles_touched,
+        "tiles_rendered": sig.tiles_rendered,
+        "tiles_culled": sig.tiles_total - sig.tiles_rendered,
+        "tiles_total": sig.tiles_total,
+        "planes": len(sig.planes),
+    })
+
   def _get_scene(self, scene_id: str) -> cache_mod.BakedScene:
+    sid, _, token = scene_id.partition(tiles_mod.KEY_SEP)
+    if self.tile is not None:
+      with self._scene_lock:
+        meta = self._tile_meta.get(sid)
+      if meta is not None:
+        return self._assemble_crop(sid, meta, token, fallback=False)
+
     def bake():
       with self._scene_lock:
         entry = self._scene_data.get(scene_id)
@@ -438,10 +627,136 @@ class RenderService:
 
     return self.cache.get_or_bake(scene_id, bake)
 
+  def _assemble_crop(self, sid: str, meta: tiles_mod.TileMeta,
+                     token: str, fallback: bool) -> cache_mod.BakedScene:
+    """The tiled scene provider: per-tile get-or-bake, then one device
+    concat of the signature's crop with its culled plane set and
+    crop-corrected source intrinsics. A bounded memo makes the repeat
+    path one dict lookup; a full-coverage all-planes signature returns a
+    plain whole-scene ``BakedScene`` (no target override), sharing the
+    monolithic path's compile and its bit-exactness."""
+    grid = meta.grid
+    sig = None
+    if token:
+      # The token was minted by the batch keyer against the meta CURRENT
+      # at submit time; a reload that changed the grid or plane count
+      # while the request sat queued makes it stale. Validate against
+      # THIS meta and fall back to full coverage of the current scene —
+      # a correct fresh frame beats a clamped-gather misrender or a 500.
+      try:
+        parsed = tiles_mod.TileSignature.parse(token, grid)
+        y0, y1, x0, x1 = parsed.crop
+        if (0 <= y0 < y1 <= grid.height and 0 <= x0 < x1 <= grid.width
+            and parsed.planes
+            and all(0 <= p < meta.planes for p in parsed.planes)):
+          sig = parsed
+      except ValueError:
+        pass
+    if sig is None:
+      # Plain scene-id lookups (warmup, prebake) assemble full coverage.
+      sig = meta.signature(np.ones((grid.rows, grid.cols), bool))
+    memo_key = sid + tiles_mod.KEY_SEP + sig.token() + \
+        (tiles_mod.KEY_SEP + "fb" if fallback else "")
+    with self._crop_lock:
+      memo = self._crop_memo.get(memo_key)
+      if memo is not None:
+        self._crop_memo.move_to_end(memo_key)
+        return memo
+    cache = self._fallback_tile_cache if fallback else self._tile_cache
+    device = (self.fallback_engine.devices[0] if fallback else None)
+    rows, cols = meta.crop_tiles(sig.crop)
+
+    def bake_tile(i, j):
+      def bake():
+        with self._scene_lock:
+          entry = self._scene_data.get(sid)
+        if entry is None:
+          raise KeyError(f"unknown scene {sid!r}")
+        if not fallback:
+          check_bake = getattr(self.engine, "check_bake", None)
+          if check_bake is not None:
+            check_bake(sid)
+        y0, y1, x0, x1 = grid.rect(i, j)
+        return cache_mod.bake_scene(
+            tiles_mod.tile_cache_key(sid, i, j),
+            entry[0][y0:y1, x0:x1], entry[1], entry[2], device=device)
+      return cache.get_or_bake(tiles_mod.tile_cache_key(sid, i, j), bake)
+
+    idx = np.asarray(sig.planes, np.int32)
+    tile_rows = []
+    depths = intrinsics = None
+    for i in rows:
+      row = [bake_tile(i, j) for j in cols]
+      depths, intrinsics = row[0].depths, row[0].intrinsics
+      tile_rows.append(row[0].rgba_layers[:, :, idx, :] if len(row) == 1
+                       else jnp.concatenate(
+                           [t.rgba_layers[:, :, idx, :] for t in row],
+                           axis=1))
+    rgba = tile_rows[0] if len(tile_rows) == 1 else jnp.concatenate(
+        tile_rows, axis=0)
+    full = (sig.crop == (0, grid.height, 0, grid.width)
+            and len(sig.planes) == meta.planes)
+    if full:
+      k_src, tgt_k, out_hw = intrinsics, None, None
+      depths_sel = depths
+    else:
+      k_src = jnp.asarray(
+          meta.crop_src_intrinsics(sig.crop, self.engine.convention))
+      tgt_k = jnp.asarray(meta.intrinsics)
+      out_hw = (grid.height, grid.width)
+      depths_sel = depths[idx]
+      if device is not None:
+        k_src, tgt_k, depths_sel = (jax.device_put(a, device)
+                                    for a in (k_src, tgt_k, depths_sel))
+    jax.block_until_ready(rgba)
+    nbytes = sum(int(np.prod(a.shape)) * a.dtype.itemsize
+                 for a in (rgba, depths_sel, k_src))
+    scene = cache_mod.BakedScene(memo_key, rgba, depths_sel, k_src,
+                                 nbytes, tgt_intrinsics=tgt_k,
+                                 out_hw=out_hw)
+    # Memoize ONLY if no publish/swap raced this assembly — verified and
+    # inserted under the scene lock (the _edge_put pattern), so a swap's
+    # registry update either happens-before this check (stale branch
+    # below) or happens-after, in which case its invalidation sweep +
+    # memo purge run after this insert and clean it up.
+    with self._scene_lock:
+      if self._tile_meta.get(sid) is meta:
+        with self._crop_lock:
+          old = self._crop_memo.pop(memo_key, None)
+          if old is not None:  # a concurrent same-key assembly won
+            self._crop_memo_bytes -= old.nbytes
+          self._crop_memo[memo_key] = scene
+          self._crop_memo_bytes += scene.nbytes
+          # Bounded by entries AND bytes (each entry duplicates its
+          # crop on device; the byte budget keeps the memo inside the
+          # same allowance the tile cache answers to).
+          while self._crop_memo and (
+              len(self._crop_memo) > _CROP_MEMO_CAP
+              or self._crop_memo_bytes > self._crop_memo_budget):
+            _, evicted = self._crop_memo.popitem(last=False)
+            self._crop_memo_bytes -= evicted.nbytes
+        return scene
+    # Stale: the tiles baked above may hold pre-swap bytes inserted
+    # AFTER the swap's invalidation sweep. Drop them (unchanged tiles
+    # re-bake to identical bytes, changed ones to the new bytes) and
+    # serve this result uncached — the same one-stale-response-max
+    # contract as the untiled swap.
+    for i in rows:
+      for j in cols:
+        cache.invalidate(tiles_mod.tile_cache_key(sid, i, j))
+    return scene
+
   def _get_scene_fallback(self, scene_id: str) -> cache_mod.BakedScene:
     """Scene provider for the degraded-mode engine: same host arrays,
     baked onto the fallback's (CPU) devices, cached separately so an
     outage does not evict the primary's residency."""
+    sid, _, token = scene_id.partition(tiles_mod.KEY_SEP)
+    if self.tile is not None:
+      with self._scene_lock:
+        meta = self._tile_meta.get(sid)
+      if meta is not None:
+        return self._assemble_crop(sid, meta, token, fallback=True)
+
     def bake():
       with self._scene_lock:
         entry = self._scene_data.get(scene_id)
@@ -470,6 +785,21 @@ class RenderService:
                    np.asarray(depths, np.float32),
                    np.asarray(k, np.float32))
         for sid, (rgba, depths, k) in scenes.items()}
+    swapped = sorted(entries)
+    if self.tile is not None:
+      # Tile-granular reload: diff each scene's tile digests and swap
+      # ONLY the changed tiles — untouched tiles keep their baked cache
+      # entries, and edge frames that never sampled a changed tile keep
+      # their bytes AND their strong ETags (pinned in test_tiles.py).
+      tiles_changed = {sid: len(self._publish_tiled(sid, entry))
+                       for sid, entry in entries.items()}
+      if prebake:
+        for sid in entries:
+          if tiles_changed[sid]:
+            self._get_scene(sid)
+      self.events.emit("scene_swap", scenes=swapped, prebake=bool(prebake),
+                       tiles_changed=tiles_changed)
+      return swapped
     with self._scene_lock:
       self._scene_data.update(entries)
       for sid in entries:
@@ -478,7 +808,6 @@ class RenderService:
       self.cache.invalidate(sid)
       if self._fallback_cache is not None:
         self._fallback_cache.invalidate(sid)
-    swapped = sorted(entries)
     if self.edge is not None:
       # The edge cache invalidates exactly like the baked caches: a
       # request racing the swap serves old pixels under the OLD etag or
@@ -551,14 +880,19 @@ class RenderService:
 
   # -- edge frame cache ---------------------------------------------------
 
-  def _edge_meta(self, scene_id: str) -> tuple[str, np.ndarray, float]:
-    """``(params_digest, intrinsics, plane_depth)`` for one scene.
+  def _edge_meta(self, scene_id: str) -> tuple[str, np.ndarray, float,
+                                               str | None]:
+    """``(params_digest, intrinsics, plane_depth, content_token)``.
 
-    The digest is the edge cache-key component: engine identity + the
-    scene's generation, so any content change (add_scene, swap_scenes,
-    live ckpt reload) retires every previously cached cell. Raises
-    ``KeyError`` for unknown scenes (the same 404 contract as the
-    scheduler path — a cache in front must not invent scenes).
+    The digest is the edge cache-key component. Untiled scenes fold in
+    the scene's generation, so any content change retires every cached
+    cell (token None — stale puts key an unreachable digest and need no
+    guard). TILED scenes keep a STABLE digest — correctness comes from
+    tile-addressed invalidation instead, which is what lets frames that
+    never sampled a changed tile survive a reload with their ETags —
+    and the token (the tile-digest hash) guards ``_edge_put`` against a
+    render that raced a swap. Raises ``KeyError`` for unknown scenes
+    (the same 404 contract as the scheduler path).
     """
     sid = str(scene_id)
     with self._scene_lock:
@@ -566,12 +900,46 @@ class RenderService:
       if entry is None:
         raise KeyError(f"unknown scene {sid!r}")
       gen = self._scene_gen.get(sid, 0)
+      meta = self._tile_meta.get(sid)
       depths, intrinsics = entry[1], entry[2]
     # Representative warp depth: the geometric mean of the scene's depth
     # range — the single plane that splits typical MPI content evenly.
     d_near, d_far = float(depths.min()), float(depths.max())
-    return (f"{self._edge_base}:g{gen}", intrinsics,
-            math.sqrt(max(d_near, 1e-6) * max(d_far, 1e-6)))
+    plane_depth = math.sqrt(max(d_near, 1e-6) * max(d_far, 1e-6))
+    if meta is not None:
+      return (f"{self._edge_base}:tiled", intrinsics, plane_depth,
+              meta.scene_digest)
+    return f"{self._edge_base}:g{gen}", intrinsics, plane_depth, None
+
+  def _touched_tiles(self, scene_id: str, pose) -> frozenset | None:
+    """The tile ids this pose's frustum can sample (None for untiled
+    scenes) — recorded on edge entries for tile-addressed invalidation."""
+    with self._scene_lock:
+      meta = self._tile_meta.get(str(scene_id))
+    if meta is None:
+      return None
+    return meta.touched_tile_ids(
+        meta.touched(np.asarray(pose, np.float32)[None],
+                     self.engine.convention))
+
+  def _edge_put(self, sid: str, digest: str, cell, pose, img, intrinsics,
+                plane_depth: float, token: str | None, tiles):
+    """Populate the edge cell, guarded against a swap that raced the
+    render: a tiled scene's digest is stable across reloads, so a stale
+    put must be REFUSED (checked and inserted under the scene lock —
+    either the put lands before the swap's registry update and the
+    swap's tile sweep drops it, or it sees the new tile digests and
+    skips). Untiled scenes need no guard: their digest carries the
+    generation, so a stale put keys an unreachable digest."""
+    if token is None:
+      return self.edge.put(sid, digest, cell, pose, img, intrinsics,
+                           plane_depth)
+    with self._scene_lock:
+      meta = self._tile_meta.get(sid)
+      if meta is None or meta.scene_digest != token:
+        return None  # scene changed mid-render: serve it, don't cache it
+      return self.edge.put(sid, digest, cell, pose, img, intrinsics,
+                           plane_depth, tiles=tiles)
 
   def render_edge(self, scene_id: str, pose, timeout: float = 60.0,
                   trace=NULL_TRACE) -> tuple[np.ndarray, dict]:
@@ -600,7 +968,7 @@ class RenderService:
       # resolves in /debug/traces must hold for those too. Past the
       # hand-off the flight finishes the trace (finish is idempotent).
       pose = np.asarray(pose, np.float32)
-      digest, intrinsics, plane_depth = self._edge_meta(scene_id)
+      digest, intrinsics, plane_depth, token = self._edge_meta(scene_id)
       max_age = self.edge.config.max_age_s
       kind, entry, cell = self.edge.lookup(scene_id, digest, pose)
       if kind == "hit":
@@ -634,11 +1002,17 @@ class RenderService:
     # Miss: a real render (latency recorded by the scheduler as usual),
     # then populate the cell. First writer wins — serving the RESIDENT
     # entry's frame keeps every response consistent with the cell's one
-    # strong ETag even when two misses race.
+    # strong ETag even when two misses race. Tiled scenes record the
+    # frustum's tile set (captured BEFORE the render, consistent with
+    # the token) so a tile-granular reload drops only dependent frames.
+    tiles = self._touched_tiles(scene_id, pose) if token is not None \
+        else None
     img = self.scheduler.render(scene_id, pose, timeout=timeout,
                                 trace=trace)
-    entry = self.edge.put(scene_id, digest, cell, pose, img, intrinsics,
-                          plane_depth)
+    entry = self._edge_put(str(scene_id), digest, cell, pose, img,
+                           intrinsics, plane_depth, token, tiles)
+    if entry is None:  # a swap raced the render: correct, just uncached
+      return img, {"edge": "miss", "etag": None, "max_age_s": max_age}
     return entry.frame, {"edge": "miss", "etag": entry.etag,
                          "max_age_s": max_age}
 
@@ -650,7 +1024,7 @@ class RenderService:
     if self.edge is None or not if_none_match:
       return None
     try:
-      digest, _, _ = self._edge_meta(scene_id)
+      digest, _, _, _ = self._edge_meta(scene_id)
     except KeyError:
       return None
     return self.edge.revalidate(scene_id, digest, np.asarray(pose, np.float32),
@@ -710,6 +1084,17 @@ class RenderService:
       out["pipeline"]["adaptive"] = adaptive
     if self.edge is not None:
       out["edge"] = self.edge.stats()
+    if self.tile is not None:
+      out["tiles"]["tile"] = self.tile
+      with self._scene_lock:
+        out["tiles"]["scenes_tiled"] = len(self._tile_meta)
+      with self._crop_lock:
+        out["tiles"]["crop_memo"] = {"entries": len(self._crop_memo),
+                                     "cap": _CROP_MEMO_CAP,
+                                     "bytes": self._crop_memo_bytes,
+                                     "byte_budget":
+                                         self._crop_memo_budget}
+      out["tile_cache"] = self._tile_cache.stats()
     out["engine"] = self.engine.describe()
     if self.resilient is not None:
       out["breaker"] = self.resilient.breaker.snapshot()
@@ -852,6 +1237,11 @@ class RenderService:
 # A /render body is a scene id + 4x4 pose (< 1 KB); anything near this cap
 # is malformed or hostile, and the handler must not buffer it.
 _MAX_BODY_BYTES = 1 << 20
+
+# Assembled-crop memo entries retained per service (serve/tiles.py): the
+# steady-state signatures of live traffic are few (view cells cluster),
+# and each entry duplicates its crop's bytes on device — keep it small.
+_CROP_MEMO_CAP = 32
 
 # W3C traceparent: version, 32-hex trace-id, 16-hex parent span id,
 # 2-hex flags (https://www.w3.org/TR/trace-context/). Spec requires
@@ -1041,6 +1431,12 @@ class _Handler(BaseHTTPRequestHandler):
         # inside the dispatcher — reject it at the door (fuzz pin).
         raise ValueError(
             f"scene_id must be a string, got {type(scene_id).__name__}")
+      if any(ord(c) < 0x20 for c in scene_id):
+        # Control characters are never legitimate scene ids, and \x1f
+        # specifically is the tile/crop key separator (serve/tiles.py,
+        # cluster/ring.py): letting it through would let a client
+        # smuggle batch-key/ring-key tokens inside a scene id.
+        raise ValueError("scene_id must not contain control characters")
       pose = np.asarray(req["pose"], np.float32)
       if pose.shape != (4, 4):
         raise ValueError(f"pose must be 4x4, got {pose.shape}")

@@ -45,12 +45,15 @@ file).
 
 from __future__ import annotations
 
+import functools
 import json
 import os
 import signal
 import sys
 import threading
 import time
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from mpi_vision_tpu.obs import prom
 from mpi_vision_tpu.serve.resilience import RestartBudget, RetryPolicy
@@ -786,3 +789,74 @@ def queue_registry(snap: dict) -> prom.Registry:
               "Leases reaped from dead workers (jobs requeued, not "
               "lost).", snap.get("queue", {}).get("leases_expired", 0))
   return reg
+
+
+class _QueueMetricsHandler(BaseHTTPRequestHandler):
+  """The ``train-queue --metrics-port`` surface: the scrape endpoints a
+  serve backend already exposes (``/metrics``, ``/stats``, ``/healthz``,
+  and ``/debug/events`` when an event log rides along), minus any
+  request path — the supervisor has none."""
+
+  def __init__(self, supervisor: "TrainSupervisor", events, *args,
+               **kwargs):
+    self.supervisor = supervisor
+    self.events = events
+    super().__init__(*args, **kwargs)
+
+  def log_message(self, fmt, *args):  # noqa: A003 - stdlib signature
+    pass
+
+  def _send(self, body: bytes, status: int = 200,
+            content_type: str = "application/json") -> None:
+    try:
+      self.send_response(status)
+      self.send_header("Content-Type", content_type)
+      self.send_header("Content-Length", str(len(body)))
+      self.end_headers()
+      self.wfile.write(body)
+    except (BrokenPipeError, ConnectionResetError):
+      self.close_connection = True
+
+  def do_GET(self):  # noqa: N802 - stdlib name
+    parsed = urllib.parse.urlsplit(self.path)
+    path = parsed.path
+    if path == "/metrics":
+      self._send(self.supervisor.metrics_text().encode(),
+                 content_type="text/plain; version=0.0.4; charset=utf-8")
+    elif path == "/stats":
+      self._send(json.dumps(self.supervisor.snapshot()).encode())
+    elif path == "/healthz":
+      snap = self.supervisor.snapshot()
+      self._send(json.dumps({
+          "status": "ok", "role": "train-queue",
+          "jobs": snap["queue"]["counts"],
+          "running": len(snap["running"]),
+          "quarantines": snap["quarantines"],
+          "drained": self.supervisor.queue.drained()}).encode())
+    elif path == "/debug/events" and self.events is not None:
+      query = urllib.parse.parse_qs(parsed.query)
+      kind = query.get("kind", [None])[0]
+      try:
+        recent = int(query.get("recent", ["128"])[0])
+      except ValueError:
+        self._send(json.dumps(
+            {"error": "recent must be an integer"}).encode(), status=400)
+        return
+      self._send(json.dumps(
+          self.events.snapshot(recent=recent, kind=kind)).encode())
+    else:
+      self._send(json.dumps({"error": f"unknown path {self.path}"}).encode(),
+                 status=404)
+
+
+def make_queue_metrics_server(supervisor: "TrainSupervisor", events=None,
+                              host: str = "127.0.0.1",
+                              port: int = 0) -> "ThreadingHTTPServer":
+  """A ready-to-``serve_forever`` threaded listener exporting the
+  supervisor's ``mpi_train_queue_*`` registry over ``/metrics`` +
+  ``/stats`` + ``/healthz`` (+ ``/debug/events`` with an event log).
+  Port 0 = ephemeral; the bound port is ``server.server_address[1]``."""
+  handler = functools.partial(_QueueMetricsHandler, supervisor, events)
+  server = ThreadingHTTPServer((host, port), handler)
+  server.daemon_threads = True
+  return server

@@ -81,6 +81,9 @@ def test_lint_covers_the_ckpt_package_and_train_loop():
   assert {"ckpt/store.py", "ckpt/guards.py", "ckpt/faultinject.py",
           "ckpt/watch.py", "ckpt/background.py", "serve/faultinject.py",
           "serve/engine.py", "serve/scheduler.py", "serve/metrics.py",
+          # The tile tier (PR 13): the planner is request-path code and
+          # the tile/crop caches feed the latency accounting.
+          "serve/tiles.py", "serve/cache.py", "serve/server.py",
           "train/loop.py", "train/telemetry.py", "train/queue.py",
           "train/supervisor.py", "train/faultinject.py",
           "cluster/router.py",

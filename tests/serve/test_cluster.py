@@ -432,6 +432,48 @@ def test_router_load_aware_demotes_deep_primary():
   assert router.forward_render(sid, body)[1]["X-Backend-Id"] == "a"
 
 
+def test_router_cell_routing_spreads_and_counts_reroutes():
+  """Tile-granular routing (serve/tiles.py x the edge lattice): with
+  --route-cell on, requests place by their (scene, view-cell) ring key
+  — a hot scene's cells spread over the pool, reroutes off the
+  scene-level primary are counted, and a malformed pose falls back to
+  the scene key instead of failing placement."""
+  transport = FakeTransport()
+  transport.set("hostA:1", lambda m, p, b, h: _good_render("s"))
+  transport.set("hostB:1", lambda m, p, b, h: _good_render("s"))
+  router = Router({"a": "hostA:1", "b": "hostB:1"}, replication=1,
+                  route_cell=0.05, transport=transport, clock=FakeClock())
+  sid = "hot"
+  rng = np.random.default_rng(7)
+  served = set()
+  for _ in range(24):
+    pose = np.eye(4, dtype=np.float32)
+    pose[:3, 3] = rng.uniform(-1.0, 1.0, 3).astype(np.float32)
+    req = {"scene_id": sid, "pose": pose.tolist()}
+    cell = router.request_cell(req)
+    assert cell is not None
+    status, headers, _ = router.forward_render(
+        sid, json.dumps(req).encode(), cell=cell)
+    assert status == 200
+    served.add(headers["X-Backend-Id"])
+  # One scene, replication 1: without cell keys ONE backend serves
+  # everything; with them both backends took cells.
+  assert served == {"a", "b"}
+  snap = router.metrics.snapshot()
+  assert snap["cell_routes"] == 24
+  assert 0 < snap["cell_reroutes"] < 24
+  # Same cell -> same placement (determinism the edge caches rely on).
+  pose = np.eye(4, dtype=np.float32)
+  req = {"scene_id": sid, "pose": pose.tolist()}
+  assert (router.request_cell(req) == router.request_cell(req))
+  # Malformed/missing poses ride the scene-level key (the backend owns
+  # the 400; the router must not fail in placement math).
+  assert router.request_cell({"scene_id": sid, "pose": "junk"}) is None
+  assert router.request_cell({"scene_id": sid}) is None
+  off = Router({"a": "hostA:1"}, transport=transport, clock=FakeClock())
+  assert off.request_cell(req) is None  # routing off: scene-level key
+
+
 def test_router_load_aware_ignores_stale_depths():
   transport = FakeTransport()
   transport.set("hostA:1", lambda m, p, b, h: _good_render("s"))

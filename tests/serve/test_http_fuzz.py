@@ -69,6 +69,10 @@ BAD_BODIES = [
     json.dumps({"scene_id": None, "pose": np.eye(4).tolist()}).encode(),
     json.dumps({"scene_id": "scene_000",
                 "pose": [[float("nan")] * 4] * 4}).encode(),   # non-finite
+    # Control chars (esp. \x1f, the tile/ring key separator —
+    # serve/tiles.py) must never reach the dispatcher as a scene id.
+    json.dumps({"scene_id": "scene_000\x1ft0,0",
+                "pose": np.eye(4).tolist()}).encode(),
     b"\xff\xfe garbage \x00\x01" * 16,             # binary junk
 ]
 

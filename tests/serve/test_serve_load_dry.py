@@ -146,6 +146,39 @@ def test_serve_load_edge_ab_dry_smoke():
   assert "edge" not in out["edge_off"]
 
 
+def test_serve_load_tiled_ab_dry_smoke():
+  """The tile-granular A/B smoke: one depth-stratified scene served
+  through the tiled (frustum-culled) path and the monolithic path, one
+  JSON line. Pins the contract — both arms' headline fields, the tile
+  accounting (the pose pool MUST have culled tiles or the workload is
+  broken), and the bit-exact full-coverage parity — NOT a dry-mode
+  speedup: on 32-px toy scenes the per-request plan/concat overhead
+  dominates and the render-cost win only shows at real sizes (recorded
+  per BENCH round)."""
+  # --duration 1: the contract (parity + cull accounting) needs poses
+  # served, not a long window — tier-1 seconds are the scarce resource.
+  out = _run_dry(["--tiled-ab", "--duration", "1"])
+  assert out["metric"] == "serve_load_tiled_ab" and out["dry"] is True
+  assert out["device"] == "cpu"
+  # The pinned parity: the bench itself aborts (non-zero exit) when the
+  # full-coverage pose is not bit-exact, so reaching here with the flag
+  # set true is the end-to-end proof; the culled poses must stay at
+  # float-rounding scale (conservative frustum + zero-padded sampling).
+  assert out["parity"]["full_coverage_bit_exact"] is True
+  assert out["parity"]["culled_pose_max_abs_diff"] <= 1e-4
+  assert out["p50_ms_tiled"] > 0 and out["p50_ms_full"] > 0
+  assert out["value"] and out["value"] > 0
+  tiles = out["tiled"]["tiles"]
+  assert tiles["tiled_requests"] > 0
+  # The panning pose pool must actually exercise the cull: some tiles
+  # culled, and the mean touched strictly inside (0, total).
+  assert tiles["culled_total"] > 0
+  assert 0 < out["tiles_touched_mean"] < out["tiles_total"]
+  assert out["tiled"]["tile_cache"]["misses"] >= 1  # per-tile bakes ran
+  assert out["full"]["requests"] > 0 and out["tiled"]["requests"] > 0
+  assert "tiles" not in out["full"]
+
+
 def test_serve_load_cluster_dry_smoke():
   """The multi-host tier's tier-1 smoke: spawn real backend processes,
   route through the cluster Router, SIGKILL one backend mid-window, and

@@ -1,0 +1,411 @@
+"""Tile-granular scenes (serve/tiles.py) end to end.
+
+The acceptance pins from the tiling issue live here:
+
+  (1) **bit-exact full-frustum parity** — a pose whose frustum touches
+      every tile renders bit-identically through the tiled service and
+      the monolithic one (the crop is the whole scene, the correction
+      is skipped, the jit signature is shared);
+  (2) **conservative culling** — a pose whose frustum touches a strict
+      subset of tiles renders the same pixels to float-rounding scale
+      (the cropped sampler taps the same source pixels; out-of-crop
+      taps were zero-padded either way);
+  (3) **partial reload** — a live ``swap_scenes`` where ONE tile's
+      bytes changed swaps only that tile: untouched tiles keep their
+      baked cache entries (same resident objects), and edge frames
+      that never sampled a changed tile survive WITH their strong
+      ETags (revalidation still answers 304);
+  (4) **tile-granular placement** — ``(scene, tile)`` ring keys are
+      deterministic, spread one scene over many backends, and a ring
+      resize moves only the keys the new backend actually takes.
+
+Scene geometry: 16x16, 4 planes, tile 8 (a 2x2 grid) with a narrow-FOV
+camera (fx = 2w), so a ±0.35 rad pan views ONE tile column — small
+enough that every compile is toy-sized, structured enough that culling,
+plane masks, and tile-addressed invalidation all engage.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+from mpi_vision_tpu.core import camera
+from mpi_vision_tpu.core.sampling import Convention
+from mpi_vision_tpu.serve import RenderService
+from mpi_vision_tpu.serve import cache as cache_mod
+from mpi_vision_tpu.serve import tiles as tiles_mod
+from mpi_vision_tpu.serve.cluster.ring import HashRing
+from mpi_vision_tpu.serve.edge import EdgeConfig
+from mpi_vision_tpu.serve.server import synthetic_tiled_scene
+
+H = W = 16
+P = 4
+TILE = 8  # 2x2 grid
+
+
+def _scene(seed=0):
+  layers, depths, _ = synthetic_tiled_scene(
+      "s", height=H, width=W, planes=P, regions=2, seed=seed)
+  # Narrow FOV (fx = 2w): a +-0.35 rad pan shifts taps by ~0.73w, so
+  # the frustum walks off one tile column entirely (margin included).
+  k = np.asarray(camera.intrinsics_matrix(2.0 * W, 2.0 * W, W / 2.0,
+                                          H / 2.0), np.float32)
+  return layers, depths, k
+
+
+def _pan(theta):
+  c, s = math.cos(theta), math.sin(theta)
+  pose = np.eye(4, dtype=np.float32)
+  pose[0, 0], pose[0, 2], pose[2, 0], pose[2, 2] = c, s, -s, c
+  return pose
+
+
+# Frustum shapes the module's tests share: identity touches everything;
+# the pans each view one tile column of the 2x2 grid.
+POSE_FULL = np.eye(4, dtype=np.float32)
+POSE_RIGHT = _pan(-0.35)  # views the right tile column only
+POSE_LEFT = _pan(0.35)    # views the left tile column only
+
+
+# --- TileGrid / TileSignature / TileMeta (host-side, no engine) ----------
+
+
+def test_tile_grid_rect_and_ragged_edges():
+  grid = tiles_mod.TileGrid(20, 16, 8)  # ragged last row
+  assert (grid.rows, grid.cols, len(grid)) == (3, 2, 6)
+  assert grid.rect(0, 0) == (0, 8, 0, 8)
+  assert grid.rect(2, 1) == (16, 20, 8, 16)  # clipped to the scene
+  with pytest.raises(ValueError):
+    tiles_mod.TileGrid(16, 16, 0)
+
+
+def test_signature_token_round_trips():
+  layers, depths, k = _scene()
+  meta = tiles_mod.TileMeta.build(layers, depths, k, TILE)
+  for pose in (POSE_FULL, POSE_RIGHT, POSE_LEFT):
+    sig = meta.plan(pose[None])
+    back = tiles_mod.TileSignature.parse(sig.token(), meta.grid)
+    assert back == sig
+
+
+def test_frustum_cull_marks_one_column_for_a_narrow_pan():
+  layers, depths, k = _scene()
+  meta = tiles_mod.TileMeta.build(layers, depths, k, TILE)
+  assert meta.touched(POSE_FULL[None]).all()
+  right = meta.touched(POSE_RIGHT[None])
+  left = meta.touched(POSE_LEFT[None])
+  # Each pan sees exactly one tile column; between them they disagree
+  # on every column, which is what the partial-reload pins rely on.
+  assert right[:, 1].all() and not right[:, 0].any()
+  assert left[:, 0].all() and not left[:, 1].any()
+  # The signature's crop snaps to the touched column.
+  assert meta.signature(right).crop == (0, H, TILE, W)
+  assert meta.signature(left).crop == (0, H, 0, TILE)
+
+
+def test_changed_tiles_diffs_per_tile_and_geometry_changes_all():
+  layers, depths, k = _scene()
+  meta = tiles_mod.TileMeta.build(layers, depths, k, TILE)
+  same = tiles_mod.TileMeta.build(layers.copy(), depths, k, TILE)
+  assert meta.changed_tiles(same) == []
+  touched = layers.copy()
+  touched[0:TILE, TILE:W, :, :3] += 0.125  # tile (0, 1) rgb only
+  assert meta.changed_tiles(
+      tiles_mod.TileMeta.build(touched, depths, k, TILE)) == [(0, 1)]
+  # A geometry change (intrinsics) retires every tile id AND changes
+  # the scene digest (the _edge_put swap-race guard must refuse frames
+  # rendered with the old camera even when no pixel byte moved).
+  k2 = k.copy()
+  k2[0, 0] *= 2.0
+  geo = tiles_mod.TileMeta.build(layers, depths, k2, TILE)
+  assert len(meta.changed_tiles(geo)) == 4
+  assert geo.scene_digest != meta.scene_digest
+  assert same.scene_digest == meta.scene_digest
+
+
+def test_ragged_sliver_crop_pulls_in_a_neighbor_tile():
+  # A 20px-tall scene with tile 8 has a 4px ragged last row; a frustum
+  # touching ONLY that row must not produce a 4px crop (the REF
+  # conventions' tap affine degenerates below ~2px and bookkeeping
+  # below 8) — the signature widens into the neighboring tile row.
+  layers = np.zeros((20, 16, P, 4), np.float32)
+  layers[..., 3] = 1.0
+  depths = np.linspace(10.0, 1.0, P).astype(np.float32)
+  k = np.asarray(camera.intrinsics_matrix(32.0, 32.0, 8.0, 10.0),
+                 np.float32)
+  meta = tiles_mod.TileMeta.build(layers, depths, k, 8)
+  touched = np.zeros((meta.grid.rows, meta.grid.cols), bool)
+  touched[2, :] = True  # the ragged 4px row only
+  sig = meta.signature(touched)
+  y0, y1, x0, x1 = sig.crop
+  assert y1 - y0 >= 8 and (y0, y1) == (8, 20)
+  assert sig.tiles_rendered == 4  # both rows of the widened crop
+  # Round-trips through the batch key like any other signature.
+  assert tiles_mod.TileSignature.parse(sig.token(), meta.grid) == sig
+
+
+def test_per_tile_depth_range_follows_content():
+  layers, depths, k = _scene()
+  layers = layers.copy()
+  # Tile (0, 0): content only on plane 2 (plus the 1-px neighbour
+  # dilation band, silenced here by zeroing a 1-px halo too).
+  layers[:TILE + 1, :TILE + 1, :, 3] = 0.0
+  layers[:TILE - 1, :TILE - 1, 2, 3] = 1.0
+  meta = tiles_mod.TileMeta.build(layers, depths, k, TILE)
+  lo, hi = meta.depth_range(0, 0)
+  assert lo == hi == float(depths[2])
+  layers[:TILE + 1, :TILE + 1, :, 3] = 0.0
+  meta2 = tiles_mod.TileMeta.build(layers, depths, k, TILE)
+  assert meta2.depth_range(0, 0) is None  # empty tile
+
+
+# --- tile-granular ring placement ----------------------------------------
+
+
+TILES_6X6 = [(i, j) for i in range(6) for j in range(6)]
+
+
+def test_tile_placement_deterministic_and_spreads_one_scene():
+  a = HashRing(["x", "y", "z"], replication=2)
+  b = HashRing(["z", "x", "y"], replication=2)  # insertion order differs
+  for t in TILES_6X6:
+    assert a.placement("hot", tile=t) == b.placement("hot", tile=t)
+    assert len(set(a.placement("hot", tile=t))) == 2
+  # The point of (scene, tile) keys: ONE hot scene's tiles land on
+  # every backend instead of pinning the scene-level primary.
+  assert {a.primary("hot", tile=t) for t in TILES_6X6} == {"x", "y", "z"}
+  # Tile keys cannot collide with scene-level keys by construction.
+  assert a.placement_key("hot", (1, 2)) != a.placement_key("hot")
+
+
+def test_tile_placement_on_ring_resize_moves_only_the_taken_keys():
+  before = HashRing(["a", "b", "c"], replication=2)
+  grown = HashRing(["a", "b", "c", "d"], replication=2)
+  moved = 0
+  for t in TILES_6X6:
+    if "d" not in grown.placement("hot", tile=t):
+      assert grown.placement("hot", tile=t) == before.placement("hot",
+                                                                tile=t)
+    else:
+      moved += 1
+  assert 0 < moved < len(TILES_6X6)  # d took some tiles, not the scene
+  shrunk = HashRing(["a", "b", "c", "d"], replication=2)
+  shrunk.remove("d")
+  for t in TILES_6X6:
+    assert shrunk.placement("hot", tile=t) == before.placement("hot",
+                                                               tile=t)
+
+
+# --- tile LRU byte accounting --------------------------------------------
+
+
+def _fake_tile(key: str, nbytes: int) -> cache_mod.BakedScene:
+  return cache_mod.BakedScene(key, rgba_layers=None, depths=None,
+                              intrinsics=None, nbytes=nbytes)
+
+
+def test_tile_lru_accounts_and_evicts_per_tile():
+  cache = cache_mod.SceneCache(byte_budget=300)
+  for j in range(3):
+    key = tiles_mod.tile_cache_key("s", 0, j)
+    cache.get_or_bake(key, lambda k=key: _fake_tile(k, 100))
+  stats = cache.stats()
+  assert stats["bytes"] == 300 and stats["scenes"] == 3
+  # One more tile: the LRU (tile 0,0) is evicted, bytes stay exact.
+  cache.get_or_bake(tiles_mod.tile_cache_key("s", 0, 3),
+                    lambda: _fake_tile(tiles_mod.tile_cache_key("s", 0, 3),
+                                       100))
+  stats = cache.stats()
+  assert stats["bytes"] == 300 and stats["evictions"] == 1
+  assert cache.get(tiles_mod.tile_cache_key("s", 0, 0)) is None
+  assert cache.get(tiles_mod.tile_cache_key("s", 0, 1)) is not None
+  # Per-tile invalidation subtracts exactly that tile's bytes...
+  assert cache.invalidate(tiles_mod.tile_cache_key("s", 0, 1))
+  assert cache.stats()["bytes"] == 200
+  # ...and the prefix sweep (grid-changing reloads) drops the rest of
+  # the scene's tiles without touching other scenes.
+  cache.get_or_bake("other", lambda: _fake_tile("other", 50))
+  assert cache.invalidate_prefix("s" + tiles_mod.KEY_SEP) == 2
+  stats = cache.stats()
+  assert stats["bytes"] == 50 and stats["scenes"] == 1
+
+
+# --- the tiled service: parity, batching, partial reload -----------------
+
+
+@pytest.fixture(scope="module")
+def scene_data():
+  return _scene(seed=3)
+
+
+@pytest.fixture(scope="module")
+def tiled_svc(scene_data):
+  layers, depths, k = scene_data
+  service = RenderService(
+      max_batch=2, max_wait_ms=2.0, use_mesh=False, tile=TILE,
+      edge=EdgeConfig(trans_cell=0.02, rot_bucket_deg=2.0,
+                      byte_budget=64 << 20))
+  service.add_scene("s", layers, depths, k)
+  yield service
+  service.close()
+
+
+@pytest.fixture(scope="module")
+def mono_svc(scene_data):
+  layers, depths, k = scene_data
+  service = RenderService(max_batch=2, max_wait_ms=2.0, use_mesh=False)
+  service.add_scene("s", layers, depths, k)
+  yield service
+  service.close()
+
+
+def test_full_frustum_render_is_bit_exact(tiled_svc, mono_svc):
+  # The identity pose touches every tile and keeps every plane: the
+  # assembled crop IS the scene, no correction is applied, and the
+  # render must be bit-identical to the monolithic path.
+  tiled = tiled_svc.render("s", POSE_FULL, timeout=60)
+  mono = mono_svc.render("s", POSE_FULL, timeout=60)
+  assert tiled.tobytes() == mono.tobytes()
+  tiles = tiled_svc.stats()["tiles"]
+  assert tiles["tiled_requests"] >= 1
+  assert tiles["touched_total"] >= 4  # all four tiles counted
+
+
+def test_culled_render_matches_to_float_rounding(tiled_svc, mono_svc):
+  # A one-column frustum renders a genuine crop (half the pixels, the
+  # column's plane set); the sampler taps the same source pixels, so
+  # the only daylight vs the monolithic render is float rounding in
+  # the crop-corrected homography chain.
+  for pose in (POSE_RIGHT, POSE_LEFT):
+    tiled = tiled_svc.render("s", pose, timeout=60)
+    mono = mono_svc.render("s", pose, timeout=60)
+    assert tiled.shape == mono.shape  # full target frame either way
+    assert float(np.abs(tiled - mono).max()) <= 1e-4
+  tiles = tiled_svc.stats()["tiles"]
+  assert tiles["culled_total"] >= 4  # two tiles culled per pan pose
+  # The culled plans really were smaller: the per-tile cache baked
+  # tiles, and the crop memo holds distinct per-signature crops.
+  assert tiled_svc.stats()["tile_cache"]["misses"] >= 2
+
+
+def test_unknown_scene_404_contract_survives_tiling(tiled_svc):
+  with pytest.raises(KeyError):
+    tiled_svc.render("nope", POSE_FULL, timeout=60)
+  with pytest.raises(KeyError):
+    tiled_svc.render_edge("nope", POSE_FULL, timeout=60)
+
+
+def test_tiled_service_guards(scene_data):
+  layers, depths, k = scene_data
+  # fused_pallas cannot render cropped sources: fail at construction,
+  # not as per-request 500s on the first culled pose.
+  with pytest.raises(ValueError, match="XLA method"):
+    RenderService(tile=TILE, method="fused_pallas", use_mesh=False)
+  with pytest.raises(ValueError, match="tile must be >= 8"):
+    RenderService(tile=4, use_mesh=False)
+  # The key separator can never become part of a scene id.
+  svc = RenderService(max_batch=2, use_mesh=False, tile=TILE)
+  try:
+    with pytest.raises(ValueError, match="x1f"):
+      svc.add_scene("s" + tiles_mod.KEY_SEP + "t0,0", layers, depths, k)
+  finally:
+    svc.close()
+
+
+def test_partial_reload_swaps_only_the_changed_tile(scene_data):
+  layers, depths, k = scene_data
+  svc = RenderService(
+      max_batch=2, max_wait_ms=2.0, use_mesh=False, tile=TILE,
+      edge=EdgeConfig(trans_cell=0.02, rot_bucket_deg=2.0,
+                      byte_budget=64 << 20))
+  svc.add_scene("s", layers, depths, k)
+  try:
+    # Populate: every tile baked, one edge frame per frustum shape.
+    _, info_full = svc.render_edge("s", POSE_FULL, timeout=60)
+    left_img, info_left = svc.render_edge("s", POSE_LEFT, timeout=60)
+    _, info_right = svc.render_edge("s", POSE_RIGHT, timeout=60)
+    assert info_left["etag"] and info_right["etag"]
+    resident_before = {key: entry for key, entry
+                       in svc._tile_cache._scenes.items()}
+    assert len(resident_before) == 4
+
+    # Live reload where ONE tile's bytes changed: tile (0, 1) — the
+    # right column POSE_RIGHT sampled and POSE_LEFT provably did not.
+    changed = layers.copy()
+    changed[0:TILE, TILE:W, :, :3] = np.clip(
+        changed[0:TILE, TILE:W, :, :3] + 0.125, 0.0, 1.0)
+    svc.swap_scenes({"s": (changed, depths, k)}, prebake=False)
+
+    # The baked-tile cache swapped ONLY tile (0, 1): the other three
+    # entries are the SAME resident objects, byte accounting intact.
+    after = dict(svc._tile_cache._scenes)
+    changed_key = tiles_mod.tile_cache_key("s", 0, 1)
+    assert changed_key not in after  # re-bakes lazily on next touch
+    for key, entry in after.items():
+      assert entry is resident_before[key]
+    assert svc._tile_cache.stats()["invalidations"] == 1
+
+    # Edge tier: the left-column frame never sampled the changed tile,
+    # so it survives WITH its strong ETag — revalidation still answers
+    # 304 — while the full-coverage and right-column frames (both
+    # sampled it) are gone, and a fresh right render shows new pixels.
+    assert svc.edge_revalidate("s", POSE_LEFT,
+                          if_none_match=info_left["etag"]) is not None
+    assert svc.edge_revalidate("s", POSE_RIGHT,
+                          if_none_match=info_right["etag"]) is None
+    assert svc.edge_revalidate("s", POSE_FULL,
+                          if_none_match=info_full["etag"]) is None
+    img_left2, info_left2 = svc.render_edge("s", POSE_LEFT, timeout=60)
+    assert info_left2["edge"] == "hit"
+    assert info_left2["etag"] == info_left["etag"]
+    assert img_left2.tobytes() == left_img.tobytes()
+    _, info_right2 = svc.render_edge("s", POSE_RIGHT, timeout=60)
+    assert info_right2["edge"] == "miss"
+    assert info_right2["etag"] != info_right["etag"]
+
+    # A no-op swap (identical bytes) invalidates nothing at all.
+    svc.swap_scenes({"s": (changed, depths, k)}, prebake=False)
+    assert svc._tile_cache.stats()["invalidations"] == 1
+    assert svc.edge_revalidate("s", POSE_LEFT,
+                          if_none_match=info_left["etag"]) is not None
+  finally:
+    svc.close()
+
+
+def test_swap_event_carries_per_scene_tiles_changed(scene_data):
+  layers, depths, k = scene_data
+  svc = RenderService(max_batch=2, max_wait_ms=2.0, use_mesh=False,
+                      tile=TILE)
+  svc.add_scene("s", layers, depths, k)
+  try:
+    changed = layers.copy()
+    changed[0:TILE, 0:TILE, :, :3] = np.clip(
+        changed[0:TILE, 0:TILE, :, :3] + 0.25, 0.0, 1.0)
+    svc.swap_scenes({"s": (changed, depths, k)}, prebake=False)
+    swaps = svc.events.snapshot(kind="scene_swap")["events"]
+    assert swaps and swaps[-1]["tiles_changed"] == {"s": 1}
+  finally:
+    svc.close()
+
+
+def test_tiled_service_plays_with_exact_convention(scene_data):
+  # Non-square-safe path: the planner must reproduce whatever
+  # convention the engine renders with (EXACT here), full coverage
+  # staying bit-exact against a monolithic EXACT service.
+  layers, depths, k = scene_data
+  svc_t = RenderService(max_batch=2, max_wait_ms=2.0, use_mesh=False,
+                        tile=TILE, convention=Convention.EXACT)
+  svc_m = RenderService(max_batch=2, max_wait_ms=2.0, use_mesh=False,
+                        convention=Convention.EXACT)
+  svc_t.add_scene("s", layers, depths, k)
+  svc_m.add_scene("s", layers, depths, k)
+  try:
+    assert svc_t.render("s", POSE_FULL, timeout=60).tobytes() == \
+        svc_m.render("s", POSE_FULL, timeout=60).tobytes()
+    assert float(np.abs(svc_t.render("s", POSE_RIGHT, timeout=60)
+                        - svc_m.render("s", POSE_RIGHT,
+                                       timeout=60)).max()) <= 1e-4
+  finally:
+    svc_t.close()
+    svc_m.close()

@@ -243,6 +243,46 @@ def test_train_queue_bad_knobs_rejected(argv, msg):
     cli.main(argv)
 
 
+def test_serve_tile_knobs_guarded():
+  """Tile knobs only act through the tiled registry (serve/tiles.py);
+  silently serving monolithic scenes would drop the frustum culling
+  the operator asked for."""
+  with pytest.raises(SystemExit, match=r"require\(s\) --tiled"):
+    cli.main(["serve", "--tile-size", "64", "--duration", "0.1"])
+  with pytest.raises(SystemExit, match="--tile-size must be >= 8"):
+    cli.main(["serve", "--tiled", "--tile-size", "4", "--duration", "0.1"])
+
+
+def test_cluster_route_cell_knobs_guarded():
+  """The rotation bucket only acts through cell routing; dangling it
+  would silently keep scene-level placement."""
+  with pytest.raises(SystemExit, match="--route-rot-bucket-deg requires"):
+    cli.main(["cluster", "--backends", "1", "--route-rot-bucket-deg", "5"])
+  with pytest.raises(SystemExit, match="--route-cell must be"):
+    cli.main(["cluster", "--backends", "1", "--route-cell", "-1"])
+  with pytest.raises(SystemExit, match="--route-rot-bucket-deg must be"):
+    cli.main(["cluster", "--backends", "1", "--route-cell", "0.1",
+              "--route-rot-bucket-deg", "0"])
+
+
+def test_train_queue_metrics_port_knobs_guarded(tmp_path):
+  """Same contract as train's: the port file is only written by the
+  listener, so dangling it would hang whatever waits on the file."""
+  with pytest.raises(SystemExit, match="--metrics-port-file requires"):
+    cli.main(["train-queue", "--root", str(tmp_path / "q"),
+              "--metrics-port-file", str(tmp_path / "p")])
+  with pytest.raises(SystemExit, match="--metrics-port must be"):
+    cli.main(["train-queue", "--root", str(tmp_path / "q"),
+              "--metrics-port", "-1"])
+
+
+def test_ship_sink_knobs_guarded(tmp_path):
+  with pytest.raises(SystemExit):  # --dir is required
+    cli.main(["ship-sink"])
+  with pytest.raises(SystemExit, match="--port must be"):
+    cli.main(["ship-sink", "--dir", str(tmp_path / "b"), "--port", "-1"])
+
+
 def test_train_queue_bad_job_id_rejected(tmp_path):
   """Bad or duplicate job ids fail the same validate-at-the-door way as
   every other knob — a clean SystemExit, not a traceback."""

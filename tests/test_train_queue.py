@@ -469,6 +469,42 @@ def test_queue_registry_families(tmp_path):
   assert "mpi_train_queue_quarantines_total 0" in text
 
 
+def test_queue_metrics_server_scrape_surface(tmp_path):
+  """The ``train-queue --metrics-port`` listener: /metrics renders the
+  mpi_train_queue_* registry the supervisor already builds, /stats the
+  snapshot, /healthz the drain/quarantine headline — over real HTTP."""
+  import json as json_mod
+  import threading
+  import urllib.request
+
+  from mpi_vision_tpu.train.supervisor import make_queue_metrics_server
+
+  clock, queue, launcher, sup, events = _sup(tmp_path)
+  queue.submit({}, job_id="a")
+  sup.tick()
+  server = make_queue_metrics_server(sup, events=events)
+  threading.Thread(target=server.serve_forever, daemon=True).start()
+  base = f"http://127.0.0.1:{server.server_address[1]}"
+  try:
+    with urllib.request.urlopen(base + "/metrics", timeout=5) as resp:
+      text = resp.read().decode()
+    assert "mpi_train_queue_spawns_total 1" in text
+    with urllib.request.urlopen(base + "/stats", timeout=5) as resp:
+      stats = json_mod.loads(resp.read())
+    assert stats["spawns"] == 1 and "queue" in stats
+    with urllib.request.urlopen(base + "/healthz", timeout=5) as resp:
+      health = json_mod.loads(resp.read())
+    assert health["status"] == "ok" and health["role"] == "train-queue"
+    assert health["running"] == 1 and health["drained"] is False
+    with urllib.request.urlopen(base + "/debug/events?recent=8",
+                                timeout=5) as resp:
+      ev = json_mod.loads(resp.read())
+    assert ev["emitted"] >= 1
+  finally:
+    server.shutdown()
+    server.server_close()
+
+
 # --- the subprocess launcher's argv (no spawn) ----------------------------
 
 

@@ -45,7 +45,13 @@ single kill for the self-healing drill: the fleet supervisor
 (``serve/cluster/supervisor.py``) runs over the pool and one backend is
 killed every time it comes back up until its ``--restart-budget``
 quarantines it; the JSON then records restarts, containment (the
-quarantine), and post-quarantine throughput.
+quarantine), and post-quarantine throughput. ``--chaos-router`` is the
+router-HA drill: TWO router replica processes (gossip peers behind one
+on-disk supervision lease) front the pool, the supervising router is
+SIGKILLed under live load on the other, and the JSON records the pinned
+arc — zero failed requests on the survivor, the bounded lease takeover,
+and a backend killed AFTER the takeover still respawned through the new
+leader's restart webhook. ``--chaos-router --dry`` is the tier-1 smoke.
 
 ``--tiled-ab`` measures the tile-granular serving path
 (``serve/tiles.py``): the SAME closed-loop load over ONE high-res
@@ -190,6 +196,15 @@ def build_parser() -> argparse.ArgumentParser:
                   help="supervisor restarts allowed before the "
                        "crash-looping backend is quarantined "
                        "(--chaos-crashloop)")
+  ap.add_argument("--chaos-router", action="store_true",
+                  help="router-HA drill (--cluster): TWO router "
+                       "replicas (gossip peers, shared supervision "
+                       "lease) front the pool; the supervising router "
+                       "is SIGKILLed under live load — traffic on the "
+                       "survivor must not fail, the lease must be "
+                       "taken over, and a backend killed AFTER the "
+                       "takeover must still be respawned (through the "
+                       "new leader's restart hook)")
   return ap
 
 
@@ -493,6 +508,396 @@ def cluster_main(args) -> int:
   finally:
     if supervisor is not None:
       supervisor.stop()
+    pool.close()
+
+
+def _free_port() -> int:
+  import socket
+
+  s = socket.socket()
+  try:
+    s.bind(("127.0.0.1", 0))
+    return s.getsockname()[1]
+  finally:
+    s.close()
+
+
+def _http_json(url: str, timeout: float = 5.0) -> dict:
+  import urllib.request
+
+  with urllib.request.urlopen(url, timeout=timeout) as resp:
+    return json.loads(resp.read().decode())
+
+
+def _metric_value(url: str, family: str, timeout: float = 5.0) -> float:
+  """One un-labelled sample from a Prometheus exposition (0.0 if absent)."""
+  import re
+  import urllib.request
+
+  with urllib.request.urlopen(url, timeout=timeout) as resp:
+    text = resp.read().decode()
+  m = re.search(rf"^{re.escape(family)}(?:{{}})? ([0-9.eE+-]+)$", text,
+                re.MULTILINE)
+  return float(m.group(1)) if m else 0.0
+
+
+class _RestartHookServer:
+  """The bench-side half of the remote restart webhook: the router's
+  RemoteBackendPool shells out to a helper that POSTs the backend id
+  here, and THIS process (the one owning the BackendPool) respawns it
+  on its old port — the k8s-operator shape with the bench as operator."""
+
+  def __init__(self, pool):
+    import http.server
+    from urllib.parse import parse_qs, urlparse
+
+    outer = self
+    self.pool = pool
+    self.invocations = 0
+    self.failures = 0
+    self._lock = threading.Lock()
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+      def do_POST(self):  # noqa: N802 - stdlib naming
+        bid = (parse_qs(urlparse(self.path).query).get("backend")
+               or [""])[0]
+        try:
+          outer.pool.restart(bid)
+        except Exception as e:  # noqa: BLE001 - reported to the hook
+          with outer._lock:
+            outer.failures += 1
+          self.send_response(500)
+          self.end_headers()
+          self.wfile.write(repr(e).encode())
+          return
+        with outer._lock:
+          outer.invocations += 1
+        self.send_response(200)
+        self.end_headers()
+        self.wfile.write(b"ok")
+
+      def log_message(self, *a):  # noqa: ARG002 - quiet
+        pass
+
+    self.httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    self.port = self.httpd.server_address[1]
+    self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                    daemon=True)
+    self._thread.start()
+
+  def close(self) -> None:
+    self.httpd.shutdown()
+    self._thread.join(10)
+
+
+_HOOK_HELPER = """\
+import sys
+import urllib.parse
+import urllib.request
+
+req = urllib.request.Request(
+    "http://127.0.0.1:{port}/restart?backend="
+    + urllib.parse.quote(sys.argv[1]),
+    data=b"", method="POST")
+with urllib.request.urlopen(req, timeout=180) as resp:
+    body = resp.read()
+sys.exit(0 if resp.status == 200 else 1)
+"""
+
+
+def _spawn_router(node_id: str, port: int, peer_port: int, backends: dict,
+                  lease_dir: str, hook_cmd: str, workdir: str,
+                  env: dict):
+  """One router replica subprocess: --join over the shared pool,
+  --supervise behind the shared file lease, gossiping with its peer.
+  Returns (popen, log_path)."""
+  import subprocess
+
+  log_path = os.path.join(workdir, f"{node_id}.log")
+  argv = [
+      sys.executable, "-m", "mpi_vision_tpu", "cluster",
+      "--join", ",".join(addr for _, addr in sorted(backends.items())),
+      "--host", "127.0.0.1", "--port", str(port),
+      "--node-id", node_id,
+      "--peers", f"127.0.0.1:{peer_port}",
+      "--gossip-interval-s", "0.2",
+      "--supervise",
+      "--lease-dir", lease_dir,
+      "--lease-ttl-s", "1.0",
+      "--restart-hook", hook_cmd,
+      "--restart-hook-timeout-s", "180",
+      "--probe-s", "0.2", "--wedge-after", "2",
+      "--restart-budget", "3", "--restart-window-s", "600",
+      "--replication", "2",
+      "--breaker-threshold", "2", "--breaker-reset-s", "60",
+      "--render-timeout-s", "60", "--retry-budget", "1.0",
+  ]
+  log_fh = open(log_path, "ab")
+  try:
+    popen = subprocess.Popen(argv, stdout=log_fh, stderr=log_fh, env=env)
+  finally:
+    log_fh.close()
+  return popen, log_path
+
+
+def _await_router(name: str, popen, url: str, log_path: str,
+                  deadline_s: float = 120.0) -> None:
+  t0 = time.perf_counter()
+  while time.perf_counter() - t0 < deadline_s:
+    if popen.poll() is not None:
+      break
+    try:
+      if _http_json(url + "/healthz", timeout=2.0).get("status") \
+          in ("ok", "degraded"):
+        return
+    except (OSError, ValueError):
+      pass
+    time.sleep(0.1)
+  tail = ""
+  try:
+    with open(log_path, "rb") as fh:
+      tail = fh.read()[-2000:].decode(errors="replace")
+  except OSError:
+    pass
+  raise SystemExit(f"serve_load: router {name} not healthy "
+                   f"within {deadline_s:.0f}s:\n{tail}")
+
+
+def _lease_owner(url: str) -> "str | None":
+  """The FRESH supervision-lease holder as this router reports it."""
+  try:
+    lease = _http_json(url + "/healthz", timeout=2.0).get(
+        "supervision_lease")
+  except (OSError, ValueError):
+    return None
+  if not isinstance(lease, dict) or not lease.get("fresh"):
+    return None
+  return lease.get("owner")
+
+
+def chaos_router_main(args) -> int:
+  """The router-HA drill (--cluster --chaos-router): two router replica
+  PROCESSES — gossip peers sharing one on-disk supervision lease — front
+  one backend pool, with restarts flowing through a remote webhook back
+  to this process (the pool's owner). Under live load on the standby
+  router, the supervising router is SIGKILLed: the pinned arc is zero
+  failed requests on the survivor, a bounded lease takeover, and a
+  backend killed AFTER the takeover still being respawned — by the NEW
+  leader, through the hook. One serve_load JSON line with a
+  ``cluster.chaos_router`` block carrying the whole arc."""
+  import shlex
+  import signal as signal_mod
+  import tempfile
+  import urllib.error
+  import urllib.request
+
+  from mpi_vision_tpu.serve.cluster import BackendPool
+
+  env = dict(os.environ)
+  env.setdefault("JAX_PLATFORMS", "cpu")
+  pool = BackendPool(
+      args.cluster_backends, scenes=args.scenes, img_size=args.img_size,
+      planes=args.num_planes, seed=args.seed, env=env, log=_log)
+  hook_server = None
+  routers = {}  # node_id -> (popen, log_path, url)
+  tmpdir = tempfile.mkdtemp(prefix="serve_load_chaos_router_")
+  phase_deadline_s = 45.0 if args.dry else 300.0
+  try:
+    _log(f"serve_load: spawning {args.cluster_backends} backend(s) "
+         f"[{args.scenes} scenes {args.img_size}x{args.img_size}"
+         f"x{args.num_planes}]")
+    backends = pool.start()
+    ids = pool.scene_ids()
+
+    hook_server = _RestartHookServer(pool)
+    helper = os.path.join(tmpdir, "restart_hook.py")
+    with open(helper, "w") as fh:
+      fh.write(_HOOK_HELPER.format(port=hook_server.port))
+    hook_cmd = f"{shlex.quote(sys.executable)} {shlex.quote(helper)}"
+    lease_dir = os.path.join(tmpdir, "lease")
+    os.makedirs(lease_dir, exist_ok=True)
+
+    port_a, port_b = _free_port(), _free_port()
+    # Leader first: routerA claims the lease before routerB exists, so
+    # the drill's roles are deterministic (A supervises, B is standby).
+    popen_a, log_a = _spawn_router("routerA", port_a, port_b, backends,
+                                   lease_dir, hook_cmd, tmpdir, env)
+    url_a = f"http://127.0.0.1:{port_a}"
+    _await_router("routerA", popen_a, url_a, log_a)
+    routers["routerA"] = (popen_a, log_a, url_a)
+    t0 = time.perf_counter()
+    while _lease_owner(url_a) != "routerA":
+      if time.perf_counter() - t0 > phase_deadline_s:
+        raise SystemExit("serve_load: routerA never acquired the "
+                         "supervision lease")
+      time.sleep(0.1)
+    popen_b, log_b = _spawn_router("routerB", port_b, port_a, backends,
+                                   lease_dir, hook_cmd, tmpdir, env)
+    url_b = f"http://127.0.0.1:{port_b}"
+    _await_router("routerB", popen_b, url_b, log_b)
+    routers["routerB"] = (popen_b, log_b, url_b)
+    _log(f"serve_load: routerA (leader) on {url_a}, "
+         f"routerB (survivor) on {url_b}")
+
+    # Closed-loop load against the SURVIVOR only: its router process
+    # never dies, so every failure it returns counts against the pin.
+    stop = threading.Event()
+    counts = [0] * args.concurrency
+    post_kill_counts = [0] * args.concurrency
+    router_killed = threading.Event()
+    failure_counts: collections.Counter = collections.Counter()
+    failure_lock = threading.Lock()
+
+    def worker(idx: int) -> None:
+      rng = np.random.default_rng(args.seed + 1 + idx)
+      while not stop.is_set():
+        sid = ids[0] if (rng.random() < 0.5 or len(ids) == 1) \
+            else ids[int(rng.integers(1, len(ids)))]
+        body = json.dumps({"scene_id": sid,
+                           "pose": random_pose(rng).tolist()}).encode()
+        req = urllib.request.Request(
+            url_b + "/render", data=body,
+            headers={"Content-Type": "application/json"})
+        try:
+          with urllib.request.urlopen(req, timeout=60) as resp:
+            resp.read()
+            status = resp.status
+        except urllib.error.HTTPError as e:
+          with failure_lock:
+            failure_counts[f"http_{e.code}"] += 1
+          time.sleep(0.005)
+          continue
+        except Exception as e:  # noqa: BLE001 - chaos is the workload
+          with failure_lock:
+            failure_counts[type(e).__name__] += 1
+          time.sleep(0.005)
+          continue
+        if status != 200:
+          with failure_lock:
+            failure_counts[f"http_{status}"] += 1
+          continue
+        counts[idx] += 1
+        if router_killed.is_set():
+          post_kill_counts[idx] += 1
+
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+               for i in range(args.concurrency)]
+    load_t0 = time.perf_counter()
+    for t in threads:
+      t.start()
+    time.sleep(args.duration / 4)  # clean phase: both routers up
+
+    # Phase 1: SIGKILL the supervising router (no drain, no lease
+    # release — a host loss). The survivor must observe the stale lease
+    # and take over supervision without dropping its own traffic.
+    _log("serve_load: SIGKILL routerA (the supervision leader)")
+    popen_a.send_signal(signal_mod.SIGKILL)
+    popen_a.wait(30)
+    router_killed.set()
+    takeover_t0 = time.perf_counter()
+    takeover_s = None
+    while time.perf_counter() - takeover_t0 < phase_deadline_s:
+      if _lease_owner(url_b) == "routerB":
+        takeover_s = time.perf_counter() - takeover_t0
+        break
+      time.sleep(0.1)
+    _log("serve_load: lease "
+         + (f"taken over by routerB after {takeover_s:.2f}s"
+            if takeover_s is not None
+            else "NOT taken over before the drill deadline"))
+
+    # Phase 2: only meaningful after a takeover — kill a backend and
+    # prove the NEW leader still heals the fleet through the hook.
+    victim = None
+    respawned = False
+    respawn_s = None
+    if takeover_s is not None:
+      victim = sorted(backends)[0]
+      _log(f"serve_load: SIGKILL backend {victim} (the new leader must "
+           "respawn it via the restart hook)")
+      pool.kill(victim)
+      respawn_t0 = time.perf_counter()
+      while time.perf_counter() - respawn_t0 < phase_deadline_s:
+        if hook_server.invocations >= 1 and pool.alive(victim):
+          respawned = True
+          respawn_s = time.perf_counter() - respawn_t0
+          break
+        time.sleep(0.1)
+      _log(f"serve_load: {victim} "
+           + (f"respawned via hook after {respawn_s:.2f}s" if respawned
+              else "NOT respawned before the drill deadline"))
+    time.sleep(args.duration / 4)  # measured tail on the healed fleet
+    stop.set()
+    for t in threads:
+      t.join(60)
+    elapsed = time.perf_counter() - load_t0
+
+    total = sum(counts)
+    if total == 0:
+      raise SystemExit("serve_load: no requests completed in the window")
+    health = _http_json(url_b + "/healthz", timeout=10.0)
+    stats = _http_json(url_b + "/stats", timeout=10.0)
+    takeovers_total = _metric_value(
+        url_b + "/metrics", "mpi_cluster_supervisor_takeovers_total",
+        timeout=10.0)
+    lease_held = _metric_value(
+        url_b + "/metrics", "mpi_cluster_supervisor_lease_held",
+        timeout=10.0)
+    gossip = stats.get("gossip") or {}
+    rps = total / elapsed
+    record = {
+        "metric": "serve_load",
+        "value": round(rps, 3),
+        "unit": "renders/s",
+        "renders_per_sec": round(rps, 3),
+        "requests": total,
+        "concurrency": args.concurrency,
+        "dry": bool(args.dry),
+        "chaos": False,
+        "cluster": {
+            "backends": len(backends),
+            "replication": 2,
+            "failed_requests": dict(sorted(failure_counts.items())),
+            "post_kill_requests": sum(post_kill_counts),
+            "health": health.get("status"),
+            "chaos_router": {
+                "routers": 2,
+                "killed_router": "routerA",
+                "survivor": "routerB",
+                "lease_taken_over": takeover_s is not None,
+                "takeover_s": (round(takeover_s, 3)
+                               if takeover_s is not None else None),
+                "takeovers_total": takeovers_total,
+                "lease_held": lease_held,
+                "lease_owner": _lease_owner(url_b),
+                "backend_killed": victim,
+                "backend_respawned": respawned,
+                "respawn_s": (round(respawn_s, 3)
+                              if respawn_s is not None else None),
+                "hook_invocations": hook_server.invocations,
+                "hook_failures": hook_server.failures,
+                "gossip": {
+                    "rounds": gossip.get("rounds"),
+                    "peers": {p: e.get("ok")
+                              for p, e in (gossip.get("peers")
+                                           or {}).items()},
+                },
+            },
+        },
+    }
+    print(json.dumps(record))
+    return 0
+  finally:
+    for node_id, (popen, _, _) in routers.items():
+      if popen.poll() is None:
+        popen.terminate()
+    for node_id, (popen, _, _) in routers.items():
+      try:
+        popen.wait(30)
+      except Exception:  # noqa: BLE001 - last resort below
+        popen.kill()
+    if hook_server is not None:
+      hook_server.close()
     pool.close()
 
 
@@ -979,6 +1384,12 @@ def main(argv=None) -> int:
   if args.chaos_crashloop and not args.cluster:
     raise SystemExit("--chaos-crashloop drills the multi-host tier; "
                      "add --cluster")
+  if args.chaos_router and not args.cluster:
+    raise SystemExit("--chaos-router drills the multi-host tier; "
+                     "add --cluster")
+  if args.chaos_router and args.chaos_crashloop:
+    raise SystemExit("--chaos-router and --chaos-crashloop are separate "
+                     "drills; run them in separate rounds")
   if args.cluster:
     if args.ab or args.edge_ab:
       raise SystemExit("--ab/--edge-ab measure the in-process path; "
@@ -989,6 +1400,8 @@ def main(argv=None) -> int:
                        "'--edge-cache' via the cluster CLI instead")
     if args.dry:
       args.duration = max(args.duration, 4.0)  # give the kill phase room
+    if args.chaos_router:
+      return chaos_router_main(args)
     return cluster_main(args)
   if args.edge_ab:
     if args.chaos or args.ab:

@@ -501,7 +501,9 @@ def cmd_serve(args: argparse.Namespace) -> dict:
         ("--edge-rot-bucket-deg", args.edge_rot_bucket_deg is not None),
         ("--edge-warp-trans", args.edge_warp_trans is not None),
         ("--edge-warp-rot-deg", args.edge_warp_rot_deg is not None),
-        ("--edge-max-age-s", args.edge_max_age_s is not None)) if on]
+        ("--edge-max-age-s", args.edge_max_age_s is not None),
+        ("--edge-negative-ttl-s", args.edge_negative_ttl_s is not None),
+    ) if on]
     if wants_edge:
       raise SystemExit(f"{', '.join(wants_edge)} require(s) --edge-cache")
   if args.event_log_max_bytes > 0 and not args.event_log:
@@ -613,7 +615,10 @@ def cmd_serve(args: argparse.Namespace) -> dict:
                           else defaults.warp_max_rot_deg),
         max_age_s=(args.edge_max_age_s
                    if args.edge_max_age_s is not None
-                   else defaults.max_age_s))
+                   else defaults.max_age_s),
+        negative_ttl_s=(args.edge_negative_ttl_s
+                        if args.edge_negative_ttl_s is not None
+                        else defaults.negative_ttl_s))
   profile_hook = None
   if args.profile_hook:
     import shlex
@@ -1087,7 +1092,12 @@ def cmd_cluster(args: argparse.Namespace) -> dict:
   from mpi_vision_tpu.obs import Tracer
   from mpi_vision_tpu.serve.cluster import (
       BackendPool,
+      FileLease,
       FleetSupervisor,
+      GossipLease,
+      GossipNode,
+      GossipState,
+      RemoteBackendPool,
       Router,
       make_router_http_server,
   )
@@ -1096,12 +1106,51 @@ def cmd_cluster(args: argparse.Namespace) -> dict:
     raise SystemExit(
         "cluster needs exactly one of --backends N (spawn a local pool) "
         "or --join host:port,... (front existing backends)")
-  if (args.supervise or args.rolling_restart) and not args.backends:
-    # Supervision needs process control; --join fronts backends some
-    # other supervisor (k8s, systemd) owns.
+  if args.rolling_restart and not args.backends:
+    # A rolling restart needs process control; --join fronts backends
+    # some other supervisor (k8s, systemd) owns. --supervise on --join
+    # IS allowed: it degrades to remote health watching + an optional
+    # restart webhook (RemoteBackendPool).
     raise SystemExit(
-        "--supervise/--rolling-restart require --backends (a local pool "
-        "this process can kill and respawn)")
+        "--rolling-restart require --backends (a local pool this "
+        "process can kill and respawn)")
+  if args.restart_hook is not None and not args.supervise:
+    raise SystemExit("--restart-hook requires --supervise (the hook is "
+                     "only invoked by the supervisor's restart path)")
+  if args.restart_hook is not None and args.backends:
+    raise SystemExit(
+        "--restart-hook requires --join (a local pool respawns its own "
+        "children; the webhook is for fleets this process cannot spawn)")
+  if args.restart_hook_timeout_s is not None:
+    if args.restart_hook is None:
+      raise SystemExit(
+          "--restart-hook-timeout-s requires --restart-hook")
+    if args.restart_hook_timeout_s <= 0:
+      raise SystemExit(f"--restart-hook-timeout-s must be > 0, "
+                       f"got {args.restart_hook_timeout_s}")
+  if args.lease_dir is not None and not args.supervise:
+    raise SystemExit("--lease-dir requires --supervise (the lease "
+                     "elects which router replica supervises)")
+  if args.lease_ttl_s is not None:
+    if not args.supervise:
+      raise SystemExit("--lease-ttl-s requires --supervise")
+    if args.lease_ttl_s <= 0:
+      raise SystemExit(
+          f"--lease-ttl-s must be > 0, got {args.lease_ttl_s}")
+  peers = []
+  if args.peers is not None:
+    peers = [p.strip() for p in args.peers.split(",") if p.strip()]
+    if not peers:
+      raise SystemExit(f"--peers parsed no addresses from {args.peers!r}")
+  if args.gossip_interval_s is not None:
+    if not peers:
+      raise SystemExit("--gossip-interval-s requires --peers")
+    if args.gossip_interval_s <= 0:
+      raise SystemExit(f"--gossip-interval-s must be > 0, "
+                       f"got {args.gossip_interval_s}")
+  if args.node_id is not None and not (peers or args.supervise):
+    raise SystemExit("--node-id requires --peers or --supervise (it "
+                     "names this router in gossip and on the lease)")
   if args.restart_budget < 1:
     raise SystemExit(
         f"--restart-budget must be >= 1, got {args.restart_budget}")
@@ -1168,24 +1217,62 @@ def cmd_cluster(args: argparse.Namespace) -> dict:
                               if args.route_rot_bucket_deg is not None
                               else 10.0),
         metrics_ttl_s=args.metrics_ttl_ms / 1e3, tracer=tracer)
+    node_id = (args.node_id if args.node_id is not None
+               else f"router-{os.getpid()}")
+    lease_ttl_s = (args.lease_ttl_s if args.lease_ttl_s is not None
+                   else 5.0)
+    gossip_node = None
+    gossip_state = None
+    if peers:
+      gossip_state = GossipState(node_id, lease_ttl_s=lease_ttl_s)
+      gossip_node = GossipNode(
+          gossip_state, peers,
+          interval_s=(args.gossip_interval_s
+                      if args.gossip_interval_s is not None else 1.0),
+          events=router.events, metrics=router.metrics,
+          on_merge=router.apply_gossip_observations, log=_log)
+      router.set_gossip(gossip_node)
     if args.supervise or args.rolling_restart:
       # Lifecycle decisions share the router's event log so one
       # /debug/events stream tells the whole fleet story. The monitor
       # loop runs in BOTH modes: a rolling step whose respawn fails
       # defers recovery to the monitor, so --rolling-restart without it
       # would strand that backend down for the rest of the run.
+      lease = None
+      if args.lease_dir is not None:
+        lease = FileLease(
+            os.path.join(args.lease_dir, "supervisor.lease"),
+            owner=node_id, ttl_s=lease_ttl_s)
+      elif gossip_state is not None:
+        lease = GossipLease(gossip_state, owner=node_id)
+      if lease is not None:
+        router.set_lease(lease)
+      sup_pool = pool if pool is not None else RemoteBackendPool(
+          backends, restart_hook=args.restart_hook,
+          hook_timeout_s=(args.restart_hook_timeout_s
+                          if args.restart_hook_timeout_s is not None
+                          else 30.0),
+          log=_log)
       supervisor = FleetSupervisor(
-          pool, router=router, events=router.events,
+          sup_pool, router=router, events=router.events,
           probe_s=args.probe_s, wedge_after=args.wedge_after,
           restart_budget=args.restart_budget,
-          budget_window_s=args.restart_window_s, log=_log)
+          budget_window_s=args.restart_window_s, log=_log,
+          lease=lease, gossip=gossip_state)
       supervisor.start()
       _log(f"cluster: supervisor on (probe every {args.probe_s:g}s, "
            f"budget {args.restart_budget} restarts / "
            f"{args.restart_window_s:g}s, wedge after {args.wedge_after} "
            "failed probes"
+           + ("" if pool is not None else "; remote fleet"
+              + (", restart hook armed" if args.restart_hook else ""))
            + ("" if args.supervise else "; implied by --rolling-restart")
+           + (f"; lease owner {node_id}" if lease is not None else "")
            + ")")
+    if gossip_node is not None:
+      gossip_node.start()
+      _log(f"cluster: gossiping with {len(peers)} peer(s) as {node_id} "
+           f"every {gossip_node.interval_s:g}s")
     httpd = make_router_http_server(router, host=args.host, port=args.port)
     port = httpd.server_address[1]
     if args.port_file:
@@ -1227,7 +1314,9 @@ def cmd_cluster(args: argparse.Namespace) -> dict:
       stop_event.wait(args.duration if args.duration > 0 else None)
     finally:
       if supervisor is not None:
-        supervisor.stop()
+        supervisor.stop()  # releases the lease: peers take over fast
+      if gossip_node is not None:
+        gossip_node.stop()
       httpd.shutdown()
       router.close()
       for sig, handler in previous_handlers.items():
@@ -1245,6 +1334,8 @@ def cmd_cluster(args: argparse.Namespace) -> dict:
         "router": snap,
         **({"supervisor": supervisor.snapshot()}
            if supervisor is not None else {}),
+        **({"gossip": gossip_node.snapshot()}
+           if gossip_node is not None else {}),
         **({"rolling_restart": rolling_report}
            if rolling_report is not None else {}),
         **({"traces": tracer.finished} if tracer is not None else {}),
@@ -1532,6 +1623,12 @@ def build_parser() -> argparse.ArgumentParser:
                  help="Cache-Control: max-age on /render responses "
                       "(default 5) — how long browsers/CDNs may reuse a "
                       "frame without revalidating")
+  s.add_argument("--edge-negative-ttl-s", type=float, default=None,
+                 help="negative-cache TTL in seconds (default 0 = off): "
+                      "a render shed queue-full plants a short-lived "
+                      "negative entry on its view cell so repeats fail "
+                      "fast with 503 + Retry-After instead of "
+                      "re-entering the saturated queue")
   s.add_argument("--alert-hook", default="",
                  help="run this command on every SLO alert fire/clear "
                       "edge with the slo_alert event appended to its "
@@ -1774,11 +1871,45 @@ def build_parser() -> argparse.ArgumentParser:
                  help="points retained per series in the router ring; "
                       "requires --tsdb-interval-s")
   c.add_argument("--supervise", action="store_true",
-                 help="run the self-healing supervisor over the spawned "
-                      "pool: /healthz probes, crashed/wedged backends "
-                      "respawned on their old port with exponential "
-                      "backoff, crash-loopers quarantined (requires "
-                      "--backends)")
+                 help="run the self-healing supervisor: /healthz probes, "
+                      "crashed/wedged backends respawned on their old "
+                      "port with exponential backoff, crash-loopers "
+                      "quarantined. With --join the supervisor has no "
+                      "process handles and degrades to remote health "
+                      "watching (DOWN/eject/quarantine/readmit semantics "
+                      "identical) plus the optional --restart-hook")
+  c.add_argument("--peers", default=None,
+                 help="comma-separated host:port list of PEER routers "
+                      "fronting the same fleet; health/eject/quarantine "
+                      "observations and supervision-lease claims spread "
+                      "by periodic anti-entropy gossip over /gossip")
+  c.add_argument("--node-id", default=None,
+                 help="this router's name in gossip and on the "
+                      "supervision lease (default router-<pid>); "
+                      "requires --peers or --supervise")
+  c.add_argument("--gossip-interval-s", type=float, default=None,
+                 help="anti-entropy round period (default 1.0); "
+                      "requires --peers")
+  c.add_argument("--lease-dir", default=None,
+                 help="directory for the on-disk supervision lease "
+                      "shared by co-located router replicas (exactly "
+                      "one holds it; a dead holder is reaped after "
+                      "--lease-ttl-s); requires --supervise. Without "
+                      "it, --peers + --join carry the lease in gossip")
+  c.add_argument("--lease-ttl-s", type=float, default=None,
+                 help="heartbeat staleness that lets a peer reap the "
+                      "supervision lease (default 5.0); requires "
+                      "--supervise")
+  c.add_argument("--restart-hook", default=None,
+                 help="command (shlex argv; backend id + address "
+                      "appended) the remote supervisor runs to restart "
+                      "a joined backend — the k8s-operator analogue; "
+                      "nonzero exits are counted restart failures, "
+                      "never fatal; requires --join --supervise")
+  c.add_argument("--restart-hook-timeout-s", type=float, default=None,
+                 help="kill the restart hook after this long (default "
+                      "30; a real respawn behind the webhook can be "
+                      "slow — size this to it); requires --restart-hook")
   c.add_argument("--probe-s", type=float, default=1.0,
                  help="supervisor health-probe period")
   c.add_argument("--wedge-after", type=int, default=3,

@@ -306,6 +306,13 @@ def serve_registry(stats: dict,
             "edge cache.", edge.get("bytes", 0))
   reg.gauge(p + "edge_frames", "Rendered frames resident in the edge "
             "cache.", edge.get("frames", 0))
+  reg.counter(p + "edge_negative_hits_total",
+              "Requests shed fast by a live negative entry (view cell "
+              "known queue-full within its negative TTL).",
+              edge.get("negative_hits", 0))
+  reg.gauge(p + "edge_negative_entries",
+            "Live negative entries (view cells recently shed "
+            "queue-full).", edge.get("negative_entries", 0))
   # Tile-granular serving (serve/tiles.py): frustum-cull outcomes + the
   # per-tile baked cache. Always exposed (zeros while --tiled is off).
   tiles = stats.get("tiles") or {}

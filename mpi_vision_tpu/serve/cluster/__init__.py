@@ -10,13 +10,27 @@ testable and benchable on one CPU box (``python -m mpi_vision_tpu
 cluster``; ``bench/serve_load.py --cluster``); ``supervisor`` is the
 self-healing layer over both — health probing, crash/wedge detection,
 budgeted restarts with crash-loop quarantine, and rolling restarts under
-live traffic. Live checkpoint reload
+live traffic; ``gossip`` + ``lease`` replicate the control plane itself —
+N peered routers exchange versioned health/quarantine observations over
+``/gossip`` and exactly one holds the supervision lease at a time, with
+takeover adopting the dead leader's budget state (the router-HA tier).
+Live checkpoint reload
 rides the backends themselves (``serve --ckpt --reload-ckpt-s N``,
 ``ckpt.watch.CheckpointWatcher``) — the router needs no coordination to
 benefit: scenes swap in place under the same ids.
 """
 
-from mpi_vision_tpu.serve.cluster.pool import BackendPool, BackendSpawnError
+from mpi_vision_tpu.serve.cluster.gossip import GossipNode, GossipState
+from mpi_vision_tpu.serve.cluster.lease import (
+    FileLease,
+    GossipLease,
+    SupervisionLeaseLost,
+)
+from mpi_vision_tpu.serve.cluster.pool import (
+    BackendPool,
+    BackendSpawnError,
+    RemoteBackendPool,
+)
 from mpi_vision_tpu.serve.cluster.ring import HashRing
 from mpi_vision_tpu.serve.cluster.router import (
     AllReplicasOpenError,
@@ -35,13 +49,19 @@ __all__ = [
     "AllReplicasOpenError",
     "BackendPool",
     "BackendSpawnError",
+    "FileLease",
     "FleetSupervisor",
+    "GossipLease",
+    "GossipNode",
+    "GossipState",
     "HashRing",
     "HttpTransport",
+    "RemoteBackendPool",
     "ReplicasExhaustedError",
     "RetryBudgetExhaustedError",
     "Router",
     "RouterMetrics",
+    "SupervisionLeaseLost",
     "make_router_http_server",
     "make_traceparent",
     "new_trace_id_32",

@@ -289,3 +289,106 @@ class BackendPool:
 
   def __exit__(self, *exc):
     self.close()
+
+
+class RemoteBackendPool:
+  """Pool facade over backends some OTHER system owns (``--join``).
+
+  A joined fleet has no local process handles, so supervision degrades
+  gracefully to the k8s-operator shape: liveness is the PROBER's
+  judgment (``alive()`` always answers True — a remote corpse shows up
+  as ``unreachable`` probes, walks the same wedge counter, and is
+  declared DOWN with identical eject/quarantine/readmit semantics), and
+  ``restart()`` either invokes an operator-supplied webhook
+  (``restart_hook``: a shlex-split argv run with the backend id and
+  address appended — the analogue of poking a k8s Deployment) or is a
+  no-op that leaves recovery to whatever owns the process, with the
+  next probe pass deciding the truth either way. Hook failures raise —
+  the supervisor counts them as restart failures and keeps looping;
+  they are never fatal.
+  """
+
+  def __init__(self, backends: dict, restart_hook: str | None = None,
+               hook_timeout_s: float = 30.0, runner=None, log=None):
+    if not backends:
+      raise ValueError("RemoteBackendPool needs at least one backend")
+    if hook_timeout_s <= 0:
+      raise ValueError(
+          f"hook_timeout_s must be > 0, got {hook_timeout_s}")
+    self._backends = {str(b): str(a) for b, a in backends.items()}
+    self.restart_hook = restart_hook
+    self._hook_argv = (None if restart_hook is None
+                       else _shlex_split(restart_hook))
+    if self._hook_argv is not None and not self._hook_argv:
+      raise ValueError("restart_hook must not be empty")
+    self.hook_timeout_s = float(hook_timeout_s)
+    self._runner = runner if runner is not None else subprocess.run
+    self._log = log if log is not None else (lambda msg: None)
+    self.hook_invocations = 0
+    self.hook_failures = 0
+
+  def addresses(self) -> dict[str, str]:
+    return dict(self._backends)
+
+  def alive(self, backend_id: str) -> bool:
+    # No process handle: liveness is the health probe's judgment, and
+    # the probe already runs every tick. Answering False here would
+    # short-circuit the wedge counter with information we don't have.
+    return str(backend_id) in self._backends
+
+  def kill(self, backend_id: str, sig=signal.SIGKILL) -> None:
+    # Nothing local to kill; the hook (if any) owns the remote process.
+    self._log(f"remote pool: kill({backend_id}) is a no-op on a "
+              "joined fleet")
+
+  def restart(self, backend_id: str) -> str:
+    """Nudge the remote owner. With a hook: run it (nonzero exit or
+    spawn failure raises — counted by the supervisor, never fatal).
+    Without: a no-op 'restart' — probes decide recovery next tick."""
+    backend_id = str(backend_id)
+    address = self._backends.get(backend_id)
+    if address is None:
+      raise KeyError(f"unknown backend {backend_id!r}")
+    if self._hook_argv is None:
+      self._log(f"remote pool: no --restart-hook; leaving {backend_id} "
+                "to its owner (probes decide recovery)")
+      return address
+    argv = self._hook_argv + [backend_id, address]
+    self.hook_invocations += 1
+    try:
+      result = self._runner(argv, timeout=self.hook_timeout_s,
+                            capture_output=True)
+      rc = result.returncode
+    except Exception as e:  # noqa: BLE001 - a broken hook is a failed spawn
+      self.hook_failures += 1
+      raise BackendSpawnError(
+          f"restart hook {argv[0]!r} failed for {backend_id}: {e!r}")
+    if rc != 0:
+      self.hook_failures += 1
+      raise BackendSpawnError(
+          f"restart hook {argv[0]!r} exited {rc} for {backend_id}")
+    self._log(f"remote pool: restart hook ok for {backend_id}")
+    return address
+
+  def snapshot(self) -> dict:
+    return {
+        "backends": dict(self._backends),
+        "restart_hook": self.restart_hook,
+        "hook_invocations": self.hook_invocations,
+        "hook_failures": self.hook_failures,
+    }
+
+  def close(self) -> None:
+    pass  # nothing owned, nothing to reap
+
+  def __enter__(self):
+    return self
+
+  def __exit__(self, *exc):
+    self.close()
+
+
+def _shlex_split(cmd: str) -> list[str]:
+  import shlex
+
+  return shlex.split(cmd)

@@ -161,6 +161,12 @@ class RouterMetrics:
     self.retry_budget_exhausted = 0
     self.cell_routes = 0
     self.cell_reroutes = 0
+    self.gossip_rounds = 0
+    self.gossip_merges = 0
+    self.gossip_conflicts = 0
+    self.gossip_peer_failures = 0
+    self.supervisor_lease_held = 0
+    self.supervisor_takeovers = 0
 
   def record_request(self) -> None:
     with self._lock:
@@ -214,6 +220,29 @@ class RouterMetrics:
     with self._lock:
       self.retry_budget_exhausted += 1
 
+  def record_gossip_round(self) -> None:
+    with self._lock:
+      self.gossip_rounds += 1
+
+  def record_gossip_merge(self, merges: int, conflicts: int) -> None:
+    with self._lock:
+      self.gossip_merges += merges
+      self.gossip_conflicts += conflicts
+
+  def record_gossip_peer_failure(self) -> None:
+    with self._lock:
+      self.gossip_peer_failures += 1
+
+  def record_lease_held(self, held: bool) -> None:
+    """Whether THIS router currently holds the supervision lease."""
+    with self._lock:
+      self.supervisor_lease_held = 1 if held else 0
+
+  def record_takeover(self) -> None:
+    """This router adopted supervision from a dead/wedged peer."""
+    with self._lock:
+      self.supervisor_takeovers += 1
+
   def record_cell_route(self, rerouted: bool) -> None:
     """One request placed by its ``(scene, view-cell)`` ring key;
     ``rerouted`` when that key's primary differs from the scene-level
@@ -241,6 +270,12 @@ class RouterMetrics:
           "retry_budget_exhausted": self.retry_budget_exhausted,
           "cell_routes": self.cell_routes,
           "cell_reroutes": self.cell_reroutes,
+          "gossip_rounds": self.gossip_rounds,
+          "gossip_merges": self.gossip_merges,
+          "gossip_conflicts": self.gossip_conflicts,
+          "gossip_peer_failures": self.gossip_peer_failures,
+          "supervisor_lease_held": self.supervisor_lease_held,
+          "supervisor_takeovers": self.supervisor_takeovers,
       }
 
 
@@ -403,6 +438,8 @@ class Router:
     else:
       self.tsdb = None
     self._closed = False
+    self.gossip = None  # GossipNode, via set_gossip (router peering)
+    self.lease = None  # supervision lease, via set_lease
     if backends:
       items = (backends.items() if isinstance(backends, dict)
                else ((f"b{i}", addr) for i, addr in enumerate(backends)))
@@ -487,6 +524,46 @@ class Router:
   def backend_ids(self) -> list[str]:
     with self._lock:
       return sorted(self._backends)
+
+  # -- router peering (gossip + supervision lease) ------------------------
+
+  def set_gossip(self, node) -> None:
+    """Attach the anti-entropy gossip node (the CLI wires this; the
+    node's ``on_merge`` should be ``apply_gossip_observations``)."""
+    self.gossip = node
+
+  def set_lease(self, lease) -> None:
+    """Attach the supervision lease so /stats and /healthz can report
+    the current holder (the supervisor drives the lease itself)."""
+    self.lease = lease
+
+  def gossip_exchange(self, remote: dict) -> dict:
+    """The /gossip endpoint body: merge the peer's push, answer with
+    this router's state (push-pull in one round trip)."""
+    if self.gossip is None:
+      raise KeyError("gossip is not enabled on this router")
+    return self.gossip.receive(remote)
+
+  def apply_gossip_observations(self, backend_ids) -> None:
+    """Fold adopted gossip verdicts into this router's own rotation: a
+    peer-observed quarantine/eject takes the backend out WITHOUT this
+    router spending breaker probes on the corpse, and a peer-observed
+    recovery readmits it. Only administrative flags move — breakers
+    stay local judgment."""
+    if self.gossip is None:
+      return
+    for backend_id in backend_ids:
+      obs = self.gossip.state.observation(backend_id)
+      if obs is None:
+        continue
+      fields = obs["fields"]
+      if fields.get("quarantined"):
+        self.eject(backend_id, reason="quarantined (gossip)")
+      elif fields.get("ejected"):
+        self.eject(backend_id,
+                   reason=fields.get("reason") or "ejected (gossip)")
+      else:
+        self.readmit(backend_id)
 
   # -- load awareness -----------------------------------------------------
 
@@ -923,6 +1000,12 @@ class Router:
         "breakers": {b: breakers[b] for b in sorted(breakers)},
         "ejected": ejected,
     }
+    if self.gossip is not None:
+      gsnap = self.gossip.snapshot()
+      out["peers"] = {p: e["ok"] for p, e in gsnap["peers"].items()}
+      out["supervision_lease"] = gsnap["lease"]
+    if self.lease is not None:
+      out["supervision_lease"] = self.lease.holder()
     if reason is not None:
       out["reason"] = reason
     return out
@@ -949,6 +1032,10 @@ class Router:
     }
     if self.retry_budget is not None:
       out["retry_budget"] = self.retry_budget.snapshot()
+    if self.gossip is not None:
+      out["gossip"] = self.gossip.snapshot()
+    if self.lease is not None:
+      out["supervision_lease"] = self.lease.holder()
     return out
 
   @staticmethod
@@ -1114,6 +1201,25 @@ class Router:
                 "Cell-keyed placements whose primary differed from the "
                 "scene-level primary (affinity moved the request).",
                 snap["cell_reroutes"])
+    reg.counter(p + "gossip_rounds_total",
+                "Anti-entropy gossip rounds this router initiated.",
+                snap["gossip_rounds"])
+    reg.counter(p + "gossip_merges_total",
+                "Peer observations adopted by newest-wins merge.",
+                snap["gossip_merges"])
+    reg.counter(p + "gossip_conflicts_total",
+                "Equal-version gossip disagreements (broken "
+                "deterministically by origin id).",
+                snap["gossip_conflicts"])
+    reg.counter(p + "gossip_peer_failures_total",
+                "Gossip rounds that could not reach a peer router.",
+                snap["gossip_peer_failures"])
+    reg.gauge(p + "supervisor_lease_held",
+              "1 while this router holds the fleet-supervision lease.",
+              snap["supervisor_lease_held"])
+    reg.counter(p + "supervisor_takeovers_total",
+                "Supervision leases adopted from a dead or wedged peer "
+                "router.", snap["supervisor_takeovers"])
     if self.retry_budget is not None:
       reg.gauge(p + "retry_budget_tokens",
                 "Failover tokens currently in the retry budget.",
@@ -1289,12 +1395,42 @@ class _RouterHandler(BaseHTTPRequestHandler):
       self._send_json({"error": f"unknown path {self.path}"}, status=404)
 
   def do_POST(self):  # noqa: N802 - stdlib name
+    if self.path == "/gossip":
+      self._do_gossip()
+      return
     if self.path != "/render":
       self._send_json({"error": f"unknown path {self.path}"}, status=404)
       return
     inbound_tid = _inbound_trace_id(self.headers)
     trace_id = inbound_tid or new_trace_id_32()
     tid_hdr = {"X-Trace-Id": trace_id}
+    return self._do_render(trace_id, tid_hdr)
+
+  def _do_gossip(self) -> None:
+    """POST /gossip: a peer pushes its state, the reply is ours (one
+    push-pull round trip). 404 when peering is off — a bare router is
+    indistinguishable from one predating the endpoint."""
+    try:
+      length = int(self.headers.get("Content-Length", "0"))
+      if not 0 <= length <= _MAX_BODY_BYTES:
+        raise ValueError(f"bad body length ({length} bytes)")
+      remote = json.loads(self.rfile.read(length) or b"{}")
+      if not isinstance(remote, dict):
+        raise ValueError("gossip body must be a JSON object")
+    except (TypeError, ValueError, json.JSONDecodeError) as e:
+      self._send_json({"error": f"bad gossip: {e}"}, status=400)
+      return
+    except (BrokenPipeError, ConnectionResetError):
+      self.close_connection = True
+      return
+    try:
+      reply = self.router.gossip_exchange(remote)
+    except KeyError as e:
+      self._send_json({"error": str(e)}, status=404)
+      return
+    self._send_json(reply)
+
+  def _do_render(self, trace_id, tid_hdr) -> None:
     try:
       length = int(self.headers.get("Content-Length", "0"))
       if not 0 <= length <= _MAX_BODY_BYTES:

@@ -102,6 +102,16 @@ class FleetSupervisor:
       when no HTTP conversation happened.
     clock / sleep: injectable time sources (the serve/-wide lint rule).
     log: diagnostics sink (None = silent).
+    lease: optional supervision lease (``lease.FileLease`` /
+      ``lease.GossipLease``) — every tick must hold it before probing,
+      so exactly one of N router replicas supervises at a time; losing
+      it (``SupervisionLeaseLost``) demotes this supervisor to standby,
+      and acquiring one marked ``takeover`` adopts the previous
+      leader's gossiped budget/quarantine state first (a crash-looper
+      cannot reset its countdown by outliving its supervisor).
+    gossip: optional ``gossip.GossipState`` this supervisor publishes
+      its per-backend observations into (and adopts them from on
+      takeover).
   """
 
   UP = "up"
@@ -115,7 +125,8 @@ class FleetSupervisor:
                budget_window_s: float = 60.0, backoff_base_s: float = 0.5,
                backoff_mult: float = 2.0, backoff_max_s: float = 15.0,
                load_refresh_s: float = 2.0, transport=None,
-               clock=time.monotonic, sleep=None, log=None):
+               clock=time.monotonic, sleep=None, log=None,
+               lease=None, gossip=None):
     if probe_s <= 0:
       raise ValueError(f"probe_s must be > 0, got {probe_s}")
     if wedge_after < 1:
@@ -167,6 +178,10 @@ class FleetSupervisor:
     self.tick_errors = 0
     self.restarts_total = 0
     self.quarantines_total = 0
+    self.lease = lease
+    self.gossip = gossip
+    self._lease_held = False
+    self.takeovers_total = 0
 
   # -- state access --------------------------------------------------------
 
@@ -212,6 +227,8 @@ class FleetSupervisor:
           "wedge_after": self.wedge_after,
           "restart_budget": self.restart_budget,
           "budget_window_s": self.budget_window_s,
+          "lease_held": self._lease_held,
+          "takeovers": self.takeovers_total,
           "backends": backends,
       }
 
@@ -250,6 +267,8 @@ class FleetSupervisor:
     with self._op_lock:
       with self._lock:
         self.ticks += 1
+      if not self._ensure_lease():
+        return  # standby replica: a peer supervises; just keep trying
       for backend_id, address in sorted(self.pool.addresses().items()):
         st = self._state_for(backend_id)
         if st.state == self.QUARANTINED:
@@ -277,6 +296,101 @@ class FleetSupervisor:
               st.last_reason if st.state == self.DOWN
               else f"wedged: {status} x{failures}")
       self._refresh_router_load()
+      self._publish_observations()
+
+  # -- leased supervision (router HA) --------------------------------------
+
+  def _ensure_lease(self) -> bool:
+    """Hold (or try to take) the supervision lease; False = standby.
+
+    Heartbeats every tick while held; ``SupervisionLeaseLost`` demotes
+    to standby (a peer reaped a wedged heartbeat — it supervises now).
+    Acquiring a lease marked ``takeover`` adopts the dead leader's
+    gossiped observations BEFORE the first probe pass, so in-window
+    budget spends and quarantine verdicts survive the handoff.
+    """
+    if self.lease is None:
+      if not self._lease_held:
+        self._lease_held = True
+        if self.router is not None:
+          self.router.metrics.record_lease_held(True)
+      return True
+    from mpi_vision_tpu.serve.cluster.lease import SupervisionLeaseLost
+
+    if self._lease_held:
+      try:
+        self.lease.heartbeat()
+        return True
+      except SupervisionLeaseLost as e:
+        self._lease_held = False
+        if self.router is not None:
+          self.router.metrics.record_lease_held(False)
+        self.events.emit("supervision_lease_lost", owner=self.lease.owner,
+                         error=str(e))
+        self._log(f"supervisor: lease lost, standing by: {e}")
+        return False
+    got = self.lease.try_acquire()
+    if got is None:
+      return False
+    self._lease_held = True
+    if self.router is not None:
+      self.router.metrics.record_lease_held(True)
+    if got.get("takeover"):
+      with self._lock:
+        self.takeovers_total += 1
+      if self.router is not None:
+        self.router.metrics.record_takeover()
+      self.events.emit("supervision_takeover", owner=self.lease.owner,
+                       previous=got.get("previous"))
+      self._log(f"supervisor: TOOK OVER supervision from "
+                f"{got.get('previous')}")
+      self._adopt_observations()
+    else:
+      self.events.emit("supervision_lease_acquired",
+                       owner=self.lease.owner)
+      self._log("supervisor: supervision lease acquired")
+    return True
+
+  def _adopt_observations(self) -> None:
+    """Seed local supervision state from gossiped observations (the
+    no-budget-reset half of takeover): in-window budget spends travel
+    as ages re-aged by the observation's own staleness, and a gossiped
+    quarantine verdict stays quarantined + ejected here."""
+    if self.gossip is None:
+      return
+    now = self.gossip.now()
+    for backend_id, obs in sorted(self.gossip.observations().items()):
+      fields = obs["fields"]
+      st = self._state_for(backend_id)
+      staleness = max(0.0, now - obs["version"])
+      ages = fields.get("budget_ages_s")
+      if isinstance(ages, list):
+        try:
+          st.budget.seed_ages(a + staleness for a in ages)
+        except (TypeError, ValueError):
+          pass  # malformed gossip never breaks supervision
+      if fields.get("quarantined"):
+        with self._lock:
+          st.state = self.QUARANTINED
+          st.last_reason = fields.get("reason") or "quarantined (adopted)"
+        if self.router is not None:
+          self.router.eject(backend_id, reason="quarantined")
+
+  def _publish_observations(self) -> None:
+    """Publish this supervisor's per-backend verdicts into the gossip
+    state (versions only bump on change, so steady state is silent)."""
+    if self.gossip is None:
+      return
+    with self._lock:
+      states = {b: (st.state, st.last_reason, st.budget.spend_ages())
+                for b, st in self._states.items()}
+    for backend_id, (state, reason, ages) in sorted(states.items()):
+      self.gossip.observe(
+          backend_id, state=state,
+          quarantined=state == self.QUARANTINED,
+          ejected=state in (self.DOWN, self.RESTARTING),
+          reason=reason,
+          budget_ages_s=[round(a, 3) for a in ages])
 
   def _refresh_router_load(self) -> None:
     if (self.router is None or not self.router.load_aware
@@ -538,6 +652,17 @@ class FleetSupervisor:
     if thread is not None:
       thread.join(timeout)
       self._thread = None
+    if self.lease is not None and self._lease_held:
+      # Clean shutdown hands the lease over immediately (a peer's next
+      # try_acquire succeeds without waiting out the TTL); a SIGKILLed
+      # holder skips this and the TTL reap is the takeover path.
+      try:
+        self.lease.release()
+      except OSError:
+        pass
+      self._lease_held = False
+      if self.router is not None:
+        self.router.metrics.record_lease_held(False)
 
   def __enter__(self):
     return self

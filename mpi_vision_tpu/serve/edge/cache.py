@@ -41,6 +41,7 @@ from __future__ import annotations
 import dataclasses
 import hashlib
 import threading
+import time
 from collections import OrderedDict
 
 import numpy as np
@@ -65,6 +66,12 @@ class EdgeConfig:
   warp_max_trans: float = 0.1
   warp_max_rot_deg: float = 4.0
   max_age_s: int = 5
+  # Negative caching under queue pressure: a render shed queue-full
+  # plants a short-TTL negative entry on its view cell, so repeated
+  # hammering of an unservable pose degrades to a fast 503 +
+  # Retry-After instead of re-entering the full queue each time.
+  # <= 0 disables (the default: shedding stays per-request).
+  negative_ttl_s: float = 0.0
 
   def __post_init__(self):
     if self.byte_budget <= 0:
@@ -77,6 +84,9 @@ class EdgeConfig:
         raise ValueError(f"{name} must be >= 0, got {getattr(self, name)}")
     if self.max_age_s < 0:
       raise ValueError(f"max_age_s must be >= 0, got {self.max_age_s}")
+    if self.negative_ttl_s < 0:
+      raise ValueError(
+          f"negative_ttl_s must be >= 0, got {self.negative_ttl_s}")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -116,13 +126,18 @@ class EdgeFrameCache:
   and the ``mpi_serve_edge_*`` families.
   """
 
-  def __init__(self, config: EdgeConfig | None = None):
+  def __init__(self, config: EdgeConfig | None = None, clock=time.monotonic):
     self.config = config if config is not None else EdgeConfig()
+    self._clock = clock
     self._lock = threading.Lock()
     self._entries: OrderedDict[tuple, CachedFrame] = OrderedDict()
     # (scene_id, digest) -> {cell: entry}: the near-miss scan and the
     # invalidation sweep walk one scene's residents, not the whole LRU.
     self._by_scene: dict[tuple, dict[tuple, CachedFrame]] = {}
+    # (scene_id, digest, cell) -> expiry clock time: view cells recently
+    # shed queue-full. Consulted before the scheduler hand-off so a
+    # saturated pose fails fast instead of re-queueing (negative_ttl_s).
+    self._negative: dict[tuple, float] = {}
     self._bytes = 0
     self._seq = 0
     self.hits = 0
@@ -131,6 +146,7 @@ class EdgeFrameCache:
     self.revalidations = 0
     self.evictions = 0
     self.invalidations = 0
+    self.negative_hits = 0
 
   def cell_of(self, pose) -> tuple:
     return lattice.quantize_pose(pose, self.config.trans_cell,
@@ -228,6 +244,49 @@ class EdgeFrameCache:
       self._drop_locked(key)
       self.evictions += 1
 
+  # -- negative caching ---------------------------------------------------
+
+  def negative_lookup(self, scene_id: str, digest: str,
+                      pose) -> float | None:
+    """Seconds until the request's view cell stops being known-shed, or
+    None when the cell carries no live negative entry.
+
+    A non-None return means a render for this cell was shed queue-full
+    within ``negative_ttl_s`` — the caller should 503 immediately with
+    the remaining TTL as ``Retry-After`` instead of re-entering the
+    queue. Expired entries are pruned on access (no sweeper thread).
+    """
+    if self.config.negative_ttl_s <= 0:
+      return None
+    key = (str(scene_id), str(digest), self.cell_of(pose))
+    with self._lock:
+      expiry = self._negative.get(key)
+      if expiry is None:
+        return None
+      remaining = expiry - self._clock()
+      if remaining <= 0:
+        del self._negative[key]
+        return None
+      self.negative_hits += 1
+      return remaining
+
+  def negative_put(self, scene_id: str, digest: str, pose) -> float | None:
+    """Record that this view cell was just shed queue-full; returns the
+    negative TTL planted (None when negative caching is disabled)."""
+    ttl = self.config.negative_ttl_s
+    if ttl <= 0:
+      return None
+    key = (str(scene_id), str(digest), self.cell_of(pose))
+    with self._lock:
+      now = self._clock()
+      self._negative[key] = now + ttl
+      # Opportunistic prune: queue pressure comes in bursts, so the dead
+      # entries of the last burst are cleared by the next one's puts.
+      expired = [k for k, exp in self._negative.items() if exp <= now]
+      for k in expired:
+        del self._negative[k]
+      return ttl
+
   # -- revalidation -------------------------------------------------------
 
   def revalidate(self, scene_id: str, digest: str, pose,
@@ -270,6 +329,8 @@ class EdgeFrameCache:
               for entry in cells.values()]
       for key in keys:
         self._drop_locked(key)
+      for nkey in [k for k in self._negative if k[0] == sid]:
+        del self._negative[nkey]
       self.invalidations += len(keys)
       return len(keys)
 
@@ -293,6 +354,10 @@ class EdgeFrameCache:
               if entry.tiles is None or (entry.tiles & changed)]
       for key in keys:
         self._drop_locked(key)
+      # Negatives record queue pressure, not pixels, but a reload is new
+      # enough state that holding a pre-reload 503 verdict is wrong.
+      for nkey in [k for k in self._negative if k[0] == sid]:
+        del self._negative[nkey]
       self.invalidations += len(keys)
       return len(keys)
 
@@ -316,6 +381,10 @@ class EdgeFrameCache:
           "revalidations": self.revalidations,
           "evictions": self.evictions,
           "invalidations": self.invalidations,
+          "negative_hits": self.negative_hits,
+          "negative_entries": sum(
+              1 for exp in self._negative.values() if exp > self._clock()),
+          "negative_ttl_s": self.config.negative_ttl_s,
           "hit_rate": (served / lookups) if lookups else None,
           "exact_hit_rate": (self.hits / lookups) if lookups else None,
           "trans_cell": self.config.trans_cell,

@@ -179,6 +179,31 @@ class RestartBudget:
     with self._lock:
       self._spends.clear()
 
+  def spend_ages(self) -> list[float]:
+    """Ages (seconds ago) of every in-window spend, oldest first.
+
+    Ages are clock-base-free, so they can cross process boundaries —
+    a supervisor taking over mid-crash-loop seeds its own budget from a
+    peer's gossiped ages and the window keeps sliding where it left off.
+    """
+    with self._lock:
+      now = self._clock()
+      self._prune_locked(now)
+      return [max(0.0, now - t) for t in self._spends]
+
+  def seed_ages(self, ages) -> None:
+    """Adopt another budget's in-window spends, given as ages.
+
+    Replaces the local window (takeover adoption, not accumulation);
+    out-of-window ages are dropped, newest ``max_restarts`` kept — the
+    no-budget-reset half of supervision handoff.
+    """
+    with self._lock:
+      now = self._clock()
+      spends = sorted(now - max(0.0, float(a)) for a in ages)
+      self._spends = spends[-self.max_restarts:]
+      self._prune_locked(now)
+
   def snapshot(self) -> dict:
     with self._lock:
       self._prune_locked(self._clock())

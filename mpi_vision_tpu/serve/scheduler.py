@@ -72,7 +72,13 @@ class QueueFullError(RuntimeError):
   Raised at submit time so overload is shed at the door (HTTP maps it to
   503) instead of building an unbounded backlog of requests whose callers
   will have timed out by the time the device reaches them.
+
+  ``retry_after_s`` is optionally set by layers that know when the shed
+  condition clears (the edge cache's negative entries carry their
+  remaining TTL); the HTTP handler surfaces it as ``Retry-After``.
   """
+
+  retry_after_s: float | None = None
 
 
 @dataclasses.dataclass

@@ -385,7 +385,8 @@ class RenderService:
     # live reload orphans every cached cell of the old pixels; the base
     # digest folds in the render-affecting engine identity so two
     # differently-configured services never share frame identities.
-    self.edge = None if edge is None else EdgeFrameCache(edge)
+    self.edge = None if edge is None else EdgeFrameCache(edge,
+                                                         clock=self._clock)
     self._scene_gen: dict[str, int] = {}
     desc = self.engine.describe()
     self._edge_base = hashlib.sha1(repr(tuple(
@@ -999,6 +1000,17 @@ class RenderService:
     except Exception as e:
       trace.finish(error=repr(e))
       raise
+    # Negative cache: this view cell was shed queue-full moments ago and
+    # its negative TTL has not lapsed — fail fast with the remaining TTL
+    # as Retry-After instead of re-entering the saturated queue. This
+    # shed costs a dict probe, not a queue slot.
+    shed_remaining_s = self.edge.negative_lookup(scene_id, digest, pose)
+    if shed_remaining_s is not None:
+      err = QueueFullError(
+          "request queue full (negative-cached view cell)")
+      err.retry_after_s = shed_remaining_s
+      trace.finish(error=repr(err))
+      raise err
     # Miss: a real render (latency recorded by the scheduler as usual),
     # then populate the cell. First writer wins — serving the RESIDENT
     # entry's frame keeps every response consistent with the cell's one
@@ -1007,8 +1019,16 @@ class RenderService:
     # the token) so a tile-granular reload drops only dependent frames.
     tiles = self._touched_tiles(scene_id, pose) if token is not None \
         else None
-    img = self.scheduler.render(scene_id, pose, timeout=timeout,
-                                trace=trace)
+    try:
+      img = self.scheduler.render(scene_id, pose, timeout=timeout,
+                                  trace=trace)
+    except QueueFullError as e:
+      # Shed for real: plant the negative entry so the NEXT request for
+      # this cell (and everyone piling behind it) skips the queue.
+      ttl = self.edge.negative_put(scene_id, digest, pose)
+      if ttl is not None and e.retry_after_s is None:
+        e.retry_after_s = ttl
+      raise
     entry = self._edge_put(str(scene_id), digest, cell, pose, img,
                            intrinsics, plane_depth, token, tiles)
     if entry is None:  # a swap raced the render: correct, just uncached
@@ -1487,8 +1507,17 @@ class _Handler(BaseHTTPRequestHandler):
                       extra_headers=tid_hdr)
       return
     except QueueFullError as e:
-      self._send_json({"error": str(e)}, status=503,
-                      extra_headers=tid_hdr)
+      # Shed at the door. A negative-cache fast shed knows when the cell
+      # clears; a raw queue-full shed advises the standard 1s backoff.
+      if e.retry_after_s is not None:
+        retry_after = max(1, math.ceil(e.retry_after_s))
+        self._send_json({"error": str(e), "retry_after_s": e.retry_after_s},
+                        status=503,
+                        extra_headers={"Retry-After": str(retry_after),
+                                       **tid_hdr})
+      else:
+        self._send_json({"error": str(e)}, status=503,
+                        extra_headers={"Retry-After": "1", **tid_hdr})
       return
     except CircuitOpenError as e:
       # Fast-fail while the device is known-bad: tell the client exactly

@@ -102,6 +102,13 @@ class Job:
     """Earliest wall time this job may be leased again (retry backoff)."""
     return float(self.record.get("not_before_unix_s", 0.0))
 
+  @property
+  def budget_spend_unix_s(self) -> list[float]:
+    """Wall times of the restart-budget spends still in this job's
+    crash-loop window (persisted at requeue so a supervisor restart
+    cannot hand a crash-looper a fresh budget)."""
+    return [float(t) for t in self.record.get("budget_spend_unix_s", [])]
+
   def __repr__(self) -> str:  # pragma: no cover - debugging sugar
     return f"Job({self.id!r}, {self.state!r}, attempts={self.attempts})"
 
@@ -370,18 +377,27 @@ class JobQueue:
 
   def requeue(self, job_id: str, owner: str, reason: str,
               not_before_unix_s: float = 0.0,
-              count_attempt: bool = True) -> None:
+              count_attempt: bool = True,
+              budget_spend_unix_s: list[float] | None = None) -> None:
     """Back to ``queued`` after a failed or preempted attempt.
 
     ``count_attempt=False`` is planned downtime (SIGTERM preemption):
     it must not look like a crash to the restart budget, exactly as the
     fleet supervisor's rolling restart spends no attempts.
     ``not_before_unix_s`` is the retry backoff floor.
+    ``budget_spend_unix_s`` persists the supervisor's in-window
+    restart-budget spend times (wall clock) onto the record, so a
+    supervisor that restarts mid-crash-loop resumes the countdown
+    instead of resetting it; None leaves the persisted list untouched
+    (preemption requeues spend nothing and must not erase history).
     """
     record = self._owned(job_id, owner)
     record["state"] = "queued"
     record["lease"] = None
     record["not_before_unix_s"] = round(float(not_before_unix_s), 6)
+    if budget_spend_unix_s is not None:
+      record["budget_spend_unix_s"] = [
+          round(float(t), 6) for t in budget_spend_unix_s]
     record["requeues"] = int(record.get("requeues", 0)) + 1
     record["history"].append({"event": "requeued", "reason": str(reason),
                               "counted": bool(count_attempt),
@@ -433,6 +449,9 @@ class JobQueue:
           f"job {job_id!r} is {record['state']!r}, not quarantined/failed")
     record["state"] = "queued"
     record["not_before_unix_s"] = 0.0
+    # The override's promise is a FRESH restart budget: drop the
+    # persisted spend window along with the in-memory one.
+    record.pop("budget_spend_unix_s", None)
     record["history"].append({"event": "readmitted",
                               "ts_unix_s": round(self._clock(), 6)})
     self._write(record)

@@ -338,13 +338,24 @@ class TrainSupervisor:
     if self.events is not None:
       self.events.emit(kind, **fields)
 
-  def _job_state(self, job_id: str) -> _JobState:
+  def _job_state(self, job_id: str, job=None) -> _JobState:
     with self._lock:
       st = self._job_states.get(job_id)
       if st is None:
         st = self._job_states[job_id] = _JobState(RestartBudget(
             max_restarts=self.restart_budget,
             window_s=self.budget_window_s, clock=self._clock))
+        if job is not None:
+          # First sight of the job THIS process: adopt the spend window
+          # a previous supervisor persisted on the record, so a restart
+          # mid-crash-loop resumes the quarantine countdown instead of
+          # handing the job a fresh budget. Spends travel as wall times
+          # on the queue's clock and are re-anchored here as ages on
+          # ours (the two clock bases never mix).
+          spends = job.budget_spend_unix_s
+          if spends:
+            now = self.queue.now()
+            st.budget.seed_ages([max(0.0, now - t) for t in spends])
       return st
 
   def _record_attempt(self, ok: bool) -> None:
@@ -515,11 +526,13 @@ class TrainSupervisor:
               f"(attempt {run.attempt}, {result})")
 
   def _requeue(self, job_id: str, run: _RunningJob, reason: str,
-               count_attempt: bool, not_before: float = 0.0) -> None:
+               count_attempt: bool, not_before: float = 0.0,
+               budget_spend_unix_s: list[float] | None = None) -> None:
     try:
       self.queue.requeue(job_id, self.owner, reason,
                          not_before_unix_s=not_before,
-                         count_attempt=count_attempt)
+                         count_attempt=count_attempt,
+                         budget_spend_unix_s=budget_spend_unix_s)
     except LeaseLostError:
       self._log(f"train-queue: lost lease on {job_id} during requeue")
       return
@@ -534,7 +547,7 @@ class TrainSupervisor:
     if not already_emitted:
       self._emit("training_job_attempt_failed", job=job_id,
                  attempt=run.attempt, reason=reason)
-    st = self._job_state(job_id)
+    st = self._job_state(job_id, run.job)
     st.attempt_streak += 1
     if not st.budget.try_spend():
       budget = st.budget.snapshot()
@@ -559,8 +572,14 @@ class TrainSupervisor:
                 "queue keeps draining")
       return
     backoff = self._backoff_s(st.attempt_streak - 1)
+    # Persist the spend window onto the record (as wall times on the
+    # queue's clock — spend_ages() is base-free) so a replacement
+    # supervisor adopts the countdown instead of resetting it.
+    now = self.queue.now()
     self._requeue(job_id, run, reason, count_attempt=True,
-                  not_before=self.queue.now() + backoff)
+                  not_before=now + backoff,
+                  budget_spend_unix_s=[now - a
+                                       for a in st.budget.spend_ages()])
     self._log(f"train-queue: {job_id} attempt {run.attempt} failed "
               f"({reason}); retry in {backoff:.2f}s")
 

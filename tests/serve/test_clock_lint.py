@@ -88,6 +88,10 @@ def test_lint_covers_the_ckpt_package_and_train_loop():
           "train/supervisor.py", "train/faultinject.py",
           "cluster/router.py",
           "cluster/ring.py", "cluster/pool.py", "cluster/supervisor.py",
+          # The router-HA tier (PR 15): gossip versions and lease
+          # heartbeats ARE timestamps — one bare clock call desyncs
+          # the anti-entropy merge from the takeover math.
+          "cluster/gossip.py", "cluster/lease.py",
           "edge/cache.py", "edge/lattice.py", "edge/warp.py",
           "obs/slo.py", "obs/events.py", "obs/trace.py",
           "obs/prom.py", "obs/hist.py", "obs/tsdb.py",

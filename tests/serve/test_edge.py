@@ -335,3 +335,105 @@ def test_http_304_revalidation_roundtrip(svc, http_base):
                                    {"If-None-Match": etag})
   assert status == 200 and headers["X-Edge-Cache"] == "miss"
   assert headers["ETag"] != etag and payload
+
+
+# --- negative caching under queue pressure (ISSUE 15 satellite) ----------
+
+
+class _FakeClock:
+  def __init__(self, t=1000.0):
+    self.t = t
+
+  def __call__(self):
+    return self.t
+
+
+def _neg_cache(ttl=5.0, clock=None):
+  return EdgeFrameCache(
+      EdgeConfig(trans_cell=0.01, rot_bucket_deg=2.0, warp_max_trans=0.05,
+                 warp_max_rot_deg=4.0, byte_budget=1 << 20,
+                 negative_ttl_s=ttl),
+      clock=clock if clock is not None else _FakeClock())
+
+
+def test_negative_cache_off_by_default_and_validated():
+  cache = _cache()  # default config: negative_ttl_s=0 -> disabled
+  assert cache.negative_put("s", "d", _pose()) is None
+  assert cache.negative_lookup("s", "d", _pose()) is None
+  assert cache.stats()["negative_ttl_s"] == 0
+  with pytest.raises(ValueError, match="negative_ttl_s"):
+    EdgeConfig(trans_cell=0.01, rot_bucket_deg=2.0, warp_max_trans=0.05,
+               warp_max_rot_deg=4.0, negative_ttl_s=-1.0)
+
+
+def test_negative_cache_shed_scoped_to_cell_and_expiring():
+  clock = _FakeClock()
+  cache = _neg_cache(ttl=5.0, clock=clock)
+  assert cache.negative_put("s", "d", _pose(0.001)) == 5.0
+  # Any pose inside the same cell sheds, with the REMAINING ttl.
+  clock.t += 2.0
+  remaining = cache.negative_lookup("s", "d", _pose(0.009))
+  assert remaining == pytest.approx(3.0)
+  # A different cell / digest / scene is NOT negative-cached: the
+  # pressure verdict is per view cell, never scene-wide.
+  assert cache.negative_lookup("s", "d", _pose(0.2)) is None
+  assert cache.negative_lookup("s", "other", _pose(0.001)) is None
+  assert cache.negative_lookup("t", "d", _pose(0.001)) is None
+  stats = cache.stats()
+  assert stats["negative_hits"] == 1 and stats["negative_entries"] == 1
+  # Past the TTL the entry lapses: the next lookup retries the queue.
+  clock.t += 3.1
+  assert cache.negative_lookup("s", "d", _pose(0.001)) is None
+  assert cache.stats()["negative_entries"] == 0
+  assert cache.stats()["negative_hits"] == 1  # expiry is not a hit
+
+
+def test_negative_cache_cleared_by_invalidation():
+  clock = _FakeClock()
+  cache = _neg_cache(ttl=30.0, clock=clock)
+  cache.negative_put("s", "d", _pose(0.001))
+  cache.negative_put("other", "d", _pose(0.001))
+  cache.invalidate_scene("s")
+  # A reload changes the world the verdict was issued against.
+  assert cache.negative_lookup("s", "d", _pose(0.001)) is None
+  assert cache.negative_lookup("other", "d", _pose(0.001)) is not None
+  cache.negative_put("s", "d", _pose(0.001))
+  cache.invalidate_tiles("s", [(0, 0)])
+  assert cache.negative_lookup("s", "d", _pose(0.001)) is None
+
+
+def test_render_edge_negative_caches_queue_full_and_sheds_fast():
+  """The server-level arc: a queue-full render poisons its view cell
+  for the negative TTL, and repeat requests for that cell shed at the
+  cache — carrying Retry-After — without re-entering the scheduler."""
+  from mpi_vision_tpu.serve.scheduler import QueueFullError
+
+  service = RenderService(
+      max_batch=4, max_wait_ms=5.0, use_mesh=False,
+      edge=EdgeConfig(trans_cell=0.02, rot_bucket_deg=2.0,
+                      warp_max_trans=0.06, warp_max_rot_deg=4.0,
+                      byte_budget=1 << 20, negative_ttl_s=30.0))
+  try:
+    service.add_synthetic_scenes(1, height=H, width=W, planes=P)
+    calls = []
+
+    def full_render(scene_id, pose, timeout=60.0, trace=None):
+      calls.append(scene_id)
+      raise QueueFullError("request queue full (64 waiting)")
+
+    service.scheduler.render = full_render
+    pose = _pose(0.4)
+    with pytest.raises(QueueFullError) as e1:
+      service.render_edge("scene_000", pose)
+    assert e1.value.retry_after_s == 30.0  # populated by the shed
+    with pytest.raises(QueueFullError, match="negative-cached") as e2:
+      service.render_edge("scene_000", pose)
+    assert 0 < e2.value.retry_after_s <= 30.0
+    assert calls == ["scene_000"]  # the repeat never reached the queue
+    edge = service.stats()["edge"]
+    assert edge["negative_hits"] == 1 and edge["negative_entries"] == 1
+    text = service.metrics_text()
+    assert "mpi_serve_edge_negative_hits_total 1" in text
+    assert "mpi_serve_edge_negative_entries 1" in text
+  finally:
+    service.close()

@@ -237,6 +237,44 @@ def test_serve_load_cluster_crashloop_dry_smoke():
   assert cluster["health"] == "degraded"
 
 
+def test_serve_load_cluster_chaos_router_dry_smoke():
+  """The router-HA drill's tier-1 smoke (ISSUE 15's acceptance pin):
+  TWO gossiping router replicas front the pool, closed-loop clients
+  hammer the SURVIVOR, and the supervising router is SIGKILLed
+  mid-window. The run must record zero failed requests on the survivor,
+  a bounded lease takeover, and a backend killed AFTER the takeover
+  respawned by the new leader through the --restart-hook webhook."""
+  out = _run_dry(["--cluster", "--chaos-router"])
+  assert out["metric"] == "serve_load" and out["dry"] is True
+  assert out["renders_per_sec"] > 0 and out["requests"] > 0
+  cluster = out["cluster"]
+  assert cluster["backends"] == 3 and cluster["replication"] == 2
+  # THE pin: the survivor dropped nothing — before, during, or after
+  # the router kill (failure_counts is empty, not merely small).
+  assert cluster["failed_requests"] == {}
+  assert cluster["post_kill_requests"] > 0
+  drill = cluster["chaos_router"]
+  assert drill["routers"] == 2
+  assert drill["killed_router"] == "routerA"
+  assert drill["survivor"] == "routerB"
+  # Supervision moved: the survivor reaped the stale lease in bounded
+  # time and its own metrics agree it now leads.
+  assert drill["lease_taken_over"] is True
+  assert drill["takeover_s"] is not None
+  assert drill["takeovers_total"] >= 1
+  assert drill["lease_held"] == 1
+  assert drill["lease_owner"] == "routerB"
+  # A backend killed AFTER the takeover was respawned by the NEW
+  # leader, via the restart webhook — remote supervision really works.
+  assert drill["backend_killed"] is not None
+  assert drill["backend_respawned"] is True
+  assert drill["respawn_s"] is not None
+  assert drill["hook_invocations"] >= 1
+  assert drill["hook_failures"] == 0
+  # Anti-entropy really ran between the replicas.
+  assert drill["gossip"]["rounds"] > 0
+
+
 def test_serve_load_chaos_dry_smoke():
   """Chaos mode must inject faults AND finish healthy: the workload rides
   retries/fallback instead of aborting, and the JSON carries the

@@ -165,12 +165,57 @@ def test_ship_knobs_without_url_rejected():
     cli.main(["serve", "--ship-interval-s", "5", "--duration", "0.1"])
 
 
-@pytest.mark.parametrize("flag", ["--supervise", "--rolling-restart"])
-def test_cluster_supervision_requires_a_local_pool(flag):
-  """--join fronts backends some OTHER supervisor owns; this process
-  can only kill and respawn what it spawned."""
+def test_cluster_rolling_restart_requires_a_local_pool():
+  """--join fronts backends some OTHER supervisor owns; a rolling
+  restart needs process control. (--supervise on --join is legal now:
+  it degrades to remote health watching + an optional restart hook.)"""
   with pytest.raises(SystemExit, match="require --backends"):
-    cli.main(["cluster", "--join", "h:1", flag])
+    cli.main(["cluster", "--join", "h:1", "--rolling-restart"])
+
+
+@pytest.mark.parametrize("argv,msg", [
+    # The restart hook only fires from the supervisor's restart path,
+    # and only for fleets this process cannot respawn itself.
+    (["cluster", "--join", "h:1", "--restart-hook", "echo"],
+     "--restart-hook requires --supervise"),
+    (["cluster", "--backends", "1", "--supervise",
+      "--restart-hook", "echo"], "--restart-hook requires --join"),
+    (["cluster", "--join", "h:1", "--supervise",
+      "--restart-hook-timeout-s", "5"],
+     "--restart-hook-timeout-s requires --restart-hook"),
+    (["cluster", "--join", "h:1", "--supervise", "--restart-hook",
+      "echo", "--restart-hook-timeout-s", "0"],
+     "--restart-hook-timeout-s must be"),
+    # Lease knobs elect a supervisor; dangling they'd guard nothing.
+    (["cluster", "--join", "h:1", "--lease-dir", "/tmp/l"],
+     "--lease-dir requires --supervise"),
+    (["cluster", "--join", "h:1", "--lease-ttl-s", "5"],
+     "--lease-ttl-s requires --supervise"),
+    (["cluster", "--backends", "1", "--supervise",
+      "--lease-ttl-s", "0"], "--lease-ttl-s must be"),
+    # Gossip knobs only act with peers to gossip with.
+    (["cluster", "--backends", "1", "--peers", " , "],
+     "--peers parsed no addresses"),
+    (["cluster", "--backends", "1", "--gossip-interval-s", "1"],
+     "--gossip-interval-s requires --peers"),
+    (["cluster", "--backends", "1", "--peers", "h:2",
+      "--gossip-interval-s", "0"], "--gossip-interval-s must be"),
+    (["cluster", "--backends", "1", "--node-id", "r0"],
+     "--node-id requires --peers or --supervise"),
+])
+def test_cluster_router_ha_knobs_guarded(argv, msg):
+  """Router-HA knobs (gossip, lease, remote restart hook) are validated
+  at the door — the monitor loop swallows tick exceptions by design, so
+  a lazily-raised ValueError would leave supervision silently dead."""
+  with pytest.raises(SystemExit, match=msg):
+    cli.main(argv)
+
+
+def test_serve_edge_negative_ttl_guarded():
+  """Negative caching only acts through the edge cache; dangling the
+  TTL would silently drop the shed behaviour the operator asked for."""
+  with pytest.raises(SystemExit, match=r"require\(s\) --edge-cache"):
+    cli.main(["serve", "--edge-negative-ttl-s", "30", "--duration", "0.1"])
 
 
 def test_cluster_bad_supervision_knobs_rejected():

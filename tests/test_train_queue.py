@@ -715,3 +715,132 @@ def test_run_until_drained_contains_tick_errors(tmp_path):
   sup.tick = lambda: (flaky_tick() if boom["n"] == 0 else finish_soon())
   assert sup.run_until_drained(timeout_s=50.0) is True
   assert sup.tick_errors == 1
+
+
+# --- budget persistence + multi-worker drill (ISSUE 15) -------------------
+
+
+def _worker(tmp_path, owner, clock, **kwargs):
+  """One worker's worth of machinery over the SHARED queue directory:
+  its own JobQueue instance (the queue is the disk), launcher, and
+  transport — only the clock is shared, like real co-located workers."""
+  events = EventLog(clock=clock)
+  queue = JobQueue(str(tmp_path), lease_s=60.0, clock=clock,
+                   events=events)
+  launcher = FakeLauncher()
+  defaults = dict(restart_budget=2, budget_window_s=600.0,
+                  backoff_base_s=1.0, backoff_mult=2.0, backoff_max_s=8.0,
+                  wedge_after=3, startup_grace_s=5.0)
+  defaults.update(kwargs)
+  supervisor = TrainSupervisor(
+      queue, launcher=launcher, transport=FakeTransport(launcher),
+      events=events, clock=clock, sleep=clock.sleep, owner=owner,
+      **defaults)
+  return queue, launcher, supervisor
+
+
+def test_budget_spends_persist_across_supervisor_restarts(tmp_path):
+  """THE no-fresh-budget pin: a supervisor restart mid-crash-loop must
+  resume the quarantine countdown from the spends persisted on the job
+  record, not hand the poison job a whole new budget."""
+  clock = FakeClock()
+  queue1, launcher1, sup1 = _worker(tmp_path, "w1", clock,
+                                    restart_budget=2)
+  queue1.submit({}, job_id="loopy")
+  sup1.tick()
+  for attempt in (0, 1):  # two failures: the whole budget, spent
+    launcher1.handles[("loopy", attempt)].rc = 1
+    sup1.tick()
+    clock.t += 10.0
+    sup1.tick()
+  # The spend window rode the requeue onto the record as wall times.
+  spends = queue1.get("loopy").budget_spend_unix_s
+  assert len(spends) == 2 and all(t <= clock() for t in spends)
+  # The supervisor dies; its replacement reads the same queue dir.
+  queue2, launcher2, sup2 = _worker(tmp_path, "w2", clock,
+                                    restart_budget=2)
+  # w1's in-flight attempt is still leased to w1 until the lease
+  # expires; the replacement reaps it on its first tick.
+  clock.t += 60.1
+  sup2.tick()  # reap + lease + spawn attempt 3
+  assert [s[0] for s in launcher2.spawned] == ["loopy"]
+  launcher2.handles[("loopy", queue2.get("loopy").attempts - 1)].rc = 1
+  sup2.tick()
+  # Adopted budget: 2 in-window spends + this failure = immediate
+  # quarantine. A fresh budget would have granted 2 more respawns.
+  assert queue2.get("loopy").state == "quarantined"
+  assert sup2.quarantines_total == 1
+  assert len(launcher2.spawned) == 1  # zero extra respawns granted
+  # readmit() clears the persisted window with the quarantine: the
+  # operator's fresh-budget promise holds across restarts too.
+  queue2.readmit("loopy")
+  assert queue2.get("loopy").budget_spend_unix_s == []
+
+
+def test_preempt_requeue_leaves_persisted_spends_untouched(tmp_path):
+  """Preemption is planned downtime: it must neither spend budget NOR
+  erase the crash-loop history a previous failure persisted."""
+  clock = FakeClock()
+  queue, launcher, sup = _worker(tmp_path, "w1", clock, restart_budget=3)
+  queue.submit({}, job_id="a")
+  sup.tick()
+  launcher.handles[("a", 0)].rc = 1  # one real failure: one spend
+  sup.tick()
+  spends = queue.get("a").budget_spend_unix_s
+  assert len(spends) == 1
+  clock.t += 2.0
+  sup.tick()  # respawn (attempt 1)
+  assert sup.running() == ["a"]
+  sup.preempt()
+  assert queue.get("a").state == "queued"
+  # No spend added, none erased: the window is exactly as it was.
+  assert queue.get("a").budget_spend_unix_s == spends
+
+
+def test_two_workers_one_queue_no_double_lease_no_lost_job(tmp_path):
+  """The multi-worker drill on fakes: two supervisors drain one shared
+  queue directory — every job runs under exactly one owner, a dead
+  worker's jobs are reaped and finished by the survivor, and the dead
+  worker's zombie attempts are fenced off (killed on lease loss)."""
+  clock = FakeClock()
+  queue_a, launcher_a, sup_a = _worker(tmp_path, "workerA", clock,
+                                       concurrency=2)
+  queue_b, launcher_b, sup_b = _worker(tmp_path, "workerB", clock,
+                                       concurrency=2)
+  for i in range(4):
+    queue_a.submit({}, job_id=f"j{i}")
+    clock.t += 0.01  # distinct create stamps keep FIFO deterministic
+  sup_a.tick()  # A fills its 2 slots first...
+  sup_b.tick()  # ...B gets the remaining 2
+  ran_a, ran_b = set(sup_a.running()), set(sup_b.running())
+  assert ran_a == {"j0", "j1"} and ran_b == {"j2", "j3"}
+  assert ran_a.isdisjoint(ran_b)  # no job double-leased, none skipped
+  # B's jobs complete; A then DIES (stops ticking, processes linger).
+  for job_id in ran_b:
+    launcher_b.handles[(job_id, 0)].rc = 0
+  sup_b.tick()
+  assert queue_b.get("j2").state == "done"
+  assert queue_b.get("j3").state == "done"
+  # Past A's lease TTL the survivor reaps and re-runs A's jobs — the
+  # queue loses nothing to a dead worker.
+  clock.t += 60.1
+  sup_b.tick()
+  assert queue_b.leases_expired == 2
+  assert set(sup_b.running()) == {"j0", "j1"}
+  assert [s for s in launcher_b.spawned if s[0] in ("j0", "j1")] == [
+      ("j0", 1, True), ("j1", 1, True)]  # attempts carried, resumed
+  # The dead worker lurching back must NOT fight the survivor: its
+  # heartbeats fail (lease lost) and it fences its own zombies.
+  sup_a.tick()
+  assert sup_a.running() == []
+  for job_id in ("j0", "j1"):
+    assert signal.SIGKILL in launcher_a.handles[(job_id, 0)].kills
+  # The survivor drains the re-run jobs to done: nothing lost, nothing
+  # run twice concurrently.
+  for job_id in ("j0", "j1"):
+    launcher_b.handles[(job_id, 1)].rc = 0
+  sup_b.tick()
+  assert all(queue_b.get(f"j{i}").state == "done" for i in range(4))
+  assert queue_b.drained()
+  total_spawns = len(launcher_a.spawned) + len(launcher_b.spawned)
+  assert total_spawns == 6  # 4 first attempts + 2 takeover re-runs

@@ -246,3 +246,61 @@ def test_queue_slos_scored_in_the_slo_engine(arc):
   assert avail["requests"] == 5, avail
   assert avail["bad"] == 3, avail
   assert snap["objectives"]["latency"]["slow"]["bad"] == 0
+
+
+def test_two_real_workers_drain_one_queue_without_double_runs(
+    tmp_path_factory):
+  """ISSUE 15's multi-worker smoke over REAL subprocesses: two
+  supervisors (distinct owners, separate work roots) drain one shared
+  queue directory. The on-disk lease protocol must hand each job to
+  exactly one worker — both jobs complete, each spawned exactly once,
+  and the two workers' spawn counts sum to the job count."""
+  from mpi_vision_tpu.train.queue import JobQueue
+  from mpi_vision_tpu.train.supervisor import (
+      SubprocessLauncher,
+      TrainSupervisor,
+  )
+
+  root = tmp_path_factory.mktemp("train_queue_two_workers")
+  env = hardened_env(1)
+  env["JAX_COMPILATION_CACHE_DIR"] = os.path.join(REPO, ".jax_cache")
+  tiny = {"epochs": 1, "img_size": 16, "num_planes": 4,
+          "synthetic_scenes": 1, "save_every": 1, "seed": 5}
+  queue_dir = str(root / "queue")
+  submitter = JobQueue(queue_dir, lease_s=120.0)
+  submitter.submit(dict(tiny), job_id="jobA")
+  submitter.submit({**tiny, "seed": 6}, job_id="jobB")
+
+  def worker(owner):
+    queue = JobQueue(queue_dir, lease_s=120.0)
+    return TrainSupervisor(
+        queue, launcher=SubprocessLauncher(str(root / owner), env=env),
+        concurrency=1, probe_s=0.25, wedge_after=200,
+        startup_grace_s=120.0, restart_budget=2, budget_window_s=600.0,
+        backoff_base_s=0.1, backoff_max_s=0.5, owner=owner)
+
+  sup1, sup2 = worker("worker1"), worker("worker2")
+  sup1.start()
+  sup2.start()
+  try:
+    deadline = time.monotonic() + 240.0
+    while time.monotonic() < deadline:
+      with sup1._lock:
+        busy1 = bool(sup1._running)
+      with sup2._lock:
+        busy2 = bool(sup2._running)
+      if not busy1 and not busy2 and submitter.drained():
+        break
+      time.sleep(0.1)
+  finally:
+    sup1.stop()
+    sup2.stop()
+  assert submitter.drained(), submitter.snapshot()
+  for job_id in ("jobA", "jobB"):
+    job = submitter.get(job_id)
+    assert job.state == "done", job.record
+    # Exactly one attempt ran it: no double-lease, no lost-and-retried.
+    assert job.attempts == 1, job.record
+  # Both spawns happened, each under exactly one owner.
+  assert sup1.spawns_total + sup2.spawns_total == 2
+  assert sup1.failures_total + sup2.failures_total == 0

@@ -128,6 +128,14 @@ def build_parser() -> argparse.ArgumentParser:
                   help="edge view-cell translation pitch (--edge/"
                        "--edge-ab); the bench default is finer than the "
                        "serve default so warps show next to exact hits")
+  ap.add_argument("--asset-ab", action="store_true",
+                  help="measure the content-addressed asset delivery "
+                       "tier (serve/assets): manifest+asset cold fetch, "
+                       "304 revalidation, and a cross-process tile-diff "
+                       "SceneFetcher sync (full vs quarter-scene diff "
+                       "bytes) in one process; emits one "
+                       "serve_load_asset_ab JSON line. --asset-ab --dry "
+                       "is the tier-1 smoke")
   ap.add_argument("--tiled-ab", action="store_true",
                   help="run the load twice — tile-granular service "
                        "(frustum-culled crops) vs monolithic — over one "
@@ -1359,6 +1367,133 @@ def edge_ab_main(args) -> int:
   return 0
 
 
+def asset_ab_main(args) -> int:
+  """The asset-delivery A/B (serve/assets): one tiled service, measured
+  through its content-addressed manifest + asset surface, in one
+  process.
+
+  Four measured legs: COLD (manifest + every tile asset over real
+  HTTP), WARM (the same GETs with ``If-None-Match`` — the immutable
+  contract must answer 304 with empty bodies), FULL SYNC (a fresh
+  replica ``SceneFetcher`` pulls every tile), and DIFF SYNC (after a
+  ``swap_scenes`` that mutates ~a quarter of the scene, the replica
+  re-syncs and must transfer ONLY the changed tiles). The headline
+  value is diff-sync bytes over the full checkpoint bytes — the
+  serve-layers-not-frames number. The run aborts if the diff sync moved
+  at least as many bytes as the full sync (the tier-1 ``--dry`` pin)."""
+  import urllib.request
+
+  from mpi_vision_tpu.serve import RenderService
+  from mpi_vision_tpu.serve.assets import SceneFetcher
+  from mpi_vision_tpu.serve.server import (
+      make_http_server,
+      synthetic_tiled_scene,
+  )
+
+  layers, depths, k = synthetic_tiled_scene(
+      "asset_scene", height=args.img_size, width=args.img_size,
+      planes=args.num_planes, regions=args.tiled_regions, seed=args.seed)
+  svc = RenderService(cache_bytes=args.cache_mb << 20,
+                      tile=args.tile_size)
+  svc.add_scene("asset_scene", layers, depths, k)
+  httpd = make_http_server(svc, port=0)
+  threading.Thread(target=httpd.serve_forever, daemon=True).start()
+  base_url = f"http://127.0.0.1:{httpd.server_address[1]}"
+  _log(f"serve_load: asset-ab — scene {args.img_size}x{args.img_size}"
+       f"x{args.num_planes}, tile {args.tile_size}, origin {base_url}")
+
+  def fetch(path, etag=None):
+    req = urllib.request.Request(base_url + path)
+    if etag:
+      req.add_header("If-None-Match", etag)
+    try:
+      with urllib.request.urlopen(req, timeout=30) as resp:
+        return resp.status, resp.headers.get("ETag"), resp.read()
+    except urllib.error.HTTPError as e:
+      if e.code == 304:
+        return 304, e.headers.get("ETag"), b""
+      raise
+
+  # COLD: manifest + every tile asset, timed over real HTTP.
+  t0 = time.perf_counter()
+  _, manifest_etag, manifest_body = fetch("/scene/asset_scene/manifest")
+  manifest = json.loads(manifest_body)
+  digests = [d for row in manifest["tiles"] for d in row]
+  etags = {}
+  cold_bytes = len(manifest_body)
+  for digest in digests:
+    _, etag, body = fetch(manifest["asset_path"] + digest)
+    etags[digest] = etag
+    cold_bytes += len(body)
+  cold_s = time.perf_counter() - t0
+
+  # WARM: the immutable contract — every conditional GET must 304.
+  t0 = time.perf_counter()
+  status, _, body = fetch("/scene/asset_scene/manifest",
+                          etag=manifest_etag)
+  warm_bytes, warm_304 = len(body), int(status == 304)
+  for digest in digests:
+    status, _, body = fetch(manifest["asset_path"] + digest,
+                            etag=etags[digest])
+    warm_bytes += len(body)
+    warm_304 += int(status == 304)
+  warm_s = time.perf_counter() - t0
+  if warm_304 != len(digests) + 1:
+    raise SystemExit(
+        f"serve_load: asset-ab revalidation failure — expected "
+        f"{len(digests) + 1} 304s, got {warm_304}")
+
+  # FULL SYNC: a fresh tiled replica pulls the whole scene tile-by-tile.
+  replica = RenderService(cache_bytes=args.cache_mb << 20,
+                          tile=args.tile_size)
+  fetcher = SceneFetcher(replica, base_url)
+  full = fetcher.sync_scene("asset_scene")
+
+  # DIFF SYNC: mutate ~a quarter of the scene on the origin, re-sync —
+  # only the changed-digest tiles may move.
+  rgba2 = np.array(layers, copy=True)
+  h, w = rgba2.shape[0] // 2, rgba2.shape[1] // 2
+  rgba2[:h, :w] = np.clip(rgba2[:h, :w] + 0.125, 0.0, 1.0)
+  svc.swap_scenes({"asset_scene": (rgba2, depths, k)})
+  diff = fetcher.sync_scene("asset_scene")
+  if diff["bytes_fetched"] >= full["bytes_fetched"]:
+    raise SystemExit(
+        "serve_load: asset-ab PINNED diff failure — the quarter-scene "
+        f"re-sync moved {diff['bytes_fetched']} bytes vs "
+        f"{full['bytes_fetched']} for the full sync")
+  httpd.shutdown()
+  svc.close()
+  replica.close()
+
+  full_ckpt_bytes = full["scene_bytes"]
+  record = {
+      "metric": "serve_load_asset_ab",
+      "value": round(diff["bytes_fetched"] / full_ckpt_bytes, 4),
+      "unit": "diff_bytes_over_full_checkpoint_bytes",
+      "cold": {"seconds": round(cold_s, 4), "bytes": cold_bytes,
+               "assets": len(digests)},
+      "warm": {"seconds": round(warm_s, 4), "bytes": warm_bytes,
+               "not_modified": warm_304},
+      "full_sync": {"seconds": full["seconds"],
+                    "bytes": full["bytes_fetched"],
+                    "tiles_fetched": full["tiles_fetched"]},
+      "diff_sync": {"seconds": diff["seconds"],
+                    "bytes": diff["bytes_fetched"],
+                    "tiles_fetched": diff["tiles_fetched"],
+                    "tiles_reused": diff["tiles_reused"]},
+      "full_checkpoint_bytes": full_ckpt_bytes,
+      "diff_vs_full_sync": round(
+          diff["bytes_fetched"] / max(full["bytes_fetched"], 1), 4),
+      "tiles_total": len(digests),
+      "tile": args.tile_size,
+      "img_size": args.img_size,
+      "num_planes": args.num_planes,
+      "dry": bool(args.dry),
+  }
+  print(json.dumps(record))
+  return 0
+
+
 def main(argv=None) -> int:
   args = build_parser().parse_args(argv)
   if os.environ.get("SERVE_LOAD_DRY", "") not in ("", "0", "false"):
@@ -1375,6 +1510,14 @@ def main(argv=None) -> int:
     raise SystemExit(f"--inflight must be >= 1, got {args.inflight}")
   if args.tile_size < 8:
     raise SystemExit(f"--tile-size must be >= 8, got {args.tile_size}")
+  if args.asset_ab:
+    if (args.chaos or args.ab or args.edge_ab or args.cluster
+        or args.edge or args.tiled_ab):
+      raise SystemExit("--asset-ab measures the asset delivery tier on "
+                       "its own service; it does not combine with "
+                       "--chaos/--ab/--edge-ab/--edge/--cluster/"
+                       "--tiled-ab")
+    return asset_ab_main(args)
   if args.tiled_ab:
     if args.chaos or args.ab or args.edge_ab or args.cluster or args.edge:
       raise SystemExit("--tiled-ab compares clean in-process arms; it "

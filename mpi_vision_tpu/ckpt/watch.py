@@ -19,6 +19,11 @@ Callback failures are counted and logged, never fatal: a checkpoint that
 fails to bake (mid-GC disappearance, corrupt manifest quarantined by the
 restore) must leave the previous scenes serving. The failed step is NOT
 marked seen, so the next poll retries it until a newer step supersedes.
+
+The poll loop itself (daemon thread, injectable sleep, interruptible
+stop) is ``PollWatcher`` — reused by the scene-sync watcher in
+``serve/assets/fetch.py``, which polls remote manifests the same way
+this class polls a checkpoint directory.
 """
 
 from __future__ import annotations
@@ -28,7 +33,64 @@ import time
 from typing import Callable
 
 
-class CheckpointWatcher:
+class PollWatcher:
+  """Reusable poll loop: a daemon thread calling ``check_once()`` every
+  ``poll_s`` seconds.
+
+  Subclasses implement ``check_once()`` (one complete poll; must never
+  raise — failures are the subclass's accounting). ``start()``/
+  ``stop()``/context management are shared. ``sleep`` is injectable for
+  deterministic tests; the real-time path waits on an event so
+  ``stop()`` never blocks a full poll interval.
+  """
+
+  thread_name = "mpi-poll-watch"
+
+  def __init__(self, poll_s: float, sleep=None):
+    if poll_s <= 0:
+      raise ValueError(f"poll_s must be > 0, got {poll_s}")
+    self.poll_s = float(poll_s)
+    self._sleep = sleep
+    self._stop = threading.Event()
+    self._thread: threading.Thread | None = None
+
+  def check_once(self):
+    raise NotImplementedError
+
+  def start(self):
+    if self._thread is not None:
+      raise RuntimeError(f"{type(self).__name__} already started")
+    self._stop.clear()
+    self._thread = threading.Thread(target=self._loop,
+                                    name=self.thread_name, daemon=True)
+    self._thread.start()
+    return self
+
+  def _loop(self) -> None:
+    while not self._stop.is_set():
+      self.check_once()
+      if self._sleep is not None:
+        self._sleep(self.poll_s)  # injected sleep (deterministic tests)
+        if self._stop.is_set():
+          return
+      elif self._stop.wait(self.poll_s):  # interruptible real-time wait
+        return
+
+  def stop(self, timeout: float = 10.0) -> None:
+    self._stop.set()
+    thread = self._thread
+    if thread is not None:
+      thread.join(timeout)
+      self._thread = None
+
+  def __enter__(self):
+    return self
+
+  def __exit__(self, *exc):
+    self.stop()
+
+
+class CheckpointWatcher(PollWatcher):
   """Fire ``on_new_step(step)`` when the store publishes a newer step.
 
   Args:
@@ -44,17 +106,16 @@ class CheckpointWatcher:
     log: diagnostics sink (reload failures are reported here).
   """
 
+  thread_name = "mpi-ckpt-watch"
+
   def __init__(self, store, on_new_step: Callable[[int], None],
                poll_s: float = 2.0, initial_step: int | None = None,
                clock=time.monotonic, sleep=None,
                log: Callable[[str], None] | None = None):
-    if poll_s <= 0:
-      raise ValueError(f"poll_s must be > 0, got {poll_s}")
+    super().__init__(poll_s, sleep=sleep)
     self.store = store
     self.on_new_step = on_new_step
-    self.poll_s = float(poll_s)
     self._clock = clock
-    self._sleep = sleep
     self._log = log if log is not None else (lambda msg: None)
     self._seen_step = initial_step
     # Two locks on purpose: _poll_lock serializes whole polls (the
@@ -65,8 +126,6 @@ class CheckpointWatcher:
     # restore + re-bake.
     self._poll_lock = threading.Lock()
     self._lock = threading.Lock()
-    self._stop = threading.Event()
-    self._thread: threading.Thread | None = None
     self.polls = 0
     self.reloads = 0
     self.reload_errors = 0
@@ -116,32 +175,6 @@ class CheckpointWatcher:
     with self._lock:
       return self._seen_step
 
-  def start(self) -> "CheckpointWatcher":
-    if self._thread is not None:
-      raise RuntimeError("CheckpointWatcher already started")
-    self._stop.clear()
-    self._thread = threading.Thread(target=self._loop,
-                                    name="mpi-ckpt-watch", daemon=True)
-    self._thread.start()
-    return self
-
-  def _loop(self) -> None:
-    while not self._stop.is_set():
-      self.check_once()
-      if self._sleep is not None:
-        self._sleep(self.poll_s)  # injected sleep (deterministic tests)
-        if self._stop.is_set():
-          return
-      elif self._stop.wait(self.poll_s):  # interruptible real-time wait
-        return
-
-  def stop(self, timeout: float = 10.0) -> None:
-    self._stop.set()
-    thread = self._thread
-    if thread is not None:
-      thread.join(timeout)
-      self._thread = None
-
   def snapshot(self) -> dict:
     with self._lock:
       return {
@@ -151,9 +184,3 @@ class CheckpointWatcher:
           "reload_errors": self.reload_errors,
           "last_error": self.last_error,
       }
-
-  def __enter__(self):
-    return self
-
-  def __exit__(self, *exc):
-    self.stop()

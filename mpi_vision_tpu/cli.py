@@ -483,15 +483,39 @@ def cmd_serve(args: argparse.Namespace) -> dict:
     if wants_ship:
       raise SystemExit(f"{', '.join(wants_ship)} require(s) --ship-url")
   if not args.tiled:
-    # Tile knobs only act through the tiled registry; silently serving
-    # monolithic scenes would drop the frustum culling / per-tile cache
-    # granularity the operator asked for.
+    # Tile/asset knobs only act through the tiled registry; silently
+    # serving monolithic scenes would drop the frustum culling /
+    # per-tile cache granularity — and the whole asset delivery tier —
+    # the operator asked for.
     wants_tiled = [flag for flag, on in (
-        ("--tile-size", args.tile_size is not None),) if on]
+        ("--tile-size", args.tile_size is not None),
+        ("--asset-cache-mb", args.asset_cache_mb is not None),
+        ("--asset-sync-from", bool(args.asset_sync_from))) if on]
     if wants_tiled:
       raise SystemExit(f"{', '.join(wants_tiled)} require(s) --tiled")
-  if args.tile_size is not None and args.tile_size < 8:
-    raise SystemExit(f"--tile-size must be >= 8, got {args.tile_size}")
+  tile_size: int | str | None = None
+  if args.tile_size is not None:
+    if args.tile_size == "auto":
+      tile_size = "auto"
+    else:
+      try:
+        tile_size = int(args.tile_size)
+      except ValueError:
+        raise SystemExit(
+            f"--tile-size must be an integer or 'auto', "
+            f"got {args.tile_size!r}") from None
+      if tile_size < 8:
+        raise SystemExit(f"--tile-size must be >= 8, got {tile_size}")
+  if args.asset_cache_mb is not None and args.asset_cache_mb < 1:
+    raise SystemExit(
+        f"--asset-cache-mb must be >= 1, got {args.asset_cache_mb}")
+  if args.asset_sync_interval_s is not None and not args.asset_sync_from:
+    # The interval only paces the sync watcher.
+    raise SystemExit("--asset-sync-interval-s requires --asset-sync-from")
+  if args.asset_sync_interval_s is not None \
+      and args.asset_sync_interval_s <= 0:
+    raise SystemExit(f"--asset-sync-interval-s must be > 0, "
+                     f"got {args.asset_sync_interval_s}")
   if not args.edge_cache:
     # Edge knobs only act through the edge cache; silently ignoring them
     # would drop the fidelity/budget bounds the user asked for.
@@ -653,8 +677,11 @@ def cmd_serve(args: argparse.Namespace) -> dict:
       cache_bytes=args.cache_mb << 20, max_batch=args.max_batch,
       max_wait_ms=args.max_wait_ms, max_inflight=max_inflight,
       max_inflight_cap=args.max_inflight_cap,
-      tile=((args.tile_size if args.tile_size is not None else 64)
+      tile=((tile_size if tile_size is not None else 64)
             if args.tiled else None),
+      asset_cache_bytes=(args.asset_cache_mb
+                         if args.asset_cache_mb is not None
+                         else 256) << 20,
       convention=convention,
       method=args.method, use_mesh=use_mesh, edge=edge,
       max_queue=args.max_queue, resilience=resilience,
@@ -718,12 +745,28 @@ def cmd_serve(args: argparse.Namespace) -> dict:
           initial_step=ckpt_info["step"], log=_log).start()
       _log(f"serve: watching {args.ckpt} for new checkpoints every "
            f"{args.reload_ckpt_s:g}s")
-  if not args.mpi_dir and not args.ckpt:
+  if not args.mpi_dir and not args.ckpt and not args.asset_sync_from:
     ids = svc.add_synthetic_scenes(
         args.scenes, height=args.img_size, width=args.img_size,
         planes=args.num_planes)
     _log(f"serve: {len(ids)} synthetic scenes "
          f"[{args.img_size}x{args.img_size}x{args.num_planes}]")
+  sync_watcher = None
+  if args.asset_sync_from:
+    # Tile-diff scene sync (serve/assets): follow a peer backend or
+    # router, pulling only changed-digest tiles each sweep. The first
+    # sweep runs on the watcher thread, so a peer that is still coming
+    # up delays nothing — failures are counted and retried.
+    from mpi_vision_tpu.serve.assets import SceneFetcher, SceneSyncWatcher
+
+    fetcher = SceneFetcher(svc, args.asset_sync_from, events=events)
+    sync_watcher = SceneSyncWatcher(
+        fetcher,
+        poll_s=(args.asset_sync_interval_s
+                if args.asset_sync_interval_s is not None else 5.0),
+        log=_log).start()
+    _log(f"serve: tile-diff syncing scenes from {args.asset_sync_from} "
+         f"every {sync_watcher.poll_s:g}s")
 
   if args.warmup:
     # Pay the compiles before traffic, not inside request latencies.
@@ -779,6 +822,8 @@ def cmd_serve(args: argparse.Namespace) -> dict:
   finally:
     if watcher is not None:
       watcher.stop()
+    if sync_watcher is not None:
+      sync_watcher.stop()
     httpd.shutdown()  # stop accepting; in-flight handler threads finish
     stats = svc.stats()
     health = svc.healthz()
@@ -831,6 +876,8 @@ def cmd_serve(args: argparse.Namespace) -> dict:
           "ckpt_params_digest": ckpt_info["params_digest"][:16]}
          if args.ckpt else {}),
       **({"ckpt_reload": watcher.snapshot()} if watcher is not None else {}),
+      **({"scene_sync": sync_watcher.snapshot()}
+         if sync_watcher is not None else {}),
   }
 
 
@@ -1524,8 +1571,23 @@ def build_parser() -> argparse.ArgumentParser:
                       "the frustum covers all tiles), cache/evict baked "
                       "data per tile, and live-reload only tiles whose "
                       "digests changed")
-  s.add_argument("--tile-size", type=int, default=None,
-                 help="tile edge in pixels (default 64); requires --tiled")
+  s.add_argument("--tile-size", default=None,
+                 help="tile edge in pixels (default 64), or 'auto' to "
+                      "derive a per-scene edge targeting ~64 tiles "
+                      "(serve/tiles.py auto_tile); requires --tiled")
+  s.add_argument("--asset-cache-mb", type=int, default=None,
+                 help="scene-asset LRU byte budget for the "
+                      "/scene/{id}/asset/{digest} delivery tier "
+                      "(default 256); requires --tiled")
+  s.add_argument("--asset-sync-from", default="",
+                 help="base URL of a peer backend or router to tile-diff "
+                      "sync scenes FROM (serve/assets SceneFetcher): "
+                      "fetch each remote manifest, pull only "
+                      "changed-digest tiles, publish locally under the "
+                      "same ids; requires --tiled")
+  s.add_argument("--asset-sync-interval-s", type=float, default=None,
+                 help="re-sync --asset-sync-from every this many seconds "
+                      "(default 5); requires --asset-sync-from")
   s.add_argument("--convention", default="ref", choices=("ref", "exact"),
                  help="sampling convention: 'ref' reproduces the "
                       "reference exactly (its axis swap is benign on "

@@ -344,6 +344,58 @@ def serve_registry(stats: dict,
             tcache.get("bytes", 0))
   reg.gauge(p + "tile_cache_tiles", "Baked tiles resident.",
             tcache.get("scenes", 0))
+  # Scene-asset delivery tier (serve/assets/): manifest + content-
+  # addressed tile/layer assets on the serving side, tile-diff scene
+  # sync on the fetching side. Always exposed (zeros while off).
+  assets = stats.get("assets") or {}
+  acache = assets.get("cache") or {}
+  reg.counter(p + "asset_manifest_requests_total",
+              "GET /scene/{id}/manifest requests (including 304s and "
+              "404s).", assets.get("manifest_requests", 0))
+  reg.counter(p + "asset_requests_total",
+              "GET /scene/{id}/asset/{digest} requests (including 304s "
+              "and 404s).", assets.get("requests", 0))
+  reg.counter(p + "asset_not_found_total",
+              "Asset-tier requests answered 404 (unknown scene, unknown "
+              "or no-longer-live digest).", assets.get("not_found", 0))
+  reg.counter(p + "asset_not_modified_total",
+              "Asset-tier If-None-Match revalidations answered 304 (no "
+              "body).", assets.get("not_modified", 0))
+  reg.counter(p + "asset_bytes_total",
+              "Body bytes served by the asset tier (manifests + "
+              "assets).", assets.get("bytes_served", 0))
+  reg.counter(p + "asset_encodes_total",
+              "Assets (re-)encoded from live scene data (publish or "
+              "LRU miss).", assets.get("encodes", 0))
+  reg.counter(p + "asset_publish_rejects_total",
+              "Corrupt bakes refused at the digest-vs-bytes gate.",
+              assets.get("publish_rejects", 0))
+  reg.counter(p + "asset_cache_evictions_total",
+              "Encoded assets evicted by the asset LRU.",
+              acache.get("evictions", 0))
+  reg.gauge(p + "asset_cache_bytes",
+            "Encoded asset bytes resident in the asset LRU.",
+            acache.get("bytes", 0))
+  reg.gauge(p + "asset_cache_assets",
+            "Encoded assets resident in the asset LRU.",
+            acache.get("assets", 0))
+  sync = stats.get("scene_sync") or {}
+  reg.counter(p + "scene_sync_runs_total",
+              "Completed tile-diff scene syncs pulled into this service "
+              "(SceneFetcher).", sync.get("runs", 0))
+  reg.counter(p + "scene_sync_tiles_fetched_total",
+              "Tiles fetched by scene syncs (digest changed or scene "
+              "new).", sync.get("tiles_fetched", 0))
+  reg.counter(p + "scene_sync_tiles_reused_total",
+              "Tiles reused locally by scene syncs (digest unchanged — "
+              "the bytes the diff protocol never moved).",
+              sync.get("tiles_reused", 0))
+  reg.counter(p + "scene_sync_bytes_total",
+              "Bytes fetched over the wire by scene syncs.",
+              sync.get("bytes_fetched", 0))
+  reg.counter(p + "scene_sync_failures_total",
+              "Scene syncs that failed (unreachable source, bad "
+              "manifest, digest mismatch).", sync.get("failures", 0))
   cache = stats.get("cache") or {}
   reg.counter(p + "cache_hits_total", "Scene-cache hits.",
               cache.get("hits", 0))

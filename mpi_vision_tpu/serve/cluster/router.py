@@ -33,6 +33,7 @@ from __future__ import annotations
 import http.client
 import json
 import math
+import re
 import threading
 import time
 import urllib.error
@@ -167,6 +168,13 @@ class RouterMetrics:
     self.gossip_peer_failures = 0
     self.supervisor_lease_held = 0
     self.supervisor_takeovers = 0
+    # Asset-tier routing (serve/assets/): manifest/viewer forwards,
+    # digest-addressed asset forwards, fan-outs past a primary's 404
+    # (any replica holding the digest may answer), fleet-wide misses.
+    self.scene_manifest_forwards = 0
+    self.scene_asset_forwards = 0
+    self.scene_asset_fanouts = 0
+    self.scene_asset_misses = 0
 
   def record_request(self) -> None:
     with self._lock:
@@ -243,6 +251,27 @@ class RouterMetrics:
     with self._lock:
       self.supervisor_takeovers += 1
 
+  def record_scene_get(self, kind: str) -> None:
+    """One asset-tier GET routed (kind: "manifest" covers manifest AND
+    viewer — both are scene-generation lookups; "asset" is a
+    digest-addressed fetch)."""
+    with self._lock:
+      if kind == "asset":
+        self.scene_asset_forwards += 1
+      else:
+        self.scene_manifest_forwards += 1
+
+  def record_asset_fanout(self) -> None:
+    """An asset walk continued past a backend's 404 (digest-addressed:
+    any replica holding the bytes may answer)."""
+    with self._lock:
+      self.scene_asset_fanouts += 1
+
+  def record_asset_miss(self) -> None:
+    """Every reachable backend 404'd an asset digest."""
+    with self._lock:
+      self.scene_asset_misses += 1
+
   def record_cell_route(self, rerouted: bool) -> None:
     """One request placed by its ``(scene, view-cell)`` ring key;
     ``rerouted`` when that key's primary differs from the scene-level
@@ -276,6 +305,12 @@ class RouterMetrics:
           "gossip_peer_failures": self.gossip_peer_failures,
           "supervisor_lease_held": self.supervisor_lease_held,
           "supervisor_takeovers": self.supervisor_takeovers,
+          "scene_sync": {
+              "manifest_forwards": self.scene_manifest_forwards,
+              "asset_forwards": self.scene_asset_forwards,
+              "asset_fanouts": self.scene_asset_fanouts,
+              "asset_misses": self.scene_asset_misses,
+          },
       }
 
 
@@ -829,6 +864,100 @@ class Router:
     if self.slo is not None:
       self.slo.record_bad()
 
+  def forward_scene_get(self, scene_id: str, path: str,
+                        if_none_match: str | None = None,
+                        kind: str = "manifest") \
+      -> tuple[int, dict, bytes]:
+    """Route an asset-tier GET (manifest / viewer / asset) to the
+    scene's replicas.
+
+    The walk is ``forward_render``'s shape (placement order first,
+    ejected and breaker-refused replicas skipped, transport failures
+    and 5xx count against the backend's breaker and fail over) with one
+    twist: an answered 404 does not end the walk. It continues through
+    the FULL backend set — content addressing means ANY backend still
+    holding the digest (e.g. the old generation's bytes mid-rollout)
+    may answer an asset GET, and a joined fleet's scenes live on
+    backends placement never chose; a 404 is only final when every
+    reachable backend said so. ``kind`` ("manifest" for manifest/viewer
+    pages, "asset" for digest-addressed bytes) picks the metric family;
+    fan-out accounting (``asset_fanouts`` / ``asset_misses``) tracks
+    the asset walks, where cross-generation scatter is the signal.
+    Conditional headers forward untouched: 304s ride back like any
+    answered status.
+
+    Raises ``AllReplicasOpenError`` / ``ReplicasExhaustedError`` /
+    ``KeyError`` exactly like ``forward_render``.
+    """
+    self.metrics.record_scene_get(kind)
+    replicas = self._replicas(scene_id)
+    with self._lock:
+      placed = {b.backend_id for b in replicas}
+      replicas = replicas + [b for b in self._backends.values()
+                             if b.backend_id not in placed]
+    if not replicas:
+      raise KeyError("no backends registered")
+    headers = {}
+    if if_none_match:
+      headers["If-None-Match"] = if_none_match
+    attempts: list[str] = []
+    retry_afters: list[float] = []
+    tried_any = False
+    missed: tuple[int, dict, bytes] | None = None
+    for backend in replicas:
+      if backend.ejected:
+        retry_afters.append(1.0)
+        continue
+      if not backend.breaker.allow_primary():
+        retry_afters.append(backend.breaker.retry_after_s())
+        continue
+      tried_any = True
+      try:
+        status, resp_headers, resp_body = self.transport.request(
+            "GET", backend.base_url + path, headers=headers or None,
+            timeout=self.render_timeout_s)
+      except ConnectionError as e:
+        backend.breaker.record_failure()
+        attempts.append(f"{backend.backend_id}: unreachable ({e})")
+        continue
+      if status >= 500:
+        backend.breaker.record_failure()
+        attempts.append(f"{backend.backend_id}: HTTP {status}")
+        continue
+      backend.breaker.record_success()
+      if status == 404:
+        # This backend doesn't hold the digest/scene; remember the miss
+        # and keep walking — another may.
+        if kind == "asset":
+          self.metrics.record_asset_fanout()
+        missed = (status, dict(resp_headers), resp_body)
+        attempts.append(f"{backend.backend_id}: HTTP 404")
+        continue
+      self.metrics.record_forward(backend.backend_id)
+      resp_headers = dict(resp_headers)
+      resp_headers["X-Backend-Id"] = backend.backend_id
+      return status, resp_headers, resp_body
+    if missed is not None:
+      if kind == "asset":
+        self.metrics.record_asset_miss()
+      return missed
+    if not tried_any:
+      self.metrics.record_breaker_fastfail()
+      raise AllReplicasOpenError(
+          scene_id, min(retry_afters) if retry_afters else 0.0)
+    self.metrics.record_replica_exhausted()
+    raise ReplicasExhaustedError(scene_id, attempts)
+
+  def scenes(self) -> dict:
+    """The fleet's scene index (``GET /scenes``): the union of every
+    backend's registered ids — what a ``SceneFetcher`` pointed at the
+    router sweeps."""
+    union: set[str] = set()
+    for result in self._fan_out_get("/scenes",
+                                    self.health_timeout_s).values():
+      union.update(result.get("scenes") or [])
+    return {"scenes": sorted(union)}
+
   @staticmethod
   def _validate_render_body(headers: dict, body: bytes) -> str | None:
     """Why a 200 response body is unusable, or None when it checks out.
@@ -1201,6 +1330,19 @@ class Router:
                 "Cell-keyed placements whose primary differed from the "
                 "scene-level primary (affinity moved the request).",
                 snap["cell_reroutes"])
+    reg.counter(p + "scene_sync_manifest_forwards_total",
+                "Scene manifest/viewer GETs routed to a replica.",
+                snap["scene_sync"]["manifest_forwards"])
+    reg.counter(p + "scene_sync_asset_forwards_total",
+                "Digest-addressed asset GETs routed to a replica.",
+                snap["scene_sync"]["asset_forwards"])
+    reg.counter(p + "scene_sync_asset_fanouts_total",
+                "Asset GETs that walked past a replica's 404 (content "
+                "addressing lets any digest holder answer).",
+                snap["scene_sync"]["asset_fanouts"])
+    reg.counter(p + "scene_sync_asset_misses_total",
+                "Asset GETs 404'd by every reachable backend.",
+                snap["scene_sync"]["asset_misses"])
     reg.counter(p + "gossip_rounds_total",
                 "Anti-entropy gossip rounds this router initiated.",
                 snap["gossip_rounds"])
@@ -1310,7 +1452,13 @@ class Router:
 # exactly like ones fronting a single backend.
 _FORWARD_HEADERS = ("Content-Type", "X-Image-Shape", "X-Image-Dtype",
                     "X-Scene-Id", "Retry-After", "ETag", "Cache-Control",
-                    "X-Edge-Cache")
+                    "X-Edge-Cache", "X-Asset-Encoding")
+
+# The asset-tier GET surface a backend exposes (serve/server.py) — the
+# router mirrors it so a SceneFetcher or browser pointed at the fleet
+# sees one scene-asset origin.
+_SCENE_ASSET_RE = re.compile(r"^/scene/([^/]+)/asset/([0-9a-f]{64})$")
+_SCENE_PAGE_RE = re.compile(r"^/scene/([^/]+)/(manifest|viewer)$")
 
 
 class _RouterHandler(BaseHTTPRequestHandler):
@@ -1391,8 +1539,56 @@ class _RouterHandler(BaseHTTPRequestHandler):
         return
       self._send_json(self.router.tsdb_snapshot(
           family=family, recent_s=recent, points=points))
+    elif parsed.path == "/scenes":
+      self._send_json(self.router.scenes())
+    elif parsed.path.startswith("/scene/"):
+      self._do_scene_get(parsed.path)
     else:
       self._send_json({"error": f"unknown path {self.path}"}, status=404)
+
+  def _do_scene_get(self, path: str) -> None:
+    """Route a scene-asset GET (manifest / viewer / digest-addressed
+    asset) with the same error mapping as ``/render``: 503 + Retry-After
+    when every breaker is open, 502 when every replica failed, 404 for
+    unplaced scenes. Conditional headers forward both ways so a 304
+    from a backend's immutable asset rides through unchanged."""
+    asset = _SCENE_ASSET_RE.match(path)
+    page = _SCENE_PAGE_RE.match(path)
+    if asset is None and page is None:
+      self._send_json({"error": f"unknown path {path}"}, status=404)
+      return
+    scene_id = urllib.parse.unquote((asset or page).group(1))
+    try:
+      status, headers, body = self.router.forward_scene_get(
+          scene_id, path,
+          if_none_match=self.headers.get("If-None-Match"),
+          kind="asset" if asset is not None else "manifest")
+    except KeyError as e:
+      self._send_json({"error": str(e)}, status=404)
+      return
+    except AllReplicasOpenError as e:
+      retry_after = max(1, math.ceil(e.retry_after_s)) if e.retry_after_s \
+          else 1
+      self._send_json(
+          {"error": str(e), "retry_after_s": e.retry_after_s}, status=503,
+          extra_headers={"Retry-After": str(retry_after)})
+      return
+    except ReplicasExhaustedError as e:
+      self._send_json({"error": str(e), "attempts": e.attempts},
+                      status=502)
+      return
+    except Exception as e:  # noqa: BLE001 - the contract is 502, never 500
+      self._send_json({"error": f"routing failed: {e}"}, status=502)
+      return
+    out_headers = {}
+    for name in _FORWARD_HEADERS:
+      value = next((v for k, v in headers.items()
+                    if k.lower() == name.lower()), None)
+      if value is not None:
+        out_headers[name] = value
+    if "X-Backend-Id" in headers:
+      out_headers["X-Backend-Id"] = headers["X-Backend-Id"]
+    self._send_bytes(body, status=status, extra_headers=out_headers)
 
   def do_POST(self):  # noqa: N802 - stdlib name
     if self.path == "/gossip":

@@ -111,10 +111,18 @@ class CachedFrame:
   tiles: frozenset | None = None
 
 
+def strong_etag(token: str) -> str:
+  """Quote an opaque token as a strong HTTP ETag — the one quoting
+  convention shared by edge frames and the content-addressed asset tier
+  (``serve/assets``), so If-None-Match comparisons are byte-exact
+  across both."""
+  return f'"{token}"'
+
+
 def _etag(scene_id: str, digest: str, cell: tuple, seq: int) -> str:
   token = hashlib.sha1(
       f"{scene_id}\x00{digest}\x00{cell}\x00{seq}".encode()).hexdigest()[:20]
-  return f'"{token}"'
+  return strong_etag(token)
 
 
 class EdgeFrameCache:

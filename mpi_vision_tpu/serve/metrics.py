@@ -104,6 +104,23 @@ class ServeMetrics:
       self.tiles_touched = 0
       self.tiles_rendered = 0
       self.tiles_culled = 0
+      # Asset-tier accounting (serve/assets/): manifest/asset request
+      # outcomes on the serving side, tile-diff sync outcomes on the
+      # fetching side. Always present in the snapshot (zeros while the
+      # tier is off) so the mpi_serve_asset_* / mpi_serve_scene_sync_*
+      # families are always exposed.
+      self.asset_manifest_requests = 0
+      self.asset_requests = 0
+      self.asset_not_found = 0
+      self.asset_not_modified = 0
+      self.asset_bytes_served = 0
+      self.asset_encodes = 0
+      self.asset_publish_rejects = 0
+      self.scene_sync_runs = 0
+      self.scene_sync_tiles_fetched = 0
+      self.scene_sync_tiles_reused = 0
+      self.scene_sync_bytes = 0
+      self.scene_sync_failures = 0
       # Per-scene latency breakdown (hot-scene regression hunting):
       # scene -> [count, sum_s, max_s, deque(recent latencies)].
       self._per_scene: dict = {}
@@ -270,6 +287,46 @@ class ServeMetrics:
       self.tiles_rendered += int(rendered)
       self.tiles_culled += max(int(total) - int(rendered), 0)
 
+  def record_asset_request(self, kind: str, outcome: str,
+                           nbytes: int = 0) -> None:
+    """One asset-tier GET: ``kind`` is "manifest" or "asset"; ``outcome``
+    is "ok" / "not_modified" (304 revalidation) / "not_found"; ``nbytes``
+    the body bytes actually sent (0 for 304s and 404s)."""
+    with self._lock:
+      if kind == "manifest":
+        self.asset_manifest_requests += 1
+      else:
+        self.asset_requests += 1
+      if outcome == "not_modified":
+        self.asset_not_modified += 1
+      elif outcome == "not_found":
+        self.asset_not_found += 1
+      self.asset_bytes_served += int(nbytes)
+
+  def record_asset_encode(self) -> None:
+    """One asset (re-)encoded from live scene data (publish or LRU
+    miss) — the cost content addressing amortizes away."""
+    with self._lock:
+      self.asset_encodes += 1
+
+  def record_asset_publish_reject(self) -> None:
+    """One corrupt bake refused at the digest-vs-bytes gate."""
+    with self._lock:
+      self.asset_publish_rejects += 1
+
+  def record_scene_sync(self, tiles_fetched: int, tiles_reused: int,
+                        bytes_fetched: int) -> None:
+    """One completed tile-diff scene sync pulled INTO this service."""
+    with self._lock:
+      self.scene_sync_runs += 1
+      self.scene_sync_tiles_fetched += int(tiles_fetched)
+      self.scene_sync_tiles_reused += int(tiles_reused)
+      self.scene_sync_bytes += int(bytes_fetched)
+
+  def record_scene_sync_failure(self) -> None:
+    with self._lock:
+      self.scene_sync_failures += 1
+
   def record_warp_pose_error(self, trans: float, rot_deg: float,
                              trace_id: str | None = None) -> None:
     """One edge warp-serve's pose error (how far the served frame's
@@ -354,6 +411,22 @@ class ServeMetrics:
               "mean_touched": (round(
                   self.tiles_touched / self.tiled_requests, 3)
                   if self.tiled_requests else None),
+          },
+          "assets": {
+              "manifest_requests": self.asset_manifest_requests,
+              "requests": self.asset_requests,
+              "not_found": self.asset_not_found,
+              "not_modified": self.asset_not_modified,
+              "bytes_served": self.asset_bytes_served,
+              "encodes": self.asset_encodes,
+              "publish_rejects": self.asset_publish_rejects,
+          },
+          "scene_sync": {
+              "runs": self.scene_sync_runs,
+              "tiles_fetched": self.scene_sync_tiles_fetched,
+              "tiles_reused": self.scene_sync_tiles_reused,
+              "bytes_fetched": self.scene_sync_bytes,
+              "failures": self.scene_sync_failures,
           },
           # Native-histogram snapshots (JSON-ready, obs/hist.py): the
           # source for the mpi_serve_*_nativehist families, the request

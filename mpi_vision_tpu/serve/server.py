@@ -24,6 +24,17 @@ stdlib ``http.server`` front end:
                    traffic (409 while one is in flight; 503 unless the
                    service was built with a profile dir); a configured
                    profile hook receives the finished capture dir
+  GET  /scenes  -> {"scenes": [...]} — the asset tier's discovery
+                   endpoint (what a SceneFetcher sweeps)
+  GET  /scene/{id}/manifest -> versioned JSON manifest (tile grid,
+                   per-tile sha256 digests, depths, intrinsics); ETag =
+                   scene digest, Cache-Control: no-cache (tiled only)
+  GET  /scene/{id}/asset/{digest} -> immutable content-addressed bytes
+                   (zlib'd raw-f32 tile or per-plane PNG); strong ETag,
+                   Cache-Control: public, max-age=31536000, immutable
+  GET  /scene/{id}/viewer -> the CSS-3D layer viewer HTML templated
+                   against asset URLs (layers stream through the CDN
+                   path, not inlined base64)
   POST /render  -> body {"scene_id": str, "pose": [[...4x4...]]} ->
                    {"scene_id", "shape", "dtype", "image_b64"} — raw
                    little-endian f32 pixels, base64 (shape [H, W, 3]).
@@ -88,6 +99,7 @@ from mpi_vision_tpu.obs.trace import (
 )
 from mpi_vision_tpu.serve import cache as cache_mod
 from mpi_vision_tpu.serve import tiles as tiles_mod
+from mpi_vision_tpu.serve.assets import store as assets_mod
 from mpi_vision_tpu.serve.edge import EdgeConfig, EdgeFrameCache, warp_frame
 from mpi_vision_tpu.serve.edge.lattice import pose_error
 from mpi_vision_tpu.serve.engine import RenderEngine
@@ -261,7 +273,8 @@ class RenderService:
   def __init__(self, cache_bytes: int = 2 << 30, max_batch: int = 8,
                max_wait_ms: float = 2.0, max_inflight: "int | str" = 4,
                max_inflight_cap: int = 16,
-               method: str = "fused", tile: int | None = None,
+               method: str = "fused", tile: "int | str | None" = None,
+               asset_cache_bytes: int = 256 << 20,
                convention: "Convention | None" = None,
                use_mesh: bool | None = None, max_queue: int = 1024,
                engine: RenderEngine | None = None,
@@ -294,7 +307,9 @@ class RenderService:
           f"max_inflight must be an int or 'auto', got {max_inflight!r}")
     elif max_inflight < 1:
       raise ValueError(f"max_inflight must be >= 1, got {max_inflight}")
-    if tile is not None and tile < 8:
+    if isinstance(tile, str) and tile != "auto":
+      raise ValueError(f"tile must be an int, 'auto', or None, got {tile!r}")
+    if tile is not None and tile != "auto" and tile < 8:
       # Below 8 px the crop-correction affines degenerate (1-px crops
       # divide by zero under the reference conventions) and the per-tile
       # bookkeeping dwarfs the pixels it manages.
@@ -307,7 +322,11 @@ class RenderService:
           "tile-granular serving requires an XLA method "
           "('fused'/'scan'/'assoc'); method='fused_pallas' cannot "
           "render cropped sources")
-    self.tile = int(tile) if tile is not None else None
+    # "auto" derives a per-scene size from its dims at publish
+    # (tiles_mod.auto_tile); every `self.tile is not None` gate below
+    # treats it exactly like an explicit size.
+    self.tile = tile if tile == "auto" else (
+        int(tile) if tile is not None else None)
     self._clock = clock
     # The engine's own window must not be the bottleneck under retries
     # (an abandoned attempt can briefly hold a slot next to its retry's)
@@ -380,6 +399,12 @@ class RenderService:
     # budget (total tiled residency <= 1.25x --cache-mb).
     self._crop_memo_budget = max(int(cache_bytes) // 4, 1)
     self._crop_lock = threading.Lock()
+    # Content-addressed asset tier (serve/assets/): rides the tile
+    # digests, so it exists exactly when tiling does. The store holds
+    # ENCODED bytes (zlib tiles, PNG layers) under its own byte budget;
+    # evicted assets re-encode from live scene data on demand.
+    self.assets = (assets_mod.AssetStore(byte_budget=asset_cache_bytes)
+                   if self.tile is not None else None)
     # The edge frame cache (serve/edge/): per-scene generation counters
     # make the params digest change on every add_scene/swap_scenes, so a
     # live reload orphans every cached cell of the old pixels; the base
@@ -520,8 +545,11 @@ class RenderService:
     invalidate exactly the tiles whose bytes changed. Returns the
     changed tile ids (every tile for a first publish or a grid/geometry
     change)."""
+    tile_px = (self.tile if isinstance(self.tile, int)
+               else tiles_mod.auto_tile(entry[0].shape[0],
+                                        entry[0].shape[1]))
     meta = tiles_mod.TileMeta.build(entry[0], entry[1], entry[2],
-                                    self.tile)
+                                    tile_px)
     with self._scene_lock:
       old = self._tile_meta.get(sid)
       self._scene_data[sid] = entry
@@ -538,6 +566,7 @@ class RenderService:
       self._purge_crop_memo(sid)
       if self.edge is not None:
         self.edge.invalidate_scene(sid)
+      self._publish_assets(sid, meta, changed)
       return changed
     changed = old.changed_tiles(meta)
     all_changed = len(changed) == len(meta.grid) or old.grid != meta.grid
@@ -560,7 +589,29 @@ class RenderService:
         self.edge.invalidate_scene(sid)
       else:
         self.edge.invalidate_tiles(sid, changed)
+    self._publish_assets(sid, meta, changed)
     return changed
+
+  def _publish_assets(self, sid: str, meta, changed) -> None:
+    """Register the new generation's tile digests with the asset store
+    and announce the manifest. Unchanged tiles keep their digests, so
+    their asset URLs/ETags survive the publish byte-identical — the
+    asset-tier mirror of the tile-granular cache invalidation above."""
+    if self.assets is None:
+      return
+    grid = meta.grid
+    planes = int(meta.depths.shape[0])
+    index = {}
+    for i in range(grid.rows):
+      for j in range(grid.cols):
+        y0, y1, x0, x1 = grid.rect(i, j)
+        index[meta.digests[i][j]] = {
+            "kind": "tile", "scene_id": sid, "row": i, "col": j,
+            "shape": (y1 - y0, x1 - x0, planes, 4)}
+    self.assets.publish_scene(sid, index)
+    self.events.emit("manifest_publish", scene_id=sid,
+                     scene_digest=meta.scene_digest, tiles=len(grid),
+                     tiles_changed=len(changed))
 
   def _purge_crop_memo(self, sid: str) -> None:
     with self._crop_lock:
@@ -581,6 +632,156 @@ class RenderService:
   def scene_ids(self) -> list[str]:
     with self._scene_lock:
       return sorted(self._scene_data)
+
+  def tile_meta(self, scene_id: str):
+    """The current ``TileMeta`` of a tiled scene (None if unknown or
+    the service is untiled) — the ``SceneFetcher`` diff's local side."""
+    with self._scene_lock:
+      return self._tile_meta.get(str(scene_id))
+
+  def scene_entry(self, scene_id: str):
+    """The registered host arrays ``(rgba, depths, intrinsics)`` of a
+    scene, or None. Shared read-only by convention — callers that
+    mutate must copy."""
+    with self._scene_lock:
+      return self._scene_data.get(str(scene_id))
+
+  # -- content-addressed asset tier (serve/assets/) -----------------------
+
+  def _require_assets(self) -> None:
+    if self.assets is None:
+      raise RuntimeError(
+          "the asset tier rides the tile digests: construct "
+          "RenderService with tile= (serve --tiled)")
+
+  def scene_manifest(self, scene_id: str) -> dict:
+    """The versioned scene manifest (``GET /scene/{id}/manifest``).
+
+    Built lazily per generation and cached by scene digest; the first
+    build also bakes the per-plane layer PNGs the viewer composites.
+    Raises KeyError for unknown scenes.
+    """
+    self._require_assets()
+    sid = str(scene_id)
+    meta = self.tile_meta(sid)
+    if meta is None:
+      raise KeyError(f"unknown scene {sid!r}")
+    cached = self.assets.manifest(sid, meta.scene_digest)
+    if cached is not None:
+      return cached
+    entry = self.scene_entry(sid)
+    layers = self._publish_layer_assets(sid, meta, entry)
+    manifest = assets_mod.build_manifest(
+        sid, meta, params_digest=f"{self._edge_base}:tiled",
+        layers=layers)
+    # Cache only if this generation is still current (a concurrent swap
+    # may have republished mid-build; the next request rebuilds).
+    if self.tile_meta(sid) is meta:
+      self.assets.cache_manifest(sid, meta.scene_digest, manifest)
+    return manifest
+
+  def _publish_layer_assets(self, sid: str, meta, entry) -> list[str]:
+    """Encode each MPI plane as a PNG asset (the viewer's sources),
+    addressed by the sha256 of the PNG bytes. Returns the digests,
+    index 0 farthest (the template's compositing order)."""
+    from mpi_vision_tpu.viewer import export as viewer_export
+
+    rgba = entry[0]
+    digests, index = [], {}
+    for plane in range(rgba.shape[2]):
+      png = viewer_export.layer_to_png_bytes(rgba[:, :, plane])
+      digest = assets_mod.digest_of(png)
+      self.metrics.record_asset_encode()
+      self.assets.put(digest, png, png,
+                      {"kind": "layer", "content_type":
+                       assets_mod.LAYER_CONTENT_TYPE,
+                       "encoding": assets_mod.LAYER_ENCODING})
+      index[digest] = {"kind": "layer", "scene_id": sid, "plane": plane}
+      digests.append(digest)
+    self.assets.register_assets(sid, index)
+    return digests
+
+  def scene_asset(self, scene_id: str, digest: str) -> tuple[bytes, dict]:
+    """Encoded bytes + serving metadata of one content-addressed asset.
+
+    Resident bytes serve straight from the LRU; an evicted-but-live
+    digest re-encodes from scene data (digest-verified — a scene that
+    changed under a stale descriptor can never serve wrong bytes).
+    Raises KeyError when the digest is neither resident nor live: 404.
+    The scene id in the URL only scopes routing; the digest alone names
+    the bytes.
+    """
+    self._require_assets()
+    hit = self.assets.get(digest)
+    if hit is not None:
+      return hit
+    desc = self.assets.source(digest)
+    if desc is None:
+      raise KeyError(f"unknown asset digest {digest[:12]}…")
+    tr = self.tracer.start_trace("asset_encode",
+                                 scene_id=desc["scene_id"],
+                                 digest=digest[:12])
+    try:
+      out = self._encode_asset(desc, digest)
+    except Exception as e:
+      tr.finish(error=repr(e))
+      raise
+    tr.finish()
+    return out
+
+  def _encode_asset(self, desc: dict, digest: str) -> tuple[bytes, dict]:
+    sid = desc["scene_id"]
+    entry = self.scene_entry(sid)
+    meta = self.tile_meta(sid)
+    if entry is None or meta is None:
+      raise KeyError(f"asset {digest[:12]}… lost its scene {sid!r}")
+    self.metrics.record_asset_encode()
+    if desc["kind"] == "tile":
+      y0, y1, x0, x1 = meta.grid.rect(desc["row"], desc["col"])
+      raw = np.ascontiguousarray(entry[0][y0:y1, x0:x1]).tobytes()
+      encoded = assets_mod.encode_tile(raw)
+      serve_meta = {"kind": "tile",
+                    "content_type": assets_mod.TILE_CONTENT_TYPE,
+                    "encoding": assets_mod.TILE_ENCODING}
+    else:
+      from mpi_vision_tpu.viewer import export as viewer_export
+
+      raw = encoded = viewer_export.layer_to_png_bytes(
+          entry[0][:, :, desc["plane"]])
+      serve_meta = {"kind": "layer",
+                    "content_type": assets_mod.LAYER_CONTENT_TYPE,
+                    "encoding": assets_mod.LAYER_ENCODING}
+    try:
+      self.assets.put(digest, raw, encoded, serve_meta)
+    except assets_mod.AssetIntegrityError:
+      # The scene changed between descriptor registration and this
+      # encode (or the bake is corrupt): the digest no longer names
+      # producible bytes. Refuse to serve — immutability means wrong
+      # bytes under a digest would be cached forever downstream.
+      self.metrics.record_asset_publish_reject()
+      raise
+    return encoded, serve_meta
+
+  def scene_viewer_html(self, scene_id: str) -> tuple[str, str]:
+    """The browser viewer for one scene, templated against asset URLs
+    (no inlined base64 — layers ride the immutable asset path).
+    Returns ``(html, scene_digest)``; the digest is the ETag token.
+    """
+    from mpi_vision_tpu.viewer import export as viewer_export
+
+    sid = str(scene_id)
+    man = self.scene_manifest(sid)
+    quoted = urllib.parse.quote(sid, safe="")
+    sources = [f"/scene/{quoted}/asset/{d}" for d in man["layers"]]
+    depths = man["depths"]
+    grid = man["grid"]
+    fx = float(man["intrinsics"][0][0])
+    fov_deg = math.degrees(2.0 * math.atan2(grid["width"] / 2.0,
+                                            max(fx, 1e-6)))
+    html = viewer_export.render_viewer_html(
+        sources, grid["width"], grid["height"],
+        near=min(depths), far=max(depths), fov_deg=fov_deg)
+    return html, man["scene_digest"]
 
   def _tile_batch_key(self, scene_id: str, pose) -> tuple[str, dict | None]:
     """The scheduler's batch-key hook for tiled services: frustum-cull
@@ -1115,6 +1316,8 @@ class RenderService:
                                      "byte_budget":
                                          self._crop_memo_budget}
       out["tile_cache"] = self._tile_cache.stats()
+    if self.assets is not None:
+      out["assets"]["cache"] = self.assets.stats()
     out["engine"] = self.engine.describe()
     if self.resilient is not None:
       out["breaker"] = self.resilient.breaker.snapshot()
@@ -1295,6 +1498,12 @@ def _inbound_trace_id(headers) -> str | None:
   return trace_id
 
 
+# Asset-tier routes (serve/assets/): the digest is 64 lowercase sha256
+# hex — anything else is a 404, never a lookup.
+_ASSET_PATH_RE = re.compile(r"^/scene/([^/]+)/asset/([0-9a-f]{64})$")
+_SCENE_PATH_RE = re.compile(r"^/scene/([^/]+)/(manifest|viewer)$")
+
+
 class _Handler(BaseHTTPRequestHandler):
   """One request per thread (ThreadingHTTPServer); blocking on the
   scheduler future is what feeds concurrent HTTP load into one batch."""
@@ -1379,8 +1588,78 @@ class _Handler(BaseHTTPRequestHandler):
       self._do_tsdb(parsed.query)
     elif parsed.path == "/debug/profile":
       self._do_profile(parsed.query)
+    elif parsed.path == "/scenes":
+      # The asset tier's discovery endpoint: what a SceneFetcher sweeps.
+      self._send_json({"scenes": self.service.scene_ids()})
+    elif parsed.path.startswith("/scene/"):
+      self._do_scene(parsed.path)
     else:
       self._send_json({"error": f"unknown path {self.path}"}, status=404)
+
+  def _if_none_match(self, etag: str) -> bool:
+    header = self.headers.get("If-None-Match", "")
+    return etag in (tok.strip() for tok in header.split(","))
+
+  def _do_scene(self, path: str) -> None:
+    """Asset-tier GETs: ``/scene/{id}/manifest`` (revalidatable JSON),
+    ``/scene/{id}/asset/{digest}`` (immutable content-addressed bytes),
+    ``/scene/{id}/viewer`` (the layer-compositing browser viewer)."""
+    svc = self.service
+    asset = _ASSET_PATH_RE.match(path)
+    scene = _SCENE_PATH_RE.match(path)
+    if (asset is None and scene is None) or svc.assets is None:
+      self._send_json({"error": f"unknown path {self.path}"}, status=404)
+      return
+    if asset is not None:
+      sid = urllib.parse.unquote(asset.group(1))
+      digest = asset.group(2)
+      etag = assets_mod.asset_etag(digest)
+      headers = {"ETag": etag,
+                 "Cache-Control": assets_mod.ASSET_CACHE_CONTROL,
+                 "X-Scene-Id": sid}
+      if self._if_none_match(etag):
+        # Immutable means ANY cached copy is current: revalidations
+        # match on the digest alone, no scene lookup at all.
+        svc.metrics.record_asset_request("asset", "not_modified")
+        self._send_bytes(b"", status=304, extra_headers=headers)
+        return
+      try:
+        body, meta = svc.scene_asset(sid, digest)
+      except (KeyError, assets_mod.AssetIntegrityError):
+        svc.metrics.record_asset_request("asset", "not_found")
+        self._send_json({"error": f"unknown asset {digest[:12]}"},
+                        status=404)
+        return
+      svc.metrics.record_asset_request("asset", "ok", nbytes=len(body))
+      headers["X-Asset-Encoding"] = meta["encoding"]
+      self._send_bytes(body, content_type=meta["content_type"],
+                       extra_headers=headers)
+      return
+    sid = urllib.parse.unquote(scene.group(1))
+    kind = scene.group(2)
+    try:
+      if kind == "manifest":
+        man = svc.scene_manifest(sid)
+        body = svc.assets.manifest_bytes(man)
+        token, ctype = man["scene_digest"], "application/json"
+      else:
+        html, token = svc.scene_viewer_html(sid)
+        body, ctype = html.encode(), "text/html; charset=utf-8"
+    except KeyError:
+      svc.metrics.record_asset_request("manifest", "not_found")
+      self._send_json({"error": f"unknown scene {sid!r}"}, status=404)
+      return
+    etag = assets_mod.manifest_etag(token)
+    # The manifest names the CURRENT generation: always revalidate
+    # (no-cache), always cheap (304 against the scene digest).
+    headers = {"ETag": etag, "Cache-Control": "no-cache",
+               "X-Scene-Id": sid}
+    if self._if_none_match(etag):
+      svc.metrics.record_asset_request("manifest", "not_modified")
+      self._send_bytes(b"", status=304, extra_headers=headers)
+      return
+    svc.metrics.record_asset_request("manifest", "ok", nbytes=len(body))
+    self._send_bytes(body, content_type=ctype, extra_headers=headers)
 
   def _do_tsdb(self, query: str) -> None:
     """``/debug/tsdb?family=&recent=&points=``: windowed history from

@@ -73,6 +73,34 @@ def tile_cache_key(scene_id: str, row: int, col: int) -> str:
   return f"{scene_id}{KEY_SEP}t{row},{col}"
 
 
+# ``auto_tile`` targets this many tiles per scene: enough granularity
+# that a frustum cull and a tile-diff reload both win (a changed region
+# invalidates ~1/64th of the scene, not half of it), few enough that
+# per-tile bookkeeping (digests, cache keys, asset manifests) stays
+# negligible next to the pixels.
+AUTO_TILE_TARGET = 64
+AUTO_TILE_MIN = 8
+
+
+def auto_tile(height: int, width: int,
+              target_tiles: int = AUTO_TILE_TARGET) -> int:
+  """Derive a tile edge from scene dims (``--tile-size auto``).
+
+  Picks the multiple of 8 whose grid lands closest under
+  ``target_tiles`` tiles, clamped to ``[AUTO_TILE_MIN, max(H, W)]`` —
+  small scenes degenerate to one tile per scene rather than sub-8px
+  tiles (below 8 px the crop-correction affines degenerate; the same
+  floor ``RenderService`` enforces for explicit sizes). Deterministic:
+  equal dims always pick equal sizes, so two processes syncing a scene
+  by manifest diff (``serve/assets``) compute identical grids.
+  """
+  if height < 1 or width < 1:
+    raise ValueError(f"bad scene dims {height}x{width}")
+  edge = math.sqrt(height * width / target_tiles)
+  edge = max(AUTO_TILE_MIN, int(round(edge / 8)) * 8)
+  return min(edge, max(height, width))
+
+
 @dataclasses.dataclass(frozen=True)
 class TileGrid:
   """A fixed tile grid over an ``H x W`` scene (ragged last row/col)."""

@@ -365,6 +365,22 @@ def to_data_uri(png_bytes: bytes) -> str:
   return "data:image/png;base64," + base64.b64encode(png_bytes).decode()
 
 
+def render_viewer_html(sources: list, w: int, h: int,
+                       near: float = 1.0, far: float = 100.0,
+                       fov_deg: float = 60.0) -> str:
+  """Template the CSS-3D viewer against ``sources`` (one image source
+  per plane, index 0 farthest) — data URIs for the self-contained
+  export, or plain URLs so a browser pulls each layer through the
+  content-addressed asset path (``GET /scene/{id}/viewer``)."""
+  return (_HTML_TEMPLATE
+          .replace("__MPI_SOURCES__",
+                   "[" + ",".join(f'"{u}"' for u in sources) + "]")
+          .replace("__W__", str(w)).replace("__H__", str(h))
+          .replace("__NEAR__", repr(float(near)))
+          .replace("__FAR__", repr(float(far)))
+          .replace("__FOV__", repr(float(fov_deg))))
+
+
 def export_viewer_html(rgba_layers: np.ndarray, out_path: str,
                        near: float = 1.0, far: float = 100.0,
                        fov_deg: float = 60.0) -> str:
@@ -378,13 +394,8 @@ def export_viewer_html(rgba_layers: np.ndarray, out_path: str,
   h, w, p, _ = rgba_layers.shape
   uris = [to_data_uri(layer_to_png_bytes(rgba_layers[:, :, i]))
           for i in range(p)]
-  html = (_HTML_TEMPLATE
-          .replace("__MPI_SOURCES__",
-                   "[" + ",".join(f'"{u}"' for u in uris) + "]")
-          .replace("__W__", str(w)).replace("__H__", str(h))
-          .replace("__NEAR__", repr(float(near)))
-          .replace("__FAR__", repr(float(far)))
-          .replace("__FOV__", repr(float(fov_deg))))
+  html = render_viewer_html(uris, w, h, near=near, far=far,
+                            fov_deg=fov_deg)
   with open(out_path, "w") as f:
     f.write(html)
   return out_path

@@ -29,6 +29,7 @@ import re
 import mpi_vision_tpu.ckpt
 import mpi_vision_tpu.obs
 import mpi_vision_tpu.serve
+import mpi_vision_tpu.serve.assets
 import mpi_vision_tpu.serve.cluster
 import mpi_vision_tpu.serve.edge
 import mpi_vision_tpu.train.faultinject
@@ -46,9 +47,9 @@ def _package_sources(pkg):
 
 
 def _linted_sources():
-  for pkg in (mpi_vision_tpu.serve, mpi_vision_tpu.serve.cluster,
-              mpi_vision_tpu.serve.edge, mpi_vision_tpu.obs,
-              mpi_vision_tpu.ckpt):
+  for pkg in (mpi_vision_tpu.serve, mpi_vision_tpu.serve.assets,
+              mpi_vision_tpu.serve.cluster, mpi_vision_tpu.serve.edge,
+              mpi_vision_tpu.obs, mpi_vision_tpu.ckpt):
     yield from _package_sources(pkg)
   yield pathlib.Path(mpi_vision_tpu.train.loop.__file__)
   yield pathlib.Path(mpi_vision_tpu.train.telemetry.__file__)
@@ -92,6 +93,9 @@ def test_lint_covers_the_ckpt_package_and_train_loop():
           # heartbeats ARE timestamps — one bare clock call desyncs
           # the anti-entropy merge from the takeover math.
           "cluster/gossip.py", "cluster/lease.py",
+          # The asset tier (PR 16): sync sweep timing and watcher polls
+          # ride the same injected clocks as the checkpoint watcher.
+          "assets/store.py", "assets/fetch.py",
           "edge/cache.py", "edge/lattice.py", "edge/warp.py",
           "obs/slo.py", "obs/events.py", "obs/trace.py",
           "obs/prom.py", "obs/hist.py", "obs/tsdb.py",

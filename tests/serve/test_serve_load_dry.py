@@ -179,6 +179,30 @@ def test_serve_load_tiled_ab_dry_smoke():
   assert "tiles" not in out["full"]
 
 
+def test_serve_load_asset_ab_dry_smoke():
+  """The asset delivery tier's tier-1 smoke: manifest + every tile
+  asset over real HTTP (cold), full 304 revalidation (warm — the bench
+  itself aborts if any conditional GET misses), a full cross-process
+  SceneFetcher sync, and the quarter-scene diff re-sync. The PINNED
+  acceptance number: diff-sync bytes strictly below both the full-sync
+  bytes (the bench aborts otherwise) and the full-checkpoint bytes —
+  tiles moved, not frames, not checkpoints."""
+  out = _run_dry(["--asset-ab"])
+  assert out["metric"] == "serve_load_asset_ab" and out["dry"] is True
+  assert out["cold"]["assets"] == out["tiles_total"] >= 4
+  assert out["cold"]["bytes"] > 0
+  assert out["warm"]["not_modified"] == out["tiles_total"] + 1  # +manifest
+  assert out["warm"]["bytes"] == 0  # 304s carry no bodies
+  assert out["full_sync"]["tiles_fetched"] == out["tiles_total"]
+  # The diff moved only the mutated quarter — and measurably fewer
+  # bytes than shipping the scene as a checkpoint would.
+  assert 0 < out["diff_sync"]["tiles_fetched"] < out["tiles_total"]
+  assert out["diff_sync"]["bytes"] < out["full_sync"]["bytes"]
+  assert out["diff_sync"]["bytes"] < out["full_checkpoint_bytes"]
+  assert out["value"] == round(
+      out["diff_sync"]["bytes"] / out["full_checkpoint_bytes"], 4)
+
+
 def test_serve_load_cluster_dry_smoke():
   """The multi-host tier's tier-1 smoke: spawn real backend processes,
   route through the cluster Router, SIGKILL one backend mid-window, and

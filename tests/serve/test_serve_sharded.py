@@ -1,29 +1,29 @@
-"""Multi-device serving on the 8-device CPU mesh (subprocess).
+"""Multi-device serving on the 8-device CPU mesh (in-process).
 
-Runs the scheduler + sharded engine dispatch under
-``XLA_FLAGS=--xla_force_host_platform_device_count=8`` in its own process
-(tests/test_parallel.py exercises the mesh primitives in-process; serving
-owns threads and a jit cache, so it gets a clean interpreter) and asserts
-the batching invariant at full strength: coalesced batched results are
-bit-for-bit equal (f32) to per-request renders dispatched one at a time
-through the same sharded engine. Against a *single-device* engine the
-sharded render is allclose but NOT bitwise — XLA compiles the shard_map
-program separately and f32 rounding differs in the last ulp — so the
-cross-engine check is atol=1e-5 (same tolerance as test_parallel.py).
+Runs the scheduler + sharded engine dispatch on the suite's own
+8-device mesh (tests/conftest.py forces it for every test process) and
+asserts the batching invariant at full strength: coalesced batched
+results are bit-for-bit equal (f32) to per-request renders dispatched
+one at a time through the same sharded engine. Against a
+*single-device* engine the sharded render is allclose but NOT bitwise —
+XLA compiles the shard_map program separately and f32 rounding differs
+in the last ulp — so the cross-engine check is atol=1e-5 (same
+tolerance as test_parallel.py).
+
+This used to spawn a subprocess for interpreter hygiene; the service
+closes its scheduler threads on ``close()`` and the jit cache is keyed
+by shape, so in-process costs nothing and saves the ~per-test
+interpreter + jax import (tier-1 seconds are the scarce resource).
 """
 
-import os
-import subprocess
-import sys
-import textwrap
+import numpy as np
 
-_SCRIPT = textwrap.dedent("""
-    import sys
-    sys.path.insert(0, {repo!r})
-    import numpy as np
-    from mpi_vision_tpu.serve import RenderEngine, RenderService
+from mpi_vision_tpu.serve import RenderEngine, RenderService
 
-    svc = RenderService(max_batch=8, max_wait_ms=500.0, use_mesh=True)
+
+def test_sharded_serving_batches_bit_for_bit():
+  svc = RenderService(max_batch=8, max_wait_ms=500.0, use_mesh=True)
+  try:
     svc.add_synthetic_scenes(1, height=16, width=16, planes=4)
     assert svc.engine.describe()["devices"] == 8, svc.engine.describe()
 
@@ -53,24 +53,5 @@ _SCRIPT = textwrap.dedent("""
     for pose, out in zip(poses, outs):
       ref = single.render_one(scene, pose)
       np.testing.assert_allclose(out, ref, atol=1e-5)
-
+  finally:
     svc.close()
-    print("SERVE_SHARDED_OK")
-""")
-
-
-def test_sharded_serving_batches_bit_for_bit():
-  repo = os.path.dirname(os.path.dirname(os.path.dirname(
-      os.path.abspath(__file__))))
-  sys.path.insert(0, repo)
-  from _cpu_mesh import hardened_env
-
-  env = hardened_env(8)
-  # Share the suite's persistent XLA cache so reruns skip the compiles.
-  env["JAX_COMPILATION_CACHE_DIR"] = os.path.join(repo, ".jax_cache")
-  proc = subprocess.run(
-      [sys.executable, "-c", _SCRIPT.format(repo=repo)],
-      capture_output=True, text=True, timeout=1200, env=env, cwd=repo)
-  assert proc.returncode == 0, (
-      f"sharded serve subprocess failed:\n{proc.stderr[-3000:]}")
-  assert "SERVE_SHARDED_OK" in proc.stdout

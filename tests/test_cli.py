@@ -294,8 +294,37 @@ def test_serve_tile_knobs_guarded():
   the operator asked for."""
   with pytest.raises(SystemExit, match=r"require\(s\) --tiled"):
     cli.main(["serve", "--tile-size", "64", "--duration", "0.1"])
+  with pytest.raises(SystemExit, match=r"require\(s\) --tiled"):
+    cli.main(["serve", "--tile-size", "auto", "--duration", "0.1"])
   with pytest.raises(SystemExit, match="--tile-size must be >= 8"):
     cli.main(["serve", "--tiled", "--tile-size", "4", "--duration", "0.1"])
+  with pytest.raises(SystemExit,
+                     match="--tile-size must be an integer or 'auto'"):
+    cli.main(["serve", "--tiled", "--tile-size", "big",
+              "--duration", "0.1"])
+
+
+def test_serve_asset_knobs_guarded():
+  """Asset knobs only act through the tiled registry's digest index
+  (serve/assets); dangling any of them would silently serve no
+  manifests, cache nothing, or never sync."""
+  with pytest.raises(SystemExit, match=r"require\(s\) --tiled"):
+    cli.main(["serve", "--asset-cache-mb", "64", "--duration", "0.1"])
+  with pytest.raises(SystemExit, match=r"require\(s\) --tiled"):
+    cli.main(["serve", "--asset-sync-from", "http://primary:8080",
+              "--duration", "0.1"])
+  with pytest.raises(SystemExit, match="--asset-cache-mb must be >= 1"):
+    cli.main(["serve", "--tiled", "--asset-cache-mb", "0",
+              "--duration", "0.1"])
+  with pytest.raises(SystemExit,
+                     match="--asset-sync-interval-s requires "
+                           "--asset-sync-from"):
+    cli.main(["serve", "--tiled", "--asset-sync-interval-s", "2",
+              "--duration", "0.1"])
+  with pytest.raises(SystemExit,
+                     match="--asset-sync-interval-s must be > 0"):
+    cli.main(["serve", "--tiled", "--asset-sync-from", "http://p:8080",
+              "--asset-sync-interval-s", "0", "--duration", "0.1"])
 
 
 def test_cluster_route_cell_knobs_guarded():

@@ -65,6 +65,19 @@ PINNED parity block: the full-coverage (identity) pose must render
 bit-exactly equal in both arms or the run aborts. ``--tiled-ab --dry``
 is the tier-1 smoke.
 
+``--overload-ab`` measures the brownout ladder (``serve/brownout.py``):
+the SAME phased closed-loop load — a baseline window, a ramp to ~3x the
+baseline worker count, then a recovery tail — run once with the
+brownout controller armed and once shed-only (no controller; overload
+resolves by queue-full 503s alone), in one process. Workers carry the
+priority-class mix (half interactive, a quarter each prefetch and
+background) and the JSON line carries both arms: per-class goodput,
+interactive p99, shed/degrade accounting, the sampled brownout level
+trajectory (which must return to L0 in the tail), and each arm's SLO
+verdict — the brownout arm holds its availability objective through the
+ramp while the shed-only arm violates it. ``--overload-ab --dry`` is
+the tier-1 smoke.
+
 ``--inflight N`` sets the streaming-pipeline window (concurrent
 in-flight batches; 1 = the legacy blocking dispatch) and the JSON gains
 the pipeline accounting: ``dispatch_gap`` (device idle between
@@ -136,6 +149,13 @@ def build_parser() -> argparse.ArgumentParser:
                        "bytes) in one process; emits one "
                        "serve_load_asset_ab JSON line. --asset-ab --dry "
                        "is the tier-1 smoke")
+  ap.add_argument("--overload-ab", action="store_true",
+                  help="brownout-vs-shed-only A/B under a ~3x traffic "
+                       "ramp (serve/brownout.py): per-class goodput, "
+                       "interactive p99, level trajectory, and both "
+                       "arms' SLO verdicts in one "
+                       "serve_load_overload_ab JSON line. "
+                       "--overload-ab --dry is the tier-1 smoke")
   ap.add_argument("--tiled-ab", action="store_true",
                   help="run the load twice — tile-granular service "
                        "(frustum-culled crops) vs monolithic — over one "
@@ -1494,6 +1514,234 @@ def asset_ab_main(args) -> int:
   return 0
 
 
+def _overload_calibrate(args) -> float:
+  """Anchor the latency objective to THIS box. The single-stream render
+  is what a healthy service owes one client, so the objective is a
+  multiple of that measurement rather than a wall-clock constant a
+  slower CPU could never meet at any ladder level. Calibrated once and
+  shared by both arms — the A/B judges two policies against one budget.
+  """
+  from mpi_vision_tpu.serve import RenderService
+
+  use_mesh = {"auto": None, "on": True, "off": False}[args.sharded]
+  svc = RenderService(
+      cache_bytes=args.cache_mb << 20, max_batch=args.max_batch,
+      max_wait_ms=args.max_wait_ms, max_inflight=args.inflight,
+      method=args.method, use_mesh=use_mesh)
+  try:
+    ids = svc.add_synthetic_scenes(
+        args.scenes, height=args.img_size, width=args.img_size,
+        planes=args.num_planes, seed=args.seed)
+    svc.warmup()
+    rng = np.random.default_rng(args.seed)
+    samples = []
+    for _ in range(5):
+      t_req = time.perf_counter()
+      svc.render_request(ids[0], random_pose(rng), timeout=60)
+      samples.append(time.perf_counter() - t_req)
+  finally:
+    svc.close()
+  single = float(np.median(samples))
+  # 16x single-stream: room for batching + a healthy queue, but far
+  # below the multi-second pileup a saturated full-res queue produces.
+  threshold_s = max(16.0 * single, 0.05)
+  _log(f"serve_load: overload calibration — single-stream "
+       f"{single * 1e3:.1f} ms, latency objective "
+       f"{threshold_s * 1e3:.1f} ms")
+  return threshold_s
+
+
+def overload_run(args, with_brownout: bool,
+                 latency_threshold_s: float | None = None) -> dict:
+  """One phased overload window: baseline -> ~3x worker ramp ->
+  recovery tail, closed-loop, classes mixed half interactive / quarter
+  prefetch / quarter background. ``with_brownout`` arms the ladder
+  (dwell/eval scaled to the bench window so it can climb AND return to
+  L0 inside one run); off, the same overload resolves by queue-full
+  sheds alone — the baseline a degradation ladder must beat."""
+  from mpi_vision_tpu.obs import SloConfig
+  from mpi_vision_tpu.obs import slo as slo_mod
+  from mpi_vision_tpu.serve import RenderService
+  from mpi_vision_tpu.serve import brownout as brownout_mod
+  from mpi_vision_tpu.serve.scheduler import QueueFullError
+
+  use_mesh = {"auto": None, "on": True, "off": False}[args.sharded]
+  duration = args.duration
+  fast = max(duration / 10.0, 0.2)
+  slo = SloConfig(fast_window_s=fast,
+                  slow_window_s=max(4.0 * duration, fast),
+                  bucket_s=max(fast / 8.0, 0.025), min_requests=5,
+                  latency_threshold_s=latency_threshold_s or 1.0)
+  bo_cfg = None
+  if with_brownout:
+    # Thresholds sized to the closed-loop shape: a baseline of
+    # ``concurrency`` workers keeps ~c/(2c)=0.5 of the queue occupied
+    # at worst (usually less — the pipeline drains it), so recovery
+    # gates above that baseline occupancy and overload trips only under
+    # the 3x ramp.
+    bo_cfg = brownout_mod.BrownoutConfig(
+        step_dwell_s=duration / 25.0,
+        recover_dwell_s=duration / 50.0,
+        eval_interval_s=duration / 400.0,
+        queue_high=0.6, recover_queue=0.3)
+  svc = RenderService(
+      cache_bytes=args.cache_mb << 20, max_batch=args.max_batch,
+      max_wait_ms=args.max_wait_ms, max_inflight=args.inflight,
+      method=args.method, use_mesh=use_mesh,
+      max_queue=max(4, 2 * args.concurrency),
+      slo=slo, brownout=bo_cfg)
+  ids = svc.add_synthetic_scenes(
+      args.scenes, height=args.img_size, width=args.img_size,
+      planes=args.num_planes, seed=args.seed)
+  arm = "brownout" if with_brownout else "shed_only"
+  _log(f"serve_load: overload arm '{arm}' — {len(ids)} scenes "
+       f"[{args.img_size}x{args.img_size}x{args.num_planes}], "
+       f"base {args.concurrency} workers, ramp to {3 * args.concurrency}")
+  svc.warmup()
+  svc.metrics.reset()
+  svc.scheduler.reset_gap_clock()
+  if svc.brownout is not None:
+    svc.brownout.reset_counters()
+
+  n_base = args.concurrency
+  n_total = 3 * args.concurrency
+  classes = ("interactive", "interactive", "prefetch", "background")
+  ramp = (0.2 * duration, 0.7 * duration)
+  t0 = time.perf_counter()
+  stop = threading.Event()
+  lock = threading.Lock()
+  ok: collections.Counter = collections.Counter()
+  shed: collections.Counter = collections.Counter()
+  rejected: collections.Counter = collections.Counter()
+  failed: collections.Counter = collections.Counter()
+  interactive_ms: list[float] = []
+
+  def worker(idx: int) -> None:
+    rng = np.random.default_rng(args.seed + 1 + idx)
+    cls = classes[idx % len(classes)]
+    surge = idx >= n_base
+    while not stop.is_set():
+      now = time.perf_counter() - t0
+      if surge and now < ramp[0]:
+        time.sleep(0.005)
+        continue
+      if surge and now >= ramp[1]:
+        return  # the surge ends; the tail is the recovery phase
+      sid = ids[0] if (rng.random() < 0.5 or len(ids) == 1) \
+          else ids[int(rng.integers(1, len(ids)))]
+      t_req = time.perf_counter()
+      try:
+        svc.render_request(sid, random_pose(rng), request_class=cls,
+                           timeout=60)
+      except brownout_mod.BrownoutShedError:
+        with lock:
+          shed[cls] += 1
+        # Honor the 503's Retry-After in bench-window proportion — a
+        # shed client that redials in 2ms defeats any admission control.
+        time.sleep(duration / 20.0)
+        continue
+      except QueueFullError:
+        with lock:
+          rejected[cls] += 1
+        time.sleep(duration / 20.0)  # same client behavior in both arms
+        continue
+      except Exception as e:  # noqa: BLE001 - overload is the workload
+        with lock:
+          failed[type(e).__name__] += 1
+        time.sleep(0.002)
+        continue
+      dt_ms = (time.perf_counter() - t_req) * 1e3
+      with lock:
+        ok[cls] += 1
+        if cls == "interactive":
+          interactive_ms.append(dt_ms)
+
+  threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+             for i in range(n_total)]
+  for t in threads:
+    t.start()
+  # The main thread doubles as the level sampler: the trajectory is the
+  # A/B's shape proof (climb under the ramp, L0 again in the tail).
+  trajectory: list[int] = []
+  step = duration / 100.0
+  while time.perf_counter() - t0 < duration:
+    if svc.brownout is not None:
+      # Admission ticks the ladder too, but when every client is parked
+      # in shed backoff the sampler is the only reliable heartbeat —
+      # recovery must not depend on traffic cadence.
+      svc.brownout.tick()
+      trajectory.append(svc.brownout.level)
+    else:
+      trajectory.append(0)
+    time.sleep(step)
+  stop.set()
+  for t in threads:
+    t.join(60)
+  elapsed = time.perf_counter() - t0
+  stats = svc.stats()
+  svc.close()
+
+  total_ok = sum(ok.values())
+  if total_ok == 0:
+    raise SystemExit(f"serve_load: overload arm '{arm}' completed "
+                     "no requests")
+  p99 = (round(float(np.percentile(interactive_ms, 99)), 3)
+         if interactive_ms else None)
+  return {
+      "arm": arm,
+      "requests_ok": {c: ok.get(c, 0) for c in set(classes)},
+      "goodput_rps": {c: round(ok.get(c, 0) / elapsed, 3)
+                      for c in set(classes)},
+      "interactive_p99_ms": p99,
+      "sheds": {c: shed.get(c, 0) for c in set(classes)},
+      "queue_rejects": {c: rejected.get(c, 0) for c in set(classes)},
+      "failed": dict(sorted(failed.items())),
+      "brownout": stats.get("brownout"),
+      "level_trajectory": trajectory,
+      "max_level": max(trajectory, default=0),
+      "final_level": trajectory[-1] if trajectory else 0,
+      "returned_to_l0": bool(trajectory) and trajectory[-1] == 0,
+      "errors": stats["errors"],
+      "rejected": stats["rejected"],
+      "slo": slo_mod.verdict(stats.get("slo")),
+  }
+
+
+def overload_ab_main(args) -> int:
+  """The brownout-vs-shed-only A/B: the same ~3x phased overload, once
+  with the degradation ladder armed and once resolving by queue-full
+  503s alone, in one process. The headline number is the interactive
+  goodput ratio — degrading low-priority work and render fidelity must
+  buy MORE completed interactive requests than indiscriminate
+  shedding, with the level trajectory back at L0 by the tail."""
+  threshold_s = _overload_calibrate(args)
+  _log("serve_load: overload A/B arm 1/2 — brownout ladder armed")
+  brownout = overload_run(args, with_brownout=True,
+                          latency_threshold_s=threshold_s)
+  _log("serve_load: overload A/B arm 2/2 — shed-only")
+  shed_only = overload_run(args, with_brownout=False,
+                           latency_threshold_s=threshold_s)
+  g_bo = brownout["goodput_rps"]["interactive"]
+  g_shed = shed_only["goodput_rps"]["interactive"]
+  ratio = round(g_bo / g_shed, 4) if g_shed else None
+  record = {
+      "metric": "serve_load_overload_ab",
+      "value": ratio,
+      "unit": "x_interactive_goodput_brownout_over_shed",
+      "interactive_goodput_x": ratio,
+      "interactive_p99_ms_brownout": brownout["interactive_p99_ms"],
+      "interactive_p99_ms_shed_only": shed_only["interactive_p99_ms"],
+      "latency_threshold_ms": round(threshold_s * 1e3, 3),
+      "max_level": brownout["max_level"],
+      "returned_to_l0": brownout["returned_to_l0"],
+      "brownout": brownout,
+      "shed_only": shed_only,
+      "dry": bool(args.dry),
+  }
+  print(json.dumps(record))
+  return 0
+
+
 def main(argv=None) -> int:
   args = build_parser().parse_args(argv)
   if os.environ.get("SERVE_LOAD_DRY", "") not in ("", "0", "false"):
@@ -1512,12 +1760,19 @@ def main(argv=None) -> int:
     raise SystemExit(f"--tile-size must be >= 8, got {args.tile_size}")
   if args.asset_ab:
     if (args.chaos or args.ab or args.edge_ab or args.cluster
-        or args.edge or args.tiled_ab):
+        or args.edge or args.tiled_ab or args.overload_ab):
       raise SystemExit("--asset-ab measures the asset delivery tier on "
                        "its own service; it does not combine with "
                        "--chaos/--ab/--edge-ab/--edge/--cluster/"
-                       "--tiled-ab")
+                       "--tiled-ab/--overload-ab")
     return asset_ab_main(args)
+  if args.overload_ab:
+    if (args.chaos or args.ab or args.edge_ab or args.cluster
+        or args.edge or args.tiled_ab or args.asset_ab):
+      raise SystemExit("--overload-ab compares clean in-process arms; "
+                       "it does not combine with --chaos/--ab/--edge-ab/"
+                       "--edge/--cluster/--tiled-ab/--asset-ab")
+    return overload_ab_main(args)
   if args.tiled_ab:
     if args.chaos or args.ab or args.edge_ab or args.cluster or args.edge:
       raise SystemExit("--tiled-ab compares clean in-process arms; it "

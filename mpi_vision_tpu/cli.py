@@ -530,6 +530,31 @@ def cmd_serve(args: argparse.Namespace) -> dict:
     ) if on]
     if wants_edge:
       raise SystemExit(f"{', '.join(wants_edge)} require(s) --edge-cache")
+  if not args.brownout:
+    # Brownout knobs only act through the controller; a silently inert
+    # degradation ladder is worse than none.
+    wants_brownout = [flag for flag, on in (
+        ("--brownout-burn-high", args.brownout_burn_high is not None),
+        ("--brownout-queue-high", args.brownout_queue_high is not None),
+        ("--brownout-recover-burn",
+         args.brownout_recover_burn is not None),
+        ("--brownout-recover-queue",
+         args.brownout_recover_queue is not None),
+        ("--brownout-step-dwell-s",
+         args.brownout_step_dwell_s is not None),
+        ("--brownout-recover-dwell-s",
+         args.brownout_recover_dwell_s is not None),
+        ("--brownout-plane-keep", args.brownout_plane_keep is not None),
+        ("--brownout-warp-scale", args.brownout_warp_scale is not None),
+        ("--brownout-max-level", args.brownout_max_level is not None),
+    ) if on]
+    if wants_brownout:
+      raise SystemExit(
+          f"{', '.join(wants_brownout)} require(s) --brownout")
+  if args.brownout and not args.slo:
+    # The ladder is DRIVEN by the SLO burn rate; without the tracker it
+    # would be a queue-only controller pretending to watch the SLO.
+    raise SystemExit("--brownout requires SLO tracking (drop --no-slo)")
   if args.event_log_max_bytes > 0 and not args.event_log:
     # Rotation only acts on the JSONL sink; the in-memory ring is
     # already bounded.
@@ -643,6 +668,44 @@ def cmd_serve(args: argparse.Namespace) -> dict:
         negative_ttl_s=(args.edge_negative_ttl_s
                         if args.edge_negative_ttl_s is not None
                         else defaults.negative_ttl_s))
+  brownout = None
+  if args.brownout:
+    from mpi_vision_tpu.serve.brownout import BrownoutConfig
+
+    bo_defaults = BrownoutConfig()
+    try:
+      brownout = BrownoutConfig(
+        burn_high=(args.brownout_burn_high
+                   if args.brownout_burn_high is not None
+                   else bo_defaults.burn_high),
+        queue_high=(args.brownout_queue_high
+                    if args.brownout_queue_high is not None
+                    else bo_defaults.queue_high),
+        recover_burn=(args.brownout_recover_burn
+                      if args.brownout_recover_burn is not None
+                      else bo_defaults.recover_burn),
+        recover_queue=(args.brownout_recover_queue
+                       if args.brownout_recover_queue is not None
+                       else bo_defaults.recover_queue),
+        step_dwell_s=(args.brownout_step_dwell_s
+                      if args.brownout_step_dwell_s is not None
+                      else bo_defaults.step_dwell_s),
+        recover_dwell_s=(args.brownout_recover_dwell_s
+                         if args.brownout_recover_dwell_s is not None
+                         else bo_defaults.recover_dwell_s),
+        plane_keep=(args.brownout_plane_keep
+                    if args.brownout_plane_keep is not None
+                    else bo_defaults.plane_keep),
+        l3_warp_scale=(args.brownout_warp_scale
+                       if args.brownout_warp_scale is not None
+                       else bo_defaults.l3_warp_scale),
+        max_level=(args.brownout_max_level
+                   if args.brownout_max_level is not None
+                   else bo_defaults.max_level))
+    except ValueError as e:
+      # BrownoutConfig's own validation (hysteresis-band ordering,
+      # plane-keep range, ...) speaks in flag terms already.
+      raise SystemExit(f"bad brownout config: {e}") from None
   profile_hook = None
   if args.profile_hook:
     import shlex
@@ -687,7 +750,8 @@ def cmd_serve(args: argparse.Namespace) -> dict:
       max_queue=args.max_queue, resilience=resilience,
       cpu_fallback=args.cpu_fallback, tracer=tracer,
       profile_dir=args.profile_dir or None, profile_hook=profile_hook,
-      alert_hook=alert_hook, slo=slo, events=events, tsdb=tsdb, ship=ship,
+      alert_hook=alert_hook, slo=slo, brownout=brownout, events=events,
+      tsdb=tsdb, ship=ship,
       metrics_ttl_s=args.metrics_ttl_ms / 1e3)
   if args.mpi_dir:
     from mpi_vision_tpu.core.camera import intrinsics_matrix, inv_depths
@@ -1730,6 +1794,50 @@ def build_parser() -> argparse.ArgumentParser:
                  help="judge the quantile objective per scene too "
                       "(bounded per-scene table; alerts named like "
                       "latency_p99:scene_007); requires --slo-quantile")
+  s.add_argument("--brownout", action=argparse.BooleanOptionalAction,
+                 default=False,
+                 help="degrade, don't die: an SLO-burn/queue-depth "
+                      "driven brownout ladder (L1 thinned planes, L2 "
+                      "half-res, L3 stale-while-overloaded edge "
+                      "serving, L4 shed) with priority admission by "
+                      "X-Request-Class (interactive/prefetch/"
+                      "background); requires SLO tracking (the --slo "
+                      "default); serve/brownout.py")
+  s.add_argument("--brownout-burn-high", type=float, default=None,
+                 help="fast-window burn rate at/above which the ladder "
+                      "steps down one level (default 2.0); requires "
+                      "--brownout")
+  s.add_argument("--brownout-queue-high", type=float, default=None,
+                 help="queue-depth fraction at/above which the ladder "
+                      "steps down (default 0.5); requires --brownout")
+  s.add_argument("--brownout-recover-burn", type=float, default=None,
+                 help="burn rate the fast window must stay at/under to "
+                      "recover a level (default 1.0; must be < "
+                      "--brownout-burn-high — the gap is the "
+                      "hysteresis band); requires --brownout")
+  s.add_argument("--brownout-recover-queue", type=float, default=None,
+                 help="queue fraction the recovery gate requires "
+                      "(default 0.25; must be < --brownout-queue-high); "
+                      "requires --brownout")
+  s.add_argument("--brownout-step-dwell-s", type=float, default=None,
+                 help="minimum seconds between consecutive downward "
+                      "steps — levels shed one at a time, never jump "
+                      "(default 2.0); requires --brownout")
+  s.add_argument("--brownout-recover-dwell-s", type=float, default=None,
+                 help="continuous healthy seconds required per upward "
+                      "step (default 5.0); requires --brownout")
+  s.add_argument("--brownout-plane-keep", type=float, default=None,
+                 help="fraction of the culled plane set L1+ keeps, "
+                      "first/last always retained (default 0.5); "
+                      "requires --brownout")
+  s.add_argument("--brownout-warp-scale", type=float, default=None,
+                 help="L3 multiplier on both edge warp tolerances "
+                      "(stale-while-overloaded; default 3.0); requires "
+                      "--brownout and acts only with --edge-cache")
+  s.add_argument("--brownout-max-level", type=int, default=None,
+                 help="ladder ceiling 1-4; below 4 the service never "
+                      "sheds, only degrades (default 4); requires "
+                      "--brownout")
   s.add_argument("--tsdb-interval-s", type=float, default=0.0,
                  help="sample every /metrics family into the on-box "
                       "time-series ring this often and serve windowed "

@@ -396,6 +396,39 @@ def serve_registry(stats: dict,
   reg.counter(p + "scene_sync_failures_total",
               "Scene syncs that failed (unreachable source, bad "
               "manifest, digest mismatch).", sync.get("failures", 0))
+  reg.counter(p + "scene_sync_retries_total",
+              "Transient per-fetch failures retried with backoff inside "
+              "scene syncs (RetryPolicy) instead of failing the sweep.",
+              sync.get("retries", 0))
+  # Brownout ladder (serve/brownout.py): always exposed (zeros at L0 /
+  # while brownout is off). The level gauge is NON-additive across a
+  # fleet (brownout.NON_ADDITIVE_FAMILIES): the router's pooled /metrics
+  # drops it and per-backend levels ride the /stats brownout block.
+  bo = stats.get("brownout") or {}
+  reg.gauge(p + "brownout_level",
+            "Current brownout ladder level (0 = full quality ... 4 = "
+            "shed with Retry-After).", bo.get("level", 0))
+  bo_trans = bo.get("transitions") or {}
+  trans_m = reg.counter(
+      p + "brownout_transitions_total",
+      "Ladder level changes, label direction=down (deeper degradation) "
+      "| up (recovery).")
+  for direction in ("down", "up"):
+    trans_m.sample(bo_trans.get(direction, 0), {"direction": direction})
+  bo_sheds = bo.get("sheds") or {}
+  shed_m = reg.counter(
+      p + "brownout_sheds_total",
+      "Requests shed by brownout admission control, label class. "
+      "Deliberate load management — excluded from the SLO bad stream.")
+  for cls in ("interactive", "prefetch", "background"):
+    shed_m.sample(bo_sheds.get(cls, 0), {"class": cls})
+  bo_deg = bo.get("degraded") or {}
+  deg_m = reg.counter(
+      p + "brownout_degraded_total",
+      "Responses served below full quality, label level (the ladder "
+      "tier that produced them — never cached, never ETag'd).")
+  for lvl in ("1", "2", "3", "4"):
+    deg_m.sample(bo_deg.get(lvl, 0), {"level": lvl})
   cache = stats.get("cache") or {}
   reg.counter(p + "cache_hits_total", "Scene-cache hits.",
               cache.get("hits", 0))

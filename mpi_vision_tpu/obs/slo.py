@@ -336,6 +336,27 @@ class SloTracker:
     """Shorthand for failures with no latency sample (errors, sheds)."""
     self.record(ok=False, count=count)
 
+  def fast_burn(self) -> float:
+    """The hottest fast-window burn rate across both objectives — the
+    brownout controller's overload signal.
+
+    Cheap (one ring walk, no histogram merge) because it is read on the
+    admission path. Objectives under ``min_requests`` in the window read
+    0.0: a cold window must not read as an outage, and an emptying
+    window is exactly how the ladder recovers.
+    """
+    with self._lock:
+      now = self._clock()
+      total, bad, lat_total, lat_bad = self._window_locked(
+          now, self.config.fast_window_s)
+    worst = 0.0
+    if total >= self.config.min_requests:
+      worst = burn_rate(bad, total, self.config.availability_target)
+    if lat_total >= self.config.min_requests:
+      worst = max(worst,
+                  burn_rate(lat_bad, lat_total, self.config.latency_target))
+    return worst
+
   # -- window math ---------------------------------------------------------
 
   def _window_floor(self, now: float, window_s: float) -> int:

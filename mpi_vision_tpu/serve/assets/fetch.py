@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import hashlib
 import json
+import random
 import time
 import urllib.error
 import urllib.parse
@@ -29,7 +30,9 @@ import zlib
 import numpy as np
 
 from mpi_vision_tpu.ckpt.watch import PollWatcher
+from mpi_vision_tpu.serve import brownout as brownout_mod
 from mpi_vision_tpu.serve.assets import store as store_mod
+from mpi_vision_tpu.serve.resilience import RetryPolicy
 
 
 class SceneSyncError(RuntimeError):
@@ -74,7 +77,9 @@ class SceneFetcher:
   """
 
   def __init__(self, service, base_url: str, transport=None,
-               events=None, clock=time.monotonic):
+               events=None, clock=time.monotonic,
+               retry: RetryPolicy | None = RetryPolicy(),
+               sleep=time.sleep, rng=None):
     self.service = service
     self.base_url = base_url.rstrip("/")
     self.transport = transport if transport is not None \
@@ -82,13 +87,37 @@ class SceneFetcher:
     self.events = events if events is not None \
         else getattr(service, "events", None)
     self._clock = clock
+    self.retry = retry
+    self._sleep = sleep
+    self._rng = rng if rng is not None else random.Random(0)
 
   def _emit(self, kind: str, **fields) -> None:
     if self.events is not None:
       self.events.emit(kind, **fields)
 
   def _get(self, path: str):
-    return self.transport.get(self.base_url + path)
+    """One GET through the transport choke point, with transient-fetch
+    retries: a ``ConnectionError`` (socket refused/reset/timed out —
+    the upstream briefly away, NOT an HTTP error status) backs off per
+    ``retry`` and redials. Every request declares itself background
+    traffic, so a browned-out upstream sheds the sync sweep before it
+    sheds a single interactive render — the fetcher's whole job is
+    deferrable."""
+    headers = {brownout_mod.REQUEST_CLASS_HEADER: "background"}
+    attempt = 0
+    while True:
+      try:
+        return self.transport.get(self.base_url + path, headers=headers)
+      except ConnectionError:
+        if self.retry is None or attempt >= self.retry.max_retries:
+          raise
+        attempt += 1
+        record = getattr(self.service.metrics, "record_scene_sync_retry",
+                         None)
+        if record is not None:
+          record()
+        self._emit("scene_sync_retry", path=path, attempt=attempt)
+        self._sleep(self.retry.backoff_s(attempt, self._rng))
 
   def remote_scenes(self) -> list[str]:
     status, _, body = self._get("/scenes")

@@ -52,6 +52,8 @@ from mpi_vision_tpu.obs import tsdb as tsdb_mod
 from mpi_vision_tpu.obs.events import EventLog
 from mpi_vision_tpu.obs.slo import SloConfig, SloTracker
 from mpi_vision_tpu.obs.trace import NULL_TRACE, NULL_TRACER, Tracer
+from mpi_vision_tpu.serve import brownout as brownout_mod
+from mpi_vision_tpu.serve.assets import store as assets_mod
 from mpi_vision_tpu.serve.edge import lattice as edge_lattice
 from mpi_vision_tpu.serve.resilience import CircuitBreaker, RetryBudget
 from mpi_vision_tpu.serve.cluster.ring import HashRing
@@ -175,6 +177,7 @@ class RouterMetrics:
     self.scene_asset_forwards = 0
     self.scene_asset_fanouts = 0
     self.scene_asset_misses = 0
+    self.scene_asset_revalidations = 0
 
   def record_request(self) -> None:
     with self._lock:
@@ -272,6 +275,13 @@ class RouterMetrics:
     with self._lock:
       self.scene_asset_misses += 1
 
+  def record_asset_revalidated(self) -> None:
+    """An asset GET answered 304 AT THE ROUTER: the client's
+    If-None-Match named the digest's own strong ETag, and content
+    addressing makes that proof of freshness — no backend contacted."""
+    with self._lock:
+      self.scene_asset_revalidations += 1
+
   def record_cell_route(self, rerouted: bool) -> None:
     """One request placed by its ``(scene, view-cell)`` ring key;
     ``rerouted`` when that key's primary differs from the scene-level
@@ -310,6 +320,7 @@ class RouterMetrics:
               "asset_forwards": self.scene_asset_forwards,
               "asset_fanouts": self.scene_asset_fanouts,
               "asset_misses": self.scene_asset_misses,
+              "asset_revalidations": self.scene_asset_revalidations,
           },
       }
 
@@ -721,7 +732,9 @@ class Router:
                      accept: str | None = None, trace_id: str | None = None,
                      trace=NULL_TRACE,
                      if_none_match: str | None = None,
-                     cell: str | None = None) -> tuple[int, dict, bytes]:
+                     cell: str | None = None,
+                     request_class: str | None = None) -> tuple[int, dict,
+                                                                bytes]:
     """Route one ``/render`` body to the scene's replica set.
 
     ``cell`` (``request_cell``'s token, when cell routing is on) keys
@@ -735,6 +748,10 @@ class Router:
     backend's edge cache can answer 304 without rendering — the router
     stays a pure conditional-request conduit (the backend owns ETag
     identity; 304s ride back like any other answered status).
+
+    ``request_class`` forwards the client's ``X-Request-Class`` header
+    so a browned-out backend's priority admission sees the class the
+    client declared — the router never reclassifies traffic.
 
     Walks the placement list primary-first (load-aware demotion may
     front a measurably idler replica), skipping ejected backends
@@ -774,6 +791,8 @@ class Router:
       headers["Accept"] = accept
     if if_none_match:
       headers["If-None-Match"] = if_none_match
+    if request_class:
+      headers[brownout_mod.REQUEST_CLASS_HEADER] = request_class
     attempts: list[str] = []
     retry_afters: list[float] = []
     tried_any = False
@@ -1158,6 +1177,7 @@ class Router:
         "backend_info": {b: backends[b] for b in sorted(backends)},
         "backends": {b: per_backend[b] for b in sorted(per_backend)},
         "slo": slo_block,
+        "brownout": self._brownout_summary(per_backend),
     }
     if self.retry_budget is not None:
       out["retry_budget"] = self.retry_budget.snapshot()
@@ -1204,6 +1224,42 @@ class Router:
                                 if tot[0] else None)}
             for name, tot in sorted(totals.items())
         },
+    }
+
+  @staticmethod
+  def _brownout_summary(per_backend_stats: dict) -> dict:
+    """Fleet brownout judgment from the backends' ``brownout`` blocks:
+    the hottest ladder level anywhere (the number a dashboard's
+    single-stat panel shows), per-backend levels for the browned-out
+    set, and pooled shed/degrade totals. Backends running without the
+    controller report ``enabled: false`` and count only toward
+    ``backends_reporting``."""
+    levels: dict[str, int] = {}
+    sheds: dict[str, int] = {}
+    degraded = 0
+    reporting = enabled = 0
+    for backend_id in sorted(per_backend_stats):
+      st = per_backend_stats[backend_id]
+      bo = st.get("brownout") if isinstance(st, dict) else None
+      if not isinstance(bo, dict):
+        continue
+      reporting += 1
+      if not bo.get("enabled"):
+        continue
+      enabled += 1
+      level = int(bo.get("level", 0))
+      if level > 0:
+        levels[backend_id] = level
+      for cls, n in (bo.get("sheds") or {}).items():
+        sheds[cls] = sheds.get(cls, 0) + int(n)
+      degraded += sum(int(n) for n in (bo.get("degraded") or {}).values())
+    return {
+        "backends_reporting": reporting,
+        "backends_enabled": enabled,
+        "max_level": max(levels.values(), default=0),
+        "levels": levels,
+        "sheds": sheds,
+        "degraded_total": degraded,
     }
 
   def events_snapshot(self, recent: int = 128) -> dict:
@@ -1343,6 +1399,11 @@ class Router:
     reg.counter(p + "scene_sync_asset_misses_total",
                 "Asset GETs 404'd by every reachable backend.",
                 snap["scene_sync"]["asset_misses"])
+    reg.counter(p + "scene_sync_asset_revalidations_total",
+                "Asset GETs answered 304 at the router itself "
+                "(If-None-Match named the digest's ETag — content "
+                "addressing proves freshness without a backend).",
+                snap["scene_sync"]["asset_revalidations"])
     reg.counter(p + "gossip_rounds_total",
                 "Anti-entropy gossip rounds this router initiated.",
                 snap["gossip_rounds"])
@@ -1411,11 +1472,14 @@ class Router:
     # per-backend statements — summing them exports garbage (and one
     # idle backend's NaN poisons the sample); the summable mpi_slo_*
     # slices and the native-histogram buckets still aggregate (the
-    # buckets EXACTLY: shared idx space, counts add).
+    # buckets EXACTLY: shared idx space, counts add). The brownout
+    # LEVEL gauge is likewise per-backend (a sum of ladder levels means
+    # nothing); /stats carries the per-backend levels and fleet max.
     parsed: dict = {}
     agg = prom.aggregate_metrics_texts(
         texts,
-        drop=slo_mod.NON_ADDITIVE_FAMILIES | hist_mod.NON_ADDITIVE_FAMILIES,
+        drop=(slo_mod.NON_ADDITIVE_FAMILIES | hist_mod.NON_ADDITIVE_FAMILIES
+              | brownout_mod.NON_ADDITIVE_FAMILIES),
         collect=parsed)
     pooled_hists = hist_mod.snapshots_from_samples(
         parsed.get("mpi_serve_request_latency_nativehist",
@@ -1452,7 +1516,8 @@ class Router:
 # exactly like ones fronting a single backend.
 _FORWARD_HEADERS = ("Content-Type", "X-Image-Shape", "X-Image-Dtype",
                     "X-Scene-Id", "Retry-After", "ETag", "Cache-Control",
-                    "X-Edge-Cache", "X-Asset-Encoding")
+                    "X-Edge-Cache", "X-Asset-Encoding",
+                    brownout_mod.DEGRADED_HEADER, brownout_mod.LEVEL_HEADER)
 
 # The asset-tier GET surface a backend exposes (serve/server.py) — the
 # router mirrors it so a SceneFetcher or browser pointed at the fleet
@@ -1558,6 +1623,20 @@ class _RouterHandler(BaseHTTPRequestHandler):
       self._send_json({"error": f"unknown path {path}"}, status=404)
       return
     scene_id = urllib.parse.unquote((asset or page).group(1))
+    if asset is not None:
+      # Digest-addressed assets are IMMUTABLE: the URL names the
+      # content, so a client whose If-None-Match carries the digest's
+      # own strong ETag is proven fresh by arithmetic — answer 304 at
+      # the router without waking any backend. This is what lets an
+      # edge tier ride out a backend brownout on revalidations alone.
+      etag = assets_mod.asset_etag(asset.group(2))
+      inm = self.headers.get("If-None-Match") or ""
+      if etag in inm:
+        self.router.metrics.record_asset_revalidated()
+        self._send_bytes(b"", status=304, extra_headers={
+            "ETag": etag,
+            "Cache-Control": "public, max-age=31536000, immutable"})
+        return
     try:
       status, headers, body = self.router.forward_scene_get(
           scene_id, path,
@@ -1659,7 +1738,8 @@ class _RouterHandler(BaseHTTPRequestHandler):
           scene_id, body, accept=self.headers.get("Accept"),
           trace_id=trace_id, trace=tr,
           if_none_match=self.headers.get("If-None-Match"),
-          cell=self.router.request_cell(req))
+          cell=self.router.request_cell(req),
+          request_class=self.headers.get(brownout_mod.REQUEST_CLASS_HEADER))
     except KeyError as e:
       tr.finish(error=repr(e))
       self._send_json({"error": str(e)}, status=503, extra_headers=tid_hdr)

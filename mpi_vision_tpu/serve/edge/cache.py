@@ -162,14 +162,18 @@ class EdgeFrameCache:
 
   # -- lookup -------------------------------------------------------------
 
-  def lookup(self, scene_id: str, digest: str,
-             pose) -> tuple[str, CachedFrame | None, tuple]:
+  def lookup(self, scene_id: str, digest: str, pose,
+             warp_scale: float = 1.0) -> tuple[str, CachedFrame | None, tuple]:
     """Classify one request: ``("hit" | "warp" | "miss", entry, cell)``.
 
     ``hit`` returns the exact cell's entry; ``warp`` the nearest
     resident entry within the warp thresholds (the caller resamples it
     to the request pose); ``miss`` returns no entry — the caller must
-    render and ``put``.
+    render and ``put``. ``warp_scale`` multiplies both warp thresholds
+    for this lookup only — the brownout ladder's L3
+    stale-while-overloaded tier widens the tolerance so nearby cached
+    full-quality frames absorb traffic that would otherwise render (the
+    caller labels beyond-base-tolerance warps as degraded).
     """
     cell = self.cell_of(pose)
     key = (str(scene_id), str(digest), cell)
@@ -179,7 +183,8 @@ class EdgeFrameCache:
         self._entries.move_to_end(key)
         self.hits += 1
         return "hit", entry, cell
-      near = self._nearest_locked(str(scene_id), str(digest), pose)
+      near = self._nearest_locked(str(scene_id), str(digest), pose,
+                                  float(warp_scale))
       if near is not None:
         self._entries.move_to_end((near.scene_id, near.digest, near.cell))
         self.warp_serves += 1
@@ -187,15 +192,17 @@ class EdgeFrameCache:
       self.misses += 1
       return "miss", None, cell
 
-  def _nearest_locked(self, scene_id: str, digest: str,
-                      pose) -> CachedFrame | None:
+  def _nearest_locked(self, scene_id: str, digest: str, pose,
+                      warp_scale: float = 1.0) -> CachedFrame | None:
     cfg = self.config
-    if cfg.warp_max_trans <= 0 and cfg.warp_max_rot_deg <= 0:
+    max_trans = cfg.warp_max_trans * warp_scale
+    max_rot_deg = cfg.warp_max_rot_deg * warp_scale
+    if max_trans <= 0 and max_rot_deg <= 0:
       return None
     best, best_trans = None, None
     for entry in self._by_scene.get((scene_id, digest), {}).values():
       trans, rot_deg = lattice.pose_error(pose, entry.pose)
-      if trans <= cfg.warp_max_trans and rot_deg <= cfg.warp_max_rot_deg \
+      if trans <= max_trans and rot_deg <= max_rot_deg \
           and (best is None or trans < best_trans):
         best, best_trans = entry, trans
     return best

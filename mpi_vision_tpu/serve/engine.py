@@ -73,6 +73,24 @@ def _next_pow2(n: int) -> int:
   return 1 << max(n - 1, 0).bit_length() if n > 1 else 1
 
 
+def upsample_nearest(frames: np.ndarray, out_hw) -> np.ndarray:
+  """Nearest-neighbour upsample of ``[..., h, w, C]`` host frames.
+
+  The readback half of the brownout ladder's L2 tier: the degraded
+  dispatch rendered at reduced resolution, but the response contract
+  (and the edge warp math) wants full target dims, so the cheap resample
+  happens host-side after ``wait`` — a gather per axis, no device work,
+  no extra jit entries. A no-op (same array) when dims already match.
+  """
+  h, w = int(out_hw[0]), int(out_hw[1])
+  ih, iw = frames.shape[-3], frames.shape[-2]
+  if (ih, iw) == (h, w):
+    return frames
+  yy = (np.arange(h) * ih) // h
+  xx = (np.arange(w) * iw) // w
+  return np.ascontiguousarray(frames[..., yy[:, None], xx, :])
+
+
 class InFlightBatch:
   """One asynchronously dispatched batch: device output + bookkeeping.
 

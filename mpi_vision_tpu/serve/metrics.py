@@ -121,6 +121,16 @@ class ServeMetrics:
       self.scene_sync_tiles_reused = 0
       self.scene_sync_bytes = 0
       self.scene_sync_failures = 0
+      self.scene_sync_retries = 0
+      # Brownout accounting (serve/brownout.py): sheds by priority class
+      # and degraded serves by ladder level. Deliberate load management,
+      # NOT SLO bad events — feeding these to the tracker would hold the
+      # burn rate high and deadlock the ladder's recovery. Always present
+      # in the snapshot (zeros while brownout is off) so the
+      # mpi_serve_brownout_* families are always exposed.
+      self.brownout_sheds = {cls: 0 for cls in
+                             ("interactive", "prefetch", "background")}
+      self.brownout_degraded = {lvl: 0 for lvl in (1, 2, 3, 4)}
       # Per-scene latency breakdown (hot-scene regression hunting):
       # scene -> [count, sum_s, max_s, deque(recent latencies)].
       self._per_scene: dict = {}
@@ -327,6 +337,30 @@ class ServeMetrics:
     with self._lock:
       self.scene_sync_failures += 1
 
+  def record_scene_sync_retry(self) -> None:
+    """One transient per-fetch failure retried (with backoff) inside a
+    scene sync instead of failing the whole sweep."""
+    with self._lock:
+      self.scene_sync_retries += 1
+
+  def record_brownout_shed(self, request_class: str) -> None:
+    """One request shed by brownout admission control.
+
+    Deliberately NOT an SLO bad event (unlike ``record_rejected``):
+    brownout sheds are the controller doing its job, and counting them
+    bad would hold the fast-window burn at its trigger level forever —
+    the ladder could never step back up.
+    """
+    with self._lock:
+      cls = (request_class if request_class in self.brownout_sheds
+             else "interactive")
+      self.brownout_sheds[cls] += 1
+
+  def record_degraded(self, level: int) -> None:
+    """One response served below full quality at ladder ``level``."""
+    with self._lock:
+      self.brownout_degraded[min(max(int(level), 1), 4)] += 1
+
   def record_warp_pose_error(self, trans: float, rot_deg: float,
                              trace_id: str | None = None) -> None:
     """One edge warp-serve's pose error (how far the served frame's
@@ -427,6 +461,17 @@ class ServeMetrics:
               "tiles_reused": self.scene_sync_tiles_reused,
               "bytes_fetched": self.scene_sync_bytes,
               "failures": self.scene_sync_failures,
+              "retries": self.scene_sync_retries,
+          },
+          # The service overlays controller state (level, transitions,
+          # signals) when brownout is on; the counter halves live here so
+          # a load generator's reset() zeroes them with everything else.
+          "brownout": {
+              "enabled": False,
+              "level": 0,
+              "sheds": dict(self.brownout_sheds),
+              "degraded": {str(k): v
+                           for k, v in self.brownout_degraded.items()},
           },
           # Native-histogram snapshots (JSON-ready, obs/hist.py): the
           # source for the mpi_serve_*_nativehist families, the request

@@ -294,7 +294,7 @@ class MicroBatcher:
   # -- request path -------------------------------------------------------
 
   def submit(self, scene_id: str, pose, timeout: float | None = None,
-             trace=NULL_TRACE) -> Future:
+             trace=NULL_TRACE, degrade: int = 0) -> Future:
     """Enqueue one pose render; the future resolves to ``[H, W, 3]``.
 
     ``timeout`` (seconds) sets the request's deadline: retries/backoff
@@ -304,6 +304,10 @@ class MicroBatcher:
     ``trace`` is this request's ``obs.trace.Trace``; the pipeline
     records its span tree (queue-wait onward) and finishes it when the
     future resolves. The default no-op singleton costs nothing.
+
+    ``degrade`` is the brownout render tier (0 = full quality) threaded
+    to the batch keyer, which folds it into the batch key — degraded and
+    full-quality requests can never coalesce into one flight.
     """
     pose = np.asarray(pose, np.float32)
     if pose.shape != (4, 4):
@@ -321,7 +325,14 @@ class MicroBatcher:
       # batch the request may ride (KeyError for unknown scenes
       # propagates to the caller — the same 404 the provider would
       # raise, just before any queue time is spent).
-      key, attrs = self._batch_keyer(str(scene_id), pose)
+      # Legacy two-arg keyers (injected by tests and older callers) keep
+      # working: the degrade arg is only passed when it is non-zero, and
+      # non-zero tiers only arise from a service that installed a
+      # degrade-aware keyer.
+      if degrade:
+        key, attrs = self._batch_keyer(str(scene_id), pose, degrade)
+      else:
+        key, attrs = self._batch_keyer(str(scene_id), pose)
     now = self._clock()
     fut: Future = Future()
     req = _Pending(str(scene_id), pose, fut, now,
@@ -349,8 +360,14 @@ class MicroBatcher:
                attrs["tiles_total"])
     return fut
 
+  def queue_fraction(self) -> float:
+    """Queue occupancy in [0, 1] — the brownout controller's pressure
+    signal (burn rate says users are hurting; this says why)."""
+    with self._cond:
+      return len(self._queue) / self.max_queue
+
   def render(self, scene_id: str, pose, timeout: float = 60.0,
-             trace=NULL_TRACE) -> np.ndarray:
+             trace=NULL_TRACE, degrade: int = 0) -> np.ndarray:
     """Synchronous render: submit + wait.
 
     On timeout the request is cancelled (best-effort) so an overloaded
@@ -364,7 +381,8 @@ class MicroBatcher:
     completion is safe).
     """
     try:
-      fut = self.submit(scene_id, pose, timeout=timeout, trace=trace)
+      fut = self.submit(scene_id, pose, timeout=timeout, trace=trace,
+                        degrade=degrade)
     except Exception as e:
       trace.finish(error=repr(e))
       raise

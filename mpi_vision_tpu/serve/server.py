@@ -65,6 +65,7 @@ dispatcher thread died).
 from __future__ import annotations
 
 import base64
+import dataclasses
 import functools
 import hashlib
 import json
@@ -97,12 +98,13 @@ from mpi_vision_tpu.obs.trace import (
     Tracer,
     new_trace_id,
 )
+from mpi_vision_tpu.serve import brownout as brownout_mod
 from mpi_vision_tpu.serve import cache as cache_mod
 from mpi_vision_tpu.serve import tiles as tiles_mod
 from mpi_vision_tpu.serve.assets import store as assets_mod
 from mpi_vision_tpu.serve.edge import EdgeConfig, EdgeFrameCache, warp_frame
 from mpi_vision_tpu.serve.edge.lattice import pose_error
-from mpi_vision_tpu.serve.engine import RenderEngine
+from mpi_vision_tpu.serve.engine import RenderEngine, upsample_nearest
 from mpi_vision_tpu.serve.metrics import ServeMetrics
 from mpi_vision_tpu.serve.resilience import (
     CircuitBreaker,
@@ -285,6 +287,7 @@ class RenderService:
                profiler: DeviceProfiler | None = None,
                profile_hook=None, alert_hook=None,
                slo: "SloConfig | SloTracker | None" = SloConfig(),
+               brownout: "brownout_mod.BrownoutConfig | None" = None,
                events: EventLog | None = None,
                tsdb: "tsdb_mod.TsdbConfig | tsdb_mod.TsdbRecorder | None" = None,
                ship: "ship_mod.ShipConfig | ship_mod.TelemetryShipper | None" = None,
@@ -322,6 +325,21 @@ class RenderService:
           "tile-granular serving requires an XLA method "
           "('fused'/'scan'/'assoc'); method='fused_pallas' cannot "
           "render cropped sources")
+    if brownout is not None:
+      if slo is None:
+        # The ladder is DRIVEN by the SLO fast-window burn; without the
+        # tracker it would only ever see queue depth and silently lose
+        # half its trigger — fail the misconfiguration at construction.
+        raise ValueError("brownout requires SLO tracking (slo=None "
+                         "disables the burn signal that drives the "
+                         "ladder)")
+      if method == "fused_pallas":
+        # L2's half-resolution renders ride the same tgt_intrinsics/
+        # out_hw path as tile crops, which the Pallas kernel rejects.
+        raise ValueError(
+            "brownout degraded rendering requires an XLA method "
+            "('fused'/'scan'/'assoc'); method='fused_pallas' cannot "
+            "render reduced-resolution targets")
     # "auto" derives a per-scene size from its dims at publish
     # (tiles_mod.auto_tile); every `self.tile is not None` gate below
     # treats it exactly like an explicit size.
@@ -429,8 +447,12 @@ class RenderService:
     self.scheduler = MicroBatcher(
         self.engine, self._get_scene, metrics=self.metrics,
         max_batch=max_batch, max_wait_ms=max_wait_ms,
+        # The keyer carries the brownout degrade tier into batch keys,
+        # so untiled-but-brownout services need it installed too (its
+        # untiled arm is the identity key at degrade 0).
         batch_keyer=(self._tile_batch_key
-                     if self.tile is not None else None),
+                     if self.tile is not None or brownout is not None
+                     else None),
         max_queue=max_queue, max_inflight=max_inflight,
         adaptive_inflight=adaptive_inflight,
         max_inflight_cap=max_inflight_cap if adaptive_inflight else None,
@@ -439,6 +461,14 @@ class RenderService:
         fallback_scene_provider=(
             self._get_scene_fallback
             if self.fallback_engine is not None else None)).start()
+    # Brownout ladder (serve/brownout.py): built after the scheduler so
+    # its queue-occupancy signal reads the live queue; the burn signal is
+    # the SLO tracker's fast window (validated non-None above).
+    self.brownout = None if brownout is None else \
+        brownout_mod.BrownoutController(
+            brownout, burn_fn=self.slo.fast_burn,
+            queue_fn=self.scheduler.queue_fraction,
+            on_transition=self._on_brownout_transition, clock=clock)
     self._metrics_cache = prom.ExpositionCache(
         self._render_metrics_text, ttl_s=metrics_ttl_s, clock=clock)
     # Flight-recorder legs (obs/tsdb.py, obs/ship.py): configs build and
@@ -509,6 +539,10 @@ class RenderService:
           self.alert_hook_failures += 1
         self.events.emit("alert_hook_failed", slo=record.get("slo"),
                          firing=record.get("firing"), error=repr(e))
+
+  def _on_brownout_transition(self, old: int, new: int,
+                              reason: str) -> None:
+    self.events.emit("brownout_level", old=old, new=new, reason=reason)
 
   # -- scenes -------------------------------------------------------------
 
@@ -783,22 +817,38 @@ class RenderService:
         near=min(depths), far=max(depths), fov_deg=fov_deg)
     return html, man["scene_digest"]
 
-  def _tile_batch_key(self, scene_id: str, pose) -> tuple[str, dict | None]:
+  def _tile_batch_key(self, scene_id: str, pose,
+                      degrade: int = 0) -> tuple[str, dict | None]:
     """The scheduler's batch-key hook for tiled services: frustum-cull
     the request into a ``TileSignature`` so it batches only with
     requests sharing its exact render plan. Untiled scenes (an
     ``--mpi-dir`` scene living next to tiled ones) pass through on the
-    plain scene id."""
+    plain scene id.
+
+    Under brownout the admitted level arrives as ``degrade``: L1 thins
+    the signature's plane set (the key changes with it, so degraded and
+    full-quality requests can never coalesce into one batch), and L2
+    additionally appends the half-res marker field — a distinct key AND
+    a distinct scene-provider plan, keeping the degraded render out of
+    every full-quality compile bucket and crop memo."""
     with self._scene_lock:
       meta = self._tile_meta.get(scene_id)
     if meta is None:
+      if degrade >= 2:
+        return brownout_mod.half_res_key(scene_id), None
       return scene_id, None
     sig = meta.plan(np.asarray(pose, np.float32)[None],
                     self.engine.convention)
+    if degrade >= 1 and self.brownout is not None:
+      sig = dataclasses.replace(sig, planes=tiles_mod.thin_planes(
+          sig.planes, self.brownout.config.plane_keep))
+    key = scene_id + tiles_mod.KEY_SEP + sig.token()
+    if degrade >= 2:
+      key = brownout_mod.half_res_key(key)
     # No metrics here: the scheduler records the attrs only for
     # requests it actually ENQUEUES, so breaker fast-fails and
     # queue-full rejections never skew the cull ratios.
-    return (scene_id + tiles_mod.KEY_SEP + sig.token(), {
+    return (key, {
         "tiles_touched": sig.tiles_touched,
         "tiles_rendered": sig.tiles_rendered,
         "tiles_culled": sig.tiles_total - sig.tiles_rendered,
@@ -807,36 +857,67 @@ class RenderService:
     })
 
   def _get_scene(self, scene_id: str) -> cache_mod.BakedScene:
-    sid, _, token = scene_id.partition(tiles_mod.KEY_SEP)
+    base, half_res = brownout_mod.split_degrade_key(scene_id)
+    sid, _, token = base.partition(tiles_mod.KEY_SEP)
     if self.tile is not None:
       with self._scene_lock:
         meta = self._tile_meta.get(sid)
       if meta is not None:
-        return self._assemble_crop(sid, meta, token, fallback=False)
+        return self._assemble_crop(sid, meta, token, fallback=False,
+                                   half_res=half_res)
 
     def bake():
       with self._scene_lock:
-        entry = self._scene_data.get(scene_id)
+        entry = self._scene_data.get(base)
       if entry is None:
-        raise KeyError(f"unknown scene {scene_id!r}")
+        raise KeyError(f"unknown scene {base!r}")
       # Bake-fault hook (FaultyEngine.check_bake): inside the cache-miss
       # path so injected bake failures fire exactly where a dead device
       # would fail a real bake — never on cache hits.
       check_bake = getattr(self.engine, "check_bake", None)
       if check_bake is not None:
-        check_bake(scene_id)
-      return cache_mod.bake_scene(scene_id, *entry)
+        check_bake(base)
+      return cache_mod.bake_scene(base, *entry)
 
-    return self.cache.get_or_bake(scene_id, bake)
+    scene = self.cache.get_or_bake(base, bake)
+    if half_res:
+      # L2 half-res view of the full bake: shares the device layers
+      # (nothing extra resident), overrides only the render target —
+      # half-scaled intrinsics and a halved raster. Built per call, not
+      # cached: the wrapper is two tiny arrays.
+      scene = self._half_res_view(scene)
+    return scene
+
+  def _half_res_view(self,
+                     scene: cache_mod.BakedScene) -> cache_mod.BakedScene:
+    """Derive the L2 target override from a full-quality bake: target
+    intrinsics scaled by 1/2 in the first two rows, output raster
+    halved. The source layers are shared by reference."""
+    base_k = (scene.tgt_intrinsics if scene.tgt_intrinsics is not None
+              else scene.intrinsics)
+    h, w = (scene.out_hw if scene.out_hw is not None
+            else (int(scene.rgba_layers.shape[0]),
+                  int(scene.rgba_layers.shape[1])))
+    tgt_k = jnp.asarray(base_k) * jnp.asarray(
+        [[0.5], [0.5], [1.0]], jnp.float32)
+    return dataclasses.replace(
+        scene, scene_id=brownout_mod.half_res_key(scene.scene_id),
+        tgt_intrinsics=tgt_k,
+        out_hw=(max(int(h) // 2, 1), max(int(w) // 2, 1)))
 
   def _assemble_crop(self, sid: str, meta: tiles_mod.TileMeta,
-                     token: str, fallback: bool) -> cache_mod.BakedScene:
+                     token: str, fallback: bool,
+                     half_res: bool = False) -> cache_mod.BakedScene:
     """The tiled scene provider: per-tile get-or-bake, then one device
     concat of the signature's crop with its culled plane set and
     crop-corrected source intrinsics. A bounded memo makes the repeat
     path one dict lookup; a full-coverage all-planes signature returns a
     plain whole-scene ``BakedScene`` (no target override), sharing the
-    monolithic path's compile and its bit-exactness."""
+    monolithic path's compile and its bit-exactness. ``half_res`` (the
+    L2 brownout tier) wraps the assembled crop in a half-res target
+    view on the way out — the memo keeps only full-quality entries, so
+    a brownout episode can never pollute the full-quality repeat
+    path."""
     grid = meta.grid
     sig = None
     if token:
@@ -863,7 +944,7 @@ class RenderService:
       memo = self._crop_memo.get(memo_key)
       if memo is not None:
         self._crop_memo.move_to_end(memo_key)
-        return memo
+        return self._half_res_view(memo) if half_res else memo
     cache = self._fallback_tile_cache if fallback else self._tile_cache
     device = (self.fallback_engine.devices[0] if fallback else None)
     rows, cols = meta.crop_tiles(sig.crop)
@@ -937,7 +1018,7 @@ class RenderService:
               or self._crop_memo_bytes > self._crop_memo_budget):
             _, evicted = self._crop_memo.popitem(last=False)
             self._crop_memo_bytes -= evicted.nbytes
-        return scene
+        return self._half_res_view(scene) if half_res else scene
     # Stale: the tiles baked above may hold pre-swap bytes inserted
     # AFTER the swap's invalidation sweep. Drop them (unchanged tiles
     # re-bake to identical bytes, changed ones to the new bytes) and
@@ -946,13 +1027,17 @@ class RenderService:
     for i in rows:
       for j in cols:
         cache.invalidate(tiles_mod.tile_cache_key(sid, i, j))
-    return scene
+    return self._half_res_view(scene) if half_res else scene
 
   def _get_scene_fallback(self, scene_id: str) -> cache_mod.BakedScene:
     """Scene provider for the degraded-mode engine: same host arrays,
     baked onto the fallback's (CPU) devices, cached separately so an
     outage does not evict the primary's residency."""
-    sid, _, token = scene_id.partition(tiles_mod.KEY_SEP)
+    # The fallback ignores the L2 half-res marker: it is already the
+    # degraded-capacity path, and serving full resolution there is safe
+    # (the readback upsample is a no-op on matching shapes).
+    base, _ = brownout_mod.split_degrade_key(scene_id)
+    sid, _, token = base.partition(tiles_mod.KEY_SEP)
     if self.tile is not None:
       with self._scene_lock:
         meta = self._tile_meta.get(sid)
@@ -961,13 +1046,13 @@ class RenderService:
 
     def bake():
       with self._scene_lock:
-        entry = self._scene_data.get(scene_id)
+        entry = self._scene_data.get(base)
       if entry is None:
-        raise KeyError(f"unknown scene {scene_id!r}")
+        raise KeyError(f"unknown scene {base!r}")
       return cache_mod.bake_scene(
-          scene_id, *entry, device=self.fallback_engine.devices[0])
+          base, *entry, device=self.fallback_engine.devices[0])
 
-    return self._fallback_cache.get_or_bake(scene_id, bake)
+    return self._fallback_cache.get_or_bake(base, bake)
 
   def swap_scenes(self, scenes: dict, prebake: bool = False) -> list[str]:
     """Atomically publish new host data for ``scenes`` (live ckpt reload).
@@ -1047,7 +1132,11 @@ class RenderService:
   def warmup(self, scene_ids=None) -> None:
     """Bake scenes (default: all registered) and compile every batch
     bucket up to the scheduler's ``max_batch`` for the first scene's
-    geometry, so steady-state traffic never pays an XLA compile."""
+    geometry, so steady-state traffic never pays an XLA compile. With
+    the brownout controller armed, the half-res (L2+) buckets compile
+    too — a browned-out service's steady state includes its degraded
+    tiers, and paying those compiles mid-overload would make the cure
+    slower than the disease."""
     ids = list(scene_ids) if scene_ids is not None else self.scene_ids()
     if not ids:
       return
@@ -1055,8 +1144,12 @@ class RenderService:
     eye = np.eye(4, dtype=np.float32)
     buckets = sorted({self.engine.batch_bucket(v)
                       for v in range(1, self.scheduler.max_batch + 1)})
-    for b in buckets:
-      self.engine.render_batch(scenes[0], np.broadcast_to(eye, (b, 4, 4)))
+    variants = [scenes[0]]
+    if self.brownout is not None:
+      variants.append(self._half_res_view(scenes[0]))
+    for scene in variants:
+      for b in buckets:
+        self.engine.render_batch(scene, np.broadcast_to(eye, (b, 4, 4)))
 
   # -- request path -------------------------------------------------------
 
@@ -1065,6 +1158,35 @@ class RenderService:
     """Blocking render of one ``[4, 4]`` pose -> ``[H, W, 3]`` f32."""
     return self.scheduler.render(scene_id, pose, timeout=timeout,
                                  trace=trace)
+
+  def _full_hw(self, scene_id: str) -> tuple[int, int]:
+    """The scene's full output raster ``(H, W)`` — the shape contract a
+    degraded (half-res) render is upsampled back to at readback."""
+    sid = str(scene_id)
+    with self._scene_lock:
+      meta = self._tile_meta.get(sid)
+      entry = self._scene_data.get(sid)
+    if meta is not None:
+      return meta.grid.height, meta.grid.width
+    if entry is None:
+      raise KeyError(f"unknown scene {sid!r}")
+    return int(entry[0].shape[0]), int(entry[0].shape[1])
+
+  def _render_scheduled(self, scene_id: str, pose, timeout: float,
+                        trace, degrade: int) -> np.ndarray:
+    """Scheduler render at the admitted degrade tier. L2+ renders at
+    half resolution on-device (a quarter of the compositing FLOPs) and
+    nearest-upsamples back to the full raster host-side at readback, so
+    every response keeps the scene's shape contract."""
+    # degrade is passed only when nonzero: drop-in scheduler.render
+    # replacements (fault stubs, tests) predating the kwarg keep
+    # working for the full-quality path they were written against.
+    kwargs = {"degrade": min(degrade, 2)} if degrade else {}
+    img = self.scheduler.render(scene_id, pose, timeout=timeout,
+                                trace=trace, **kwargs)
+    if degrade >= 2:
+      img = upsample_nearest(img, self._full_hw(scene_id))
+    return img
 
   def render_traced(self, scene_id: str, pose, timeout: float = 60.0):
     """``render`` plus a trace: returns ``(image, trace_id)``.
@@ -1144,24 +1266,35 @@ class RenderService:
                            plane_depth, tiles=tiles)
 
   def render_edge(self, scene_id: str, pose, timeout: float = 60.0,
-                  trace=NULL_TRACE) -> tuple[np.ndarray, dict]:
+                  trace=NULL_TRACE, degrade: int = 0) -> tuple[np.ndarray,
+                                                               dict]:
     """Render through the edge frame cache -> ``(image, info)``.
 
     ``info``: ``{"edge": "off" | "hit" | "warp" | "miss", "etag":
-    str | None, "max_age_s": int | None}``. Exact cell hits return the
-    stored frame (READ-ONLY — it is shared with every other hit) with
-    its strong ETag; near-misses return a fresh single-homography warp
-    of the nearest cached frame (pose-specific, so no ETag); misses
-    render through the scheduler and populate the cell. Hit and warp
-    latencies are recorded into the same request metrics/SLO stream as
-    rendered ones — the p50 drop IS the feature, it must be visible in
-    ``/stats``. With the edge cache disabled this is exactly
-    ``render`` (plus the ``"off"`` info), so callers can wire one path.
+    str | None, "max_age_s": int | None, "degraded": bool}``. Exact
+    cell hits return the stored frame (READ-ONLY — it is shared with
+    every other hit) with its strong ETag; near-misses return a fresh
+    single-homography warp of the nearest cached frame (pose-specific,
+    so no ETag); misses render through the scheduler and populate the
+    cell. Hit and warp latencies are recorded into the same request
+    metrics/SLO stream as rendered ones — the p50 drop IS the feature,
+    it must be visible in ``/stats``. With the edge cache disabled this
+    is exactly ``render`` (plus the ``"off"`` info), so callers can
+    wire one path.
+
+    ``degrade`` is the admitted brownout tier. It reshapes this path,
+    never the cache: L3 widens the warp-tolerance (stale-while-
+    overloaded — cached full-quality frames absorb traffic the device
+    cannot), and a degraded MISS renders thinned/half-res and is served
+    WITHOUT an ETag and WITHOUT populating the cell. The edge cache
+    holds only full-quality frames, ever — a degraded frame must not
+    poison the bit-exact ETag contract.
     """
     if self.edge is None:
-      return (self.scheduler.render(scene_id, pose, timeout=timeout,
-                                    trace=trace),
-              {"edge": "off", "etag": None, "max_age_s": None})
+      return (self._render_scheduled(str(scene_id), pose, timeout, trace,
+                                     degrade),
+              {"edge": "off", "etag": None, "max_age_s": None,
+               "degraded": degrade > 0})
     t0 = self._clock()
     try:
       # Everything before the scheduler hand-off owns the trace's error
@@ -1172,15 +1305,20 @@ class RenderService:
       pose = np.asarray(pose, np.float32)
       digest, intrinsics, plane_depth, token = self._edge_meta(scene_id)
       max_age = self.edge.config.max_age_s
-      kind, entry, cell = self.edge.lookup(scene_id, digest, pose)
+      warp_scale = (self.brownout.config.l3_warp_scale
+                    if degrade >= 3 and self.brownout is not None else 1.0)
+      kind, entry, cell = self.edge.lookup(scene_id, digest, pose,
+                                           warp_scale=warp_scale)
       if kind == "hit":
         span = trace.start_span("edge_hit", cell=list(cell))
         trace.end_span(span)
         self.metrics.record_request(self._clock() - t0, scene_id=scene_id,
                                     trace_id=trace.trace_id or None)
         trace.finish()
+        # An exact hit is the stored full-quality frame whatever the
+        # brownout level — it keeps its strong ETag and is NOT degraded.
         return entry.frame, {"edge": "hit", "etag": entry.etag,
-                             "max_age_s": max_age}
+                             "max_age_s": max_age, "degraded": False}
       if kind == "warp":
         span = trace.start_span("edge_warp", cell=list(cell),
                                 from_cell=list(entry.cell))
@@ -1197,7 +1335,14 @@ class RenderService:
         self.metrics.record_request(self._clock() - t0, scene_id=scene_id,
                                     trace_id=trace.trace_id or None)
         trace.finish()
-        return img, {"edge": "warp", "etag": None, "max_age_s": max_age}
+        # A warp served only because L3 widened the tolerance is
+        # labelled degraded; one within the base tolerance is ordinary
+        # quality whatever the level.
+        cfg = self.edge.config
+        stale = (warp_trans > cfg.warp_max_trans
+                 or warp_rot_deg > cfg.warp_max_rot_deg)
+        return img, {"edge": "warp", "etag": None, "max_age_s": max_age,
+                     "degraded": stale}
     except Exception as e:
       trace.finish(error=repr(e))
       raise
@@ -1221,8 +1366,8 @@ class RenderService:
     tiles = self._touched_tiles(scene_id, pose) if token is not None \
         else None
     try:
-      img = self.scheduler.render(scene_id, pose, timeout=timeout,
-                                  trace=trace)
+      img = self._render_scheduled(str(scene_id), pose, timeout, trace,
+                                   degrade)
     except QueueFullError as e:
       # Shed for real: plant the negative entry so the NEXT request for
       # this cell (and everyone piling behind it) skips the queue.
@@ -1230,12 +1375,55 @@ class RenderService:
       if ttl is not None and e.retry_after_s is None:
         e.retry_after_s = ttl
       raise
+    if degrade > 0:
+      # Degraded render: labelled, un-ETag'd, and NEVER cached — the
+      # cell stays empty until a full-quality render fills it.
+      return img, {"edge": "miss", "etag": None, "max_age_s": max_age,
+                   "degraded": True}
     entry = self._edge_put(str(scene_id), digest, cell, pose, img,
                            intrinsics, plane_depth, token, tiles)
     if entry is None:  # a swap raced the render: correct, just uncached
-      return img, {"edge": "miss", "etag": None, "max_age_s": max_age}
+      return img, {"edge": "miss", "etag": None, "max_age_s": max_age,
+                   "degraded": False}
     return entry.frame, {"edge": "miss", "etag": entry.etag,
-                         "max_age_s": max_age}
+                         "max_age_s": max_age, "degraded": False}
+
+  def render_request(self, scene_id: str, pose, request_class=None,
+                     timeout: float = 60.0,
+                     trace=NULL_TRACE) -> tuple[np.ndarray, dict]:
+    """The brownout-aware front door: priority admission, then a render
+    at the admitted degrade tier. ``info`` is ``render_edge``'s dict
+    plus ``"level"`` (the brownout level this response was served
+    under). With no brownout controller this is exactly ``render_edge``
+    (level 0, never degraded).
+
+    Sheds raise ``BrownoutShedError`` (a ``QueueFullError``, so the
+    HTTP 503 + Retry-After arm already handles it). Brownout sheds and
+    degraded serves are counted in their own metric families and NEVER
+    fed to the SLO tracker as bad — shedding is the mechanism that
+    brings the burn rate DOWN; counting it as failure would wedge the
+    ladder at max level.
+    """
+    if self.brownout is None:
+      img, info = self.render_edge(scene_id, pose, timeout=timeout,
+                                   trace=trace)
+      info.setdefault("degraded", False)
+      info["level"] = 0
+      return img, info
+    cls = brownout_mod.normalize_class(request_class)
+    try:
+      level = self.brownout.admit(cls)
+    except brownout_mod.BrownoutShedError as e:
+      self.metrics.record_brownout_shed(cls)
+      trace.finish(error=repr(e))
+      raise
+    degrade = min(level, 3)
+    img, info = self.render_edge(scene_id, pose, timeout=timeout,
+                                 trace=trace, degrade=degrade)
+    info["level"] = level
+    if info.get("degraded"):
+      self.metrics.record_degraded(level)
+    return img, info
 
   def edge_revalidate(self, scene_id: str, pose,
                       if_none_match: str | None) -> str | None:
@@ -1323,6 +1511,10 @@ class RenderService:
       out["breaker"] = self.resilient.breaker.snapshot()
     if self.slo is not None:
       out["slo"] = self.slo.snapshot()
+    if self.brownout is not None:
+      # Overlay the controller's live state onto the metrics block (the
+      # snapshot's counters stay — they are the shed/degrade history).
+      out["brownout"].update(self.brownout.snapshot())
     out["events"] = {"emitted": self.events.emitted,
                      "dropped": self.events.dropped,
                      "sink_errors": self.events.sink_errors}
@@ -1771,14 +1963,27 @@ class _Handler(BaseHTTPRequestHandler):
                                          scene_id=str(scene_id), http=True)
     if tr.trace_id:
       tid_hdr = {"X-Trace-Id": tr.trace_id}
+    bo_on = self.service.brownout is not None
     try:
-      if edge_on:
-        img, edge_info = self.service.render_edge(scene_id, pose, trace=tr)
+      if edge_on or bo_on:
+        img, edge_info = self.service.render_request(
+            scene_id, pose,
+            request_class=self.headers.get(brownout_mod.REQUEST_CLASS_HEADER),
+            trace=tr)
         tid_hdr = dict(tid_hdr)
-        tid_hdr["X-Edge-Cache"] = edge_info["edge"]
-        tid_hdr["Cache-Control"] = f"max-age={edge_info['max_age_s']}"
-        if edge_info["etag"] is not None:
-          tid_hdr["ETag"] = edge_info["etag"]
+        if edge_on:
+          tid_hdr["X-Edge-Cache"] = edge_info["edge"]
+          tid_hdr["Cache-Control"] = f"max-age={edge_info['max_age_s']}"
+          if edge_info["etag"] is not None:
+            tid_hdr["ETag"] = edge_info["etag"]
+        if bo_on:
+          tid_hdr[brownout_mod.LEVEL_HEADER] = str(edge_info["level"])
+        if edge_info.get("degraded"):
+          # Degraded frames are always labelled and must never be
+          # cached by any intermediary — they carry no ETag and the
+          # no-store overrides any edge max-age set above.
+          tid_hdr[brownout_mod.DEGRADED_HEADER] = "1"
+          tid_hdr["Cache-Control"] = "no-store"
       else:
         img = self.service.render(scene_id, pose, trace=tr)
     except KeyError as e:
@@ -1787,7 +1992,11 @@ class _Handler(BaseHTTPRequestHandler):
       return
     except QueueFullError as e:
       # Shed at the door. A negative-cache fast shed knows when the cell
-      # clears; a raw queue-full shed advises the standard 1s backoff.
+      # clears; a raw queue-full shed advises the standard 1s backoff; a
+      # brownout shed additionally names the ladder level that refused
+      # the request's class.
+      if isinstance(e, brownout_mod.BrownoutShedError):
+        tid_hdr = {brownout_mod.LEVEL_HEADER: str(e.level), **tid_hdr}
       if e.retry_after_s is not None:
         retry_after = max(1, math.ceil(e.retry_after_s))
         self._send_json({"error": str(e), "retry_after_s": e.retry_after_s},

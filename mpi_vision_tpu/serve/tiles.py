@@ -167,6 +167,26 @@ class TileSignature:
                len(grid))
 
 
+def thin_planes(planes: tuple[int, ...], keep: float) -> tuple[int, ...]:
+  """Deterministic plane subset for degraded (brownout L1+) compositing.
+
+  Keeps ``ceil(len * keep)`` of the content-culled plane list: always
+  the first entry (plane 0 — the farthest plane's RGB composites
+  unconditionally) and the last (the nearest content), evenly strided
+  between. Pure and order-preserving, so equal ``(signature, keep)``
+  pairs produce equal thinned plans — and therefore equal batch keys —
+  on every process.
+  """
+  n = len(planes)
+  k = max(1, math.ceil(n * float(keep)))
+  if k >= n:
+    return tuple(planes)
+  if k == 1:
+    return (planes[0],)
+  idx = sorted({round(i * (n - 1) / (k - 1)) for i in range(k)})
+  return tuple(planes[i] for i in idx)
+
+
 def _tap_affine(convention: Convention, h: int, w: int,
                 ch: int, cw: int, y0: int, x0: int):
   """Per-axis affine ``raw_crop = a * raw_full + b`` mapping the full

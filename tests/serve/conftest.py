@@ -1,0 +1,73 @@
+"""Shared serve-tier fixtures: ONE live backend pool for every suite.
+
+The three live multi-process suites (test_cluster, test_gossip,
+test_supervisor) each used to spawn their own BackendPool — three full
+JAX child-process spawn arcs per tier-1 run, the single most expensive
+setup in the suite. The pools were near-identical (same image size and
+plane count, pixels a pure function of ``(seed, scene_id)``), and every
+suite asserts against its OWN router/supervisor state, never against
+backend-side absolute counters — so one session-scoped pool serves all
+three.
+
+Sharing a pool across chaos suites needs one discipline: a suite that
+SIGKILLs backends may leave a corpse behind (a failed assertion skips
+the restore path). ``heal_pool`` re-gates the fleet — every module
+fixture calls it before building its router, so each suite starts from
+three live, healthy backends regardless of what the previous one did.
+"""
+
+import os
+import sys
+
+import pytest
+
+from mpi_vision_tpu.serve.cluster import BackendPool
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+N_BACKENDS = 3
+N_SCENES = 6
+IMG, PLANES = 32, 4
+
+
+def _pool_env():
+  sys.path.insert(0, REPO)
+  from _cpu_mesh import hardened_env
+
+  env = hardened_env(1)
+  env["JAX_COMPILATION_CACHE_DIR"] = os.path.join(REPO, ".jax_cache")
+  return env
+
+
+def heal_pool(pool) -> dict:
+  """Restart any backend a previous suite's chaos left dead and return
+  the (unchanged — restarts reuse ports) address map."""
+  for bid in sorted(pool.addresses()):
+    if not pool.alive(bid):
+      pool.restart(bid)
+  return pool.addresses()
+
+
+@pytest.fixture(scope="session")
+def backend_pool():
+  """3 real serve processes shared by every live suite in tests/serve."""
+  pool = BackendPool(
+      N_BACKENDS, scenes=N_SCENES, img_size=IMG, planes=PLANES,
+      env=_pool_env(),
+      extra_args=["--max-batch", "4", "--max-wait-ms", "1"],
+      log=lambda m: print(m, file=sys.stderr))
+  try:
+    pool.start()
+  except Exception:
+    pool.close()
+    raise
+  yield pool
+  pool.close()
+
+
+@pytest.fixture(scope="module")
+def healed_backends(backend_pool):
+  """``(pool, addresses)`` with every backend re-gated live — what a
+  suite's module fixture consumes (fresh heal per module, one pool)."""
+  return backend_pool, heal_pool(backend_pool)

@@ -1,0 +1,614 @@
+"""Brownout: the degradation ladder, priority admission, and the two
+contracts that make it safe to run.
+
+Three layers, cheapest first:
+
+  * Ladder state-machine tests on a fake clock — hysteresis
+    (no-flapping band), one-level-at-a-time descent with dwell,
+    fast-window recovery where every step earns its own healthy window,
+    priority shed ordering (interactive last), max_level cap.
+  * In-process service pins — L0 bit-exactness vs a brownout-less
+    service, degraded renders labelled and full-shape, the cache
+    contract (degraded frames never populate the edge cache and never
+    carry an ETag; L3 widens warp tolerance over full-quality entries
+    only), the recovery contract (sheds count in brownout families,
+    never in SLO bad), and the HTTP header surface.
+  * Router aggregation over fake transports — class forwarding,
+    degraded-header passthrough, the fleet brownout summary, and the
+    asset-304 answered at the router without waking a backend.
+"""
+
+import base64
+import json
+import random
+import threading
+import types
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mpi_vision_tpu.obs import SloConfig
+from mpi_vision_tpu.serve import RenderService, make_http_server
+from mpi_vision_tpu.serve import brownout
+from mpi_vision_tpu.serve.assets.fetch import SceneFetcher
+from mpi_vision_tpu.serve.assets.store import asset_etag
+from mpi_vision_tpu.serve.cluster import Router, make_router_http_server
+from mpi_vision_tpu.serve.edge.cache import EdgeConfig
+from mpi_vision_tpu.serve.resilience import RetryPolicy
+from mpi_vision_tpu.serve.scheduler import QueueFullError
+
+H = W = 16
+P = 4
+
+
+class FakeClock:
+  def __init__(self, t=100.0):
+    self.t = t
+
+  def __call__(self):
+    return self.t
+
+
+def _pose(tx=0.0):
+  pose = np.eye(4, dtype=np.float32)
+  pose[0, 3] = tx
+  return pose
+
+
+def _ladder(burn=0.0, queue=0.0, clock=None, **cfg):
+  """A controller on mutable signal holders and (optionally) a fake
+  clock; eval rate limit off so every tick evaluates."""
+  cfg.setdefault("eval_interval_s", 0.0)
+  sig = {"burn": burn, "queue": queue}
+  ctl = brownout.BrownoutController(
+      brownout.BrownoutConfig(**cfg),
+      burn_fn=lambda: sig["burn"], queue_fn=lambda: sig["queue"],
+      clock=clock if clock is not None else FakeClock())
+  return ctl, sig
+
+
+# --- config & key helpers ------------------------------------------------
+
+
+def test_config_rejects_inverted_hysteresis_band():
+  with pytest.raises(ValueError, match="hysteresis"):
+    brownout.BrownoutConfig(recover_burn=2.0, burn_high=2.0)
+  with pytest.raises(ValueError, match="hysteresis"):
+    brownout.BrownoutConfig(recover_queue=0.5, queue_high=0.5)
+  with pytest.raises(ValueError, match="plane_keep"):
+    brownout.BrownoutConfig(plane_keep=0.0)
+  with pytest.raises(ValueError, match="l3_warp_scale"):
+    brownout.BrownoutConfig(l3_warp_scale=0.5)
+  with pytest.raises(ValueError, match="max_level"):
+    brownout.BrownoutConfig(max_level=0)
+
+
+def test_normalize_class_unknown_is_interactive():
+  assert brownout.normalize_class(None) == "interactive"
+  assert brownout.normalize_class(" Prefetch ") == "prefetch"
+  assert brownout.normalize_class("vip") == "interactive"
+  assert brownout.shed_level("background") == 2
+  assert brownout.shed_level("interactive") == 4
+
+
+def test_half_res_key_roundtrip():
+  key = brownout.half_res_key("scene_000")
+  assert key != "scene_000"
+  assert brownout.split_degrade_key(key) == ("scene_000", True)
+  assert brownout.split_degrade_key("scene_000") == ("scene_000", False)
+
+
+# --- the ladder state machine (fake clock) -------------------------------
+
+
+def test_first_descent_immediate_then_one_level_per_dwell():
+  clk = FakeClock()
+  ctl, sig = _ladder(burn=10.0, clock=clk, step_dwell_s=2.0,
+                     recover_dwell_s=5.0)
+  assert ctl.tick() == 1  # first response to an incident: immediate
+  assert ctl.tick() == 1  # consecutive steps wait out the dwell
+  clk.t += 1.9
+  assert ctl.tick() == 1
+  clk.t += 0.1
+  assert ctl.tick() == 2
+  clk.t += 2.0
+  assert ctl.tick() == 3
+  clk.t += 2.0
+  assert ctl.tick() == 4
+  clk.t += 10.0
+  assert ctl.tick() == 4  # capped at max_level
+  assert ctl.transitions_down == 4 and ctl.transitions_up == 0
+
+
+def test_queue_signal_alone_drives_descent():
+  clk = FakeClock()
+  ctl, sig = _ladder(queue=0.9, clock=clk, step_dwell_s=0.0)
+  assert ctl.tick() == 1
+  sig["queue"] = 0.3  # inside the band (0.25, 0.5): hold
+  clk.t += 100.0
+  assert ctl.tick() == 1
+  sig["queue"] = 0.1  # healthy
+  clk.t += 1.0
+  ctl.tick()  # healthy timer starts here
+  clk.t += ctl.config.recover_dwell_s
+  assert ctl.tick() == 0
+
+
+def test_recovery_needs_a_full_healthy_window_per_step():
+  clk = FakeClock()
+  ctl, sig = _ladder(burn=10.0, clock=clk, step_dwell_s=0.0,
+                     recover_dwell_s=5.0)
+  ctl.tick()
+  ctl.tick()
+  assert ctl.level == 2
+  sig["burn"] = 0.5  # healthy
+  ctl.tick()  # healthy_since = now
+  clk.t += 4.9
+  assert ctl.tick() == 2  # 4.9 < 5: not yet
+  clk.t += 0.1
+  assert ctl.tick() == 1  # one step, and the timer restarts
+  assert ctl.tick() == 1  # a 2-level climb is TWO sustained windows
+  clk.t += 5.0
+  assert ctl.tick() == 0
+  assert ctl.transitions_up == 2
+
+
+def test_hysteresis_band_resets_the_healthy_timer():
+  clk = FakeClock()
+  ctl, sig = _ladder(burn=10.0, clock=clk, step_dwell_s=0.0,
+                     recover_dwell_s=5.0)
+  assert ctl.tick() == 1
+  sig["burn"] = 0.5
+  ctl.tick()
+  clk.t += 4.9  # almost recovered...
+  assert ctl.tick() == 1
+  sig["burn"] = 1.5  # ...then a blip into the band (1.0, 2.0)
+  clk.t += 0.1
+  assert ctl.tick() == 1  # held, not descended (band != overload)
+  sig["burn"] = 0.5
+  clk.t += 0.1
+  ctl.tick()  # the blip reset the timer: a fresh full window is owed
+  clk.t += 4.9
+  assert ctl.tick() == 1
+  clk.t += 0.1
+  assert ctl.tick() == 0
+  assert ctl.transitions_down == 1 and ctl.transitions_up == 1
+
+
+def test_priority_shed_ordering_interactive_last():
+  clk = FakeClock()
+  ctl, sig = _ladder(burn=10.0, clock=clk, step_dwell_s=0.0,
+                     recover_dwell_s=3600.0, shed_retry_after_s=2.5)
+  for want_level, shed, admitted in (
+      (1, (), ("interactive", "prefetch", "background")),
+      (2, ("background",), ("interactive", "prefetch")),
+      (3, ("background", "prefetch"), ("interactive",)),
+      (4, ("background", "prefetch", "interactive"), ()),
+  ):
+    sig["burn"] = 10.0
+    ctl.tick()
+    sig["burn"] = 1.5  # hold in the band while we probe admission
+    assert ctl.level == want_level
+    for cls in admitted:
+      assert ctl.admit(cls) == want_level
+    for cls in shed:
+      with pytest.raises(brownout.BrownoutShedError) as err:
+        ctl.admit(cls)
+      assert err.value.request_class == cls
+      assert err.value.level == want_level
+      assert err.value.retry_after_s == 2.5
+      assert isinstance(err.value, QueueFullError)  # rides the 503 arm
+
+
+def test_max_level_cap_holds_the_ladder_down():
+  clk = FakeClock()
+  ctl, _ = _ladder(burn=10.0, clock=clk, step_dwell_s=0.0, max_level=2)
+  for _ in range(5):
+    ctl.tick()
+  assert ctl.level == 2
+  ctl.admit("interactive")  # interactive sheds only at 4: still served
+
+
+def test_snapshot_and_reset_counters():
+  ctl, sig = _ladder(burn=10.0, step_dwell_s=0.0)
+  ctl.tick()
+  snap = ctl.snapshot()
+  assert snap["enabled"] is True and snap["level"] == 1
+  assert snap["transitions"] == {"down": 1, "up": 0}
+  assert snap["signals"]["burn"] == 10.0
+  ctl.reset_counters()
+  assert ctl.snapshot()["transitions"] == {"down": 0, "up": 0}
+  assert ctl.level == 1  # the level is live state, not a counter
+
+
+# --- in-process service pins ---------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def svc_bo():
+  svc = RenderService(max_batch=2, max_wait_ms=1.0, use_mesh=False,
+                      method="fused", slo=SloConfig(),
+                      brownout=brownout.BrownoutConfig())
+  svc.add_synthetic_scenes(1, height=H, width=W, planes=P)
+  yield svc
+  svc.close()
+
+
+@pytest.fixture(scope="module")
+def svc_plain():
+  svc = RenderService(max_batch=2, max_wait_ms=1.0, use_mesh=False,
+                      method="fused")
+  svc.add_synthetic_scenes(1, height=H, width=W, planes=P)
+  yield svc
+  svc.close()
+
+
+def _arm(svc, level):
+  """Pin the service's ladder at ``level`` via injected signals: climb
+  on a saturated burn, then hold in the hysteresis band."""
+  sig = {"burn": 10.0}
+  ctl = brownout.BrownoutController(
+      brownout.BrownoutConfig(step_dwell_s=0.0, recover_dwell_s=3600.0,
+                              eval_interval_s=0.0),
+      burn_fn=lambda: sig["burn"], queue_fn=lambda: 0.0)
+  for _ in range(level):
+    ctl.tick()
+  sig["burn"] = 1.5
+  assert ctl.level == level
+  svc.brownout = ctl
+  return ctl
+
+
+def test_l0_bit_identical_to_a_service_without_brownout(svc_bo, svc_plain):
+  _arm(svc_bo, 0)
+  pose = _pose(0.01)
+  img, info = svc_bo.render_request("scene_000", pose,
+                                    request_class="interactive")
+  assert info["level"] == 0 and info["degraded"] is False
+  np.testing.assert_array_equal(img, svc_plain.render("scene_000", pose))
+
+
+def test_l2_render_full_shape_degraded_and_counted(svc_bo):
+  _arm(svc_bo, 0)
+  pose = _pose(0.02)
+  full, _ = svc_bo.render_request("scene_000", pose)
+  _arm(svc_bo, 2)
+  img, info = svc_bo.render_request("scene_000", pose,
+                                    request_class="interactive")
+  assert img.shape == (H, W, 3)  # upsampled back to the request raster
+  assert info["level"] == 2 and info["degraded"] is True
+  assert not np.array_equal(img, full)  # genuinely lower fidelity
+  snap = svc_bo.metrics.snapshot()
+  assert snap["brownout"]["degraded"]["2"] >= 1
+
+
+def test_degrade_batch_keys_never_coalesce(svc_bo):
+  pose = _pose()
+  k0, _ = svc_bo._tile_batch_key("scene_000", pose, degrade=0)
+  k2, _ = svc_bo._tile_batch_key("scene_000", pose, degrade=2)
+  assert k0 != k2
+  assert brownout.split_degrade_key(k2) == (k0, True)
+
+
+def test_shed_counts_in_brownout_families_never_slo_bad(svc_bo):
+  _arm(svc_bo, 4)
+  bad_before = svc_bo.slo.snapshot()[
+      "objectives"]["availability"]["slow"]["bad"]
+  sheds_before = svc_bo.metrics.snapshot()["brownout"]["sheds"]
+  with pytest.raises(brownout.BrownoutShedError) as err:
+    svc_bo.render_request("scene_000", _pose(), request_class="prefetch")
+  assert err.value.level == 4 and err.value.retry_after_s > 0
+  snap = svc_bo.metrics.snapshot()["brownout"]["sheds"]
+  assert snap["prefetch"] == sheds_before["prefetch"] + 1
+  # The recovery contract: a shed is load management, not an outage.
+  assert svc_bo.slo.snapshot()[
+      "objectives"]["availability"]["slow"]["bad"] == bad_before
+
+
+def test_stats_overlays_controller_state(svc_bo):
+  _arm(svc_bo, 3)
+  block = svc_bo.stats()["brownout"]
+  assert block["enabled"] is True and block["level"] == 3
+  assert "sheds" in block and "signals" in block
+
+
+# --- HTTP header surface -------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def http_bo(svc_bo):
+  httpd = make_http_server(svc_bo, port=0)
+  thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+  thread.start()
+  yield f"http://127.0.0.1:{httpd.server_address[1]}"
+  httpd.shutdown()
+
+
+def _post_render(base, request_class=None, tx=0.0):
+  body = json.dumps({"scene_id": "scene_000",
+                     "pose": _pose(tx).tolist()}).encode()
+  headers = {"Content-Type": "application/json"}
+  if request_class is not None:
+    headers[brownout.REQUEST_CLASS_HEADER] = request_class
+  req = urllib.request.Request(base + "/render", data=body, headers=headers)
+  try:
+    with urllib.request.urlopen(req, timeout=60) as resp:
+      return resp.status, dict(resp.headers.items())
+  except urllib.error.HTTPError as e:
+    with e:
+      return e.code, dict(e.headers.items())
+
+
+def test_http_degraded_response_is_labelled_and_uncacheable(svc_bo, http_bo):
+  _arm(svc_bo, 2)
+  status, headers = _post_render(http_bo, request_class="interactive")
+  assert status == 200
+  assert headers[brownout.LEVEL_HEADER] == "2"
+  assert headers[brownout.DEGRADED_HEADER] == "1"
+  assert headers["Cache-Control"] == "no-store"
+  assert "ETag" not in headers
+
+
+def test_http_shed_is_503_with_retry_after_and_level(svc_bo, http_bo):
+  _arm(svc_bo, 2)
+  status, headers = _post_render(http_bo, request_class="background")
+  assert status == 503
+  assert float(headers["Retry-After"]) > 0
+  assert headers[brownout.LEVEL_HEADER] == "2"
+
+
+def test_http_l0_carries_level_zero_and_no_degraded_marker(svc_bo, http_bo):
+  _arm(svc_bo, 0)
+  status, headers = _post_render(http_bo, request_class="interactive")
+  assert status == 200
+  assert headers[brownout.LEVEL_HEADER] == "0"
+  assert brownout.DEGRADED_HEADER not in headers
+
+
+# --- the edge-cache contract ---------------------------------------------
+
+
+@pytest.fixture
+def svc_edge():
+  svc = RenderService(
+      max_batch=2, max_wait_ms=1.0, use_mesh=False, method="fused",
+      slo=SloConfig(),
+      edge=EdgeConfig(trans_cell=0.01, rot_bucket_deg=90.0,
+                      warp_max_trans=0.02, warp_max_rot_deg=45.0),
+      brownout=brownout.BrownoutConfig())
+  svc.add_synthetic_scenes(1, height=H, width=W, planes=P)
+  yield svc
+  svc.close()
+
+
+def test_degraded_frames_never_enter_the_edge_cache(svc_edge):
+  _arm(svc_edge, 2)
+  img, info = svc_edge.render_request("scene_000", _pose())
+  assert info["edge"] == "miss" and info["degraded"] is True
+  assert info["etag"] is None
+  assert svc_edge.edge.stats()["frames"] == 0  # the cell stayed empty
+  # A full-quality render fills the cell and earns the strong ETag...
+  _arm(svc_edge, 0)
+  img0, info0 = svc_edge.render_request("scene_000", _pose())
+  assert info0["edge"] == "miss" and info0["etag"]
+  assert svc_edge.edge.stats()["frames"] == 1
+  # ...and only THAT entry serves hits, at full quality.
+  img1, info1 = svc_edge.render_request("scene_000", _pose())
+  assert info1["edge"] == "hit" and info1["degraded"] is False
+  assert info1["etag"] == info0["etag"]
+  np.testing.assert_array_equal(img1, img0)
+
+
+def test_l3_widens_warp_tolerance_and_labels_the_serve(svc_edge):
+  _arm(svc_edge, 0)
+  _, info0 = svc_edge.render_request("scene_000", _pose())
+  assert info0["edge"] == "miss" and info0["etag"]
+  # 0.04 translation: outside the base warp tolerance (0.02), inside
+  # the L3-widened one (3x = 0.06).
+  _arm(svc_edge, 3)
+  img, info = svc_edge.render_request("scene_000", _pose(0.04),
+                                      request_class="interactive")
+  assert info["edge"] == "warp"
+  assert info["degraded"] is True  # served only because L3 widened it
+  assert info["etag"] is None  # pose-specific warp: never validatable
+  assert svc_edge.metrics.snapshot()["brownout"]["degraded"]["3"] >= 1
+  # The same request at L0 would NOT warp-serve: it renders.
+  _arm(svc_edge, 0)
+  _, info_l0 = svc_edge.render_request("scene_000", _pose(0.04))
+  assert info_l0["edge"] == "miss" and info_l0["degraded"] is False
+
+
+# --- router: forwarding, aggregation, asset 304 --------------------------
+
+
+class FakeTransport:
+  def __init__(self):
+    self.handlers = {}
+    self.calls = []
+
+  def set(self, address, handler):
+    self.handlers[address] = handler
+
+  def request(self, method, url, body=None, headers=None, timeout=30.0):
+    address, _, path = url[len("http://"):].partition("/")
+    self.calls.append((address, method, "/" + path))
+    return self.handlers[address](method, "/" + path, body, headers or {})
+
+
+def _router(transport):
+  return Router({"a": "hostA:1", "b": "hostB:1"}, replication=2,
+                breaker_threshold=2, breaker_reset_s=10.0,
+                transport=transport, clock=FakeClock())
+
+
+def test_router_brownout_summary_pools_the_fleet():
+  per = {
+      "a": {"brownout": {"enabled": True, "level": 2,
+                         "sheds": {"background": 3},
+                         "degraded": {"2": 5}}},
+      "b": {"brownout": {"enabled": True, "level": 0,
+                         "sheds": {"background": 1, "prefetch": 2},
+                         "degraded": {}}},
+      "c": {"brownout": {"enabled": False, "level": 0,
+                         "sheds": {}, "degraded": {}}},
+      "d": {"error": "unreachable"},
+  }
+  out = Router._brownout_summary(per)
+  assert out == {
+      "backends_reporting": 3,
+      "backends_enabled": 2,
+      "max_level": 2,
+      "levels": {"a": 2},
+      "sheds": {"background": 4, "prefetch": 2},
+      "degraded_total": 5,
+  }
+
+
+def test_router_stats_carry_the_fleet_brownout_block():
+  def backend(method, path, body, headers):
+    if path == "/stats":
+      return 200, {}, json.dumps({
+          "brownout": {"enabled": True, "level": 1,
+                       "sheds": {"background": 2}, "degraded": {"1": 1}},
+      }).encode()
+    return 200, {}, json.dumps({}).encode()
+
+  transport = FakeTransport()
+  transport.set("hostA:1", backend)
+  transport.set("hostB:1", backend)
+  out = _router(transport).stats()["brownout"]
+  assert out["backends_enabled"] == 2 and out["max_level"] == 1
+  assert out["sheds"] == {"background": 4}
+
+
+@pytest.fixture
+def http_router_bo():
+  """A socketed router over fake backends that echo brownout headers
+  and record what the router forwarded to them."""
+  seen = {}
+
+  def backend(method, path, body, headers):
+    seen.update(headers)
+    if method == "GET":
+      return 200, {"Content-Type": "application/octet-stream",
+                   "ETag": asset_etag("ab" * 32)}, b"asset-bytes"
+    # A structurally valid render body — the router validates 200s
+    # before forwarding them (1x1x3 float32 => 12 bytes => 16 b64).
+    pixels = base64.b64encode(np.zeros((1, 1, 3), np.float32).tobytes())
+    return 200, {"Content-Type": "application/json",
+                 brownout.LEVEL_HEADER: "2",
+                 brownout.DEGRADED_HEADER: "1",
+                 "Cache-Control": "no-store"}, json.dumps(
+                     {"scene_id": "s1", "shape": [1, 1, 3],
+                      "image_b64": pixels.decode()}).encode()
+
+  transport = FakeTransport()
+  transport.set("hostA:1", backend)
+  transport.set("hostB:1", backend)
+  router = _router(transport)
+  server = make_router_http_server(router)
+  thread = threading.Thread(target=server.serve_forever, daemon=True)
+  thread.start()
+  base = f"http://127.0.0.1:{server.server_address[1]}"
+  yield base, router, transport, seen
+  server.shutdown()
+
+
+def test_http_router_forwards_class_and_degraded_headers(http_router_bo):
+  base, _, _, seen = http_router_bo
+  body = json.dumps({"scene_id": "s1",
+                     "pose": np.eye(4).tolist()}).encode()
+  req = urllib.request.Request(
+      base + "/render", data=body,
+      headers={"Content-Type": "application/json",
+               brownout.REQUEST_CLASS_HEADER: "prefetch"})
+  with urllib.request.urlopen(req, timeout=30) as resp:
+    headers = dict(resp.headers.items())
+  assert seen.get(brownout.REQUEST_CLASS_HEADER) == "prefetch"
+  assert headers[brownout.LEVEL_HEADER] == "2"
+  assert headers[brownout.DEGRADED_HEADER] == "1"
+  assert headers["Cache-Control"] == "no-store"
+
+
+def test_http_router_answers_asset_304_without_a_backend(http_router_bo):
+  base, router, transport, _ = http_router_bo
+  digest = "ab" * 32
+  etag = asset_etag(digest)
+  calls_before = len(transport.calls)
+  req = urllib.request.Request(
+      base + f"/scene/s1/asset/{digest}",
+      headers={"If-None-Match": etag})
+  with pytest.raises(urllib.error.HTTPError) as err:
+    urllib.request.urlopen(req, timeout=30)
+  with err.value:
+    assert err.value.code == 304
+    assert err.value.headers["ETag"] == etag
+    assert "immutable" in err.value.headers["Cache-Control"]
+  # Proven fresh by arithmetic: no backend was consulted.
+  assert len(transport.calls) == calls_before
+  assert router.metrics.snapshot()["scene_sync"]["asset_revalidations"] == 1
+  # Without the matching validator the GET forwards as before.
+  with urllib.request.urlopen(base + f"/scene/s1/asset/{digest}",
+                              timeout=30) as resp:
+    assert resp.status == 200 and resp.read() == b"asset-bytes"
+  assert len(transport.calls) > calls_before
+
+
+# --- scene fetcher: transient retry + background class -------------------
+
+
+class FlakyFetchTransport:
+  def __init__(self, failures):
+    self.failures = failures
+    self.calls = 0
+    self.headers_seen = []
+
+  def get(self, url, headers=None):
+    self.calls += 1
+    self.headers_seen.append(dict(headers or {}))
+    if self.calls <= self.failures:
+      raise ConnectionError("connection reset")
+    return 200, {}, json.dumps({"scenes": ["s1"]}).encode()
+
+
+def _fetch_service():
+  retries = {"n": 0}
+  metrics = types.SimpleNamespace(
+      record_scene_sync_retry=lambda: retries.__setitem__(
+          "n", retries["n"] + 1))
+  return types.SimpleNamespace(metrics=metrics, events=None), retries
+
+
+def test_fetcher_retries_transient_failures_with_backoff():
+  transport = FlakyFetchTransport(failures=2)
+  service, retries = _fetch_service()
+  sleeps = []
+  fetcher = SceneFetcher(
+      service, "http://upstream", transport=transport,
+      retry=RetryPolicy(max_retries=2, backoff_base_s=0.05,
+                        backoff_mult=2.0, jitter=0.1),
+      sleep=sleeps.append, rng=random.Random(0))
+  assert fetcher.remote_scenes() == ["s1"]
+  assert transport.calls == 3 and retries["n"] == 2
+  assert len(sleeps) == 2
+  assert 0.05 * 0.9 <= sleeps[0] <= 0.05 * 1.1  # base +- jitter
+  assert 0.10 * 0.9 <= sleeps[1] <= 0.10 * 1.1  # exponential
+  # Every attempt declares itself background traffic: a browned-out
+  # upstream sheds the sync sweep before any interactive render.
+  for headers in transport.headers_seen:
+    assert headers[brownout.REQUEST_CLASS_HEADER] == "background"
+
+
+def test_fetcher_retry_budget_exhausts_to_the_caller():
+  transport = FlakyFetchTransport(failures=10)
+  service, retries = _fetch_service()
+  fetcher = SceneFetcher(
+      service, "http://upstream", transport=transport,
+      retry=RetryPolicy(max_retries=2), sleep=lambda s: None,
+      rng=random.Random(0))
+  with pytest.raises(ConnectionError):
+    fetcher.remote_scenes()
+  assert transport.calls == 3  # 1 + max_retries, then give up
+  assert retries["n"] == 2

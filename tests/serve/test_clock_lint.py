@@ -85,6 +85,10 @@ def test_lint_covers_the_ckpt_package_and_train_loop():
           # The tile tier (PR 13): the planner is request-path code and
           # the tile/crop caches feed the latency accounting.
           "serve/tiles.py", "serve/cache.py", "serve/server.py",
+          # The brownout tier (PR 17): dwell and recovery windows are
+          # the hysteresis — one bare clock call makes the ladder
+          # untestable and ties descent cadence to wall time.
+          "serve/brownout.py",
           "train/loop.py", "train/telemetry.py", "train/queue.py",
           "train/supervisor.py", "train/faultinject.py",
           "cluster/router.py",

@@ -765,45 +765,22 @@ def test_aggregated_metrics_cached_for_ttl_under_injectable_clock():
 # --- the real thing: multi-process cluster on CPU -----------------------
 
 
-N_BACKENDS = 3
-N_SCENES = 6
-IMG, PLANES = 32, 4
-
-
-def _pool_env():
-  sys.path.insert(0, REPO)
-  from _cpu_mesh import hardened_env
-
-  env = hardened_env(1)
-  env["JAX_COMPILATION_CACHE_DIR"] = os.path.join(REPO, ".jax_cache")
-  return env
-
-
 @pytest.fixture(scope="module")
-def cluster():
+def cluster(healed_backends):
   """≥3 real serve processes + a router with per-backend breakers.
 
-  Module-scoped: the pool spawn (3 JAX processes) is the expensive part;
-  the tests below run in definition order against one pool. The breaker
-  cooldown is LONG so an opened breaker stays visibly open for the
-  assertions; the resurrection test drives the probe through a fresh
-  router with its own short-cooldown breakers.
+  The pool is the session-shared one (conftest.backend_pool) — spawning
+  3 JAX processes is the expensive part, so every live suite rides the
+  same fleet, re-gated healthy per module. The breaker cooldown is LONG
+  so an opened breaker stays visibly open for the assertions; the
+  resurrection test drives the probe through a fresh router with its
+  own short-cooldown breakers.
   """
-  pool = BackendPool(
-      N_BACKENDS, scenes=N_SCENES, img_size=IMG, planes=PLANES,
-      env=_pool_env(),
-      extra_args=["--max-batch", "4", "--max-wait-ms", "1"],
-      log=lambda m: print(m, file=sys.stderr))
-  try:
-    backends = pool.start()
-  except Exception:
-    pool.close()
-    raise
+  pool, backends = healed_backends
   router = Router(backends, replication=2, breaker_threshold=2,
                   breaker_reset_s=600.0, render_timeout_s=120.0,
                   tracer=Tracer())
   yield pool, router
-  pool.close()
 
 
 def _render_body(sid, tx=0.0):
@@ -828,7 +805,7 @@ def test_cluster_shards_scenes_and_routes_bit_identically(cluster):
     status, headers, body = router.forward_render(sid, _render_body(sid))
     assert status == 200
     routed = _decode(body)
-    assert routed.shape == (IMG, IMG, 3)
+    assert routed.shape == (pool.img_size, pool.img_size, 3)
     # Bit-identical to a DIRECT render on the very backend that served
     # it (the router is a pure forwarder; placement changes nothing in
     # the pixels).
@@ -931,7 +908,7 @@ def test_cluster_sigkill_mid_load_fails_over_and_isolates(cluster):
           f"healthy backend {bid} breaker opened: {binfo}")  # isolation
   health = router.healthz()
   assert health["status"] == "degraded"  # NOT unhealthy: replicas cover
-  assert health["backends_reachable"] == N_BACKENDS - 1
+  assert health["backends_reachable"] == pool.n_backends - 1
   assert router.metrics.snapshot()["failovers"] >= 1
 
 

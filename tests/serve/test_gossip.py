@@ -550,34 +550,12 @@ def test_remote_pool_hook_failure_is_counted_by_supervisor_never_fatal():
 # --- the real thing: leased handoff over a live fleet --------------------
 
 
-N_BACKENDS = 2
-N_SCENES = 2
-IMG, PLANES = 32, 4
-
-
-def _pool_env():
-  sys.path.insert(0, REPO)
-  from _cpu_mesh import hardened_env
-
-  env = hardened_env(1)
-  env["JAX_COMPILATION_CACHE_DIR"] = os.path.join(REPO, ".jax_cache")
-  return env
-
-
 @pytest.fixture(scope="module")
-def live_fleet():
-  pool = BackendPool(
-      N_BACKENDS, scenes=N_SCENES, img_size=IMG, planes=PLANES,
-      env=_pool_env(),
-      extra_args=["--max-batch", "4", "--max-wait-ms", "1"],
-      log=lambda m: print(m, file=sys.stderr))
-  try:
-    backends = pool.start()
-  except Exception:
-    pool.close()
-    raise
-  yield pool, backends
-  pool.close()
+def live_fleet(healed_backends):
+  """The session-shared backend pool (conftest.backend_pool), re-gated
+  healthy — the lease-handoff arc needs real processes to kill and
+  respawn, not a particular pool size."""
+  return healed_backends
 
 
 def _render_body(sid):
@@ -611,7 +589,10 @@ def test_live_failover_arc_lease_handoff_and_respawn(live_fleet, tmp_path):
   router_a, sup_a = replica("routerA", state_a)
   router_b, sup_b = replica("routerB", state_b)
   sids = pool.scene_ids()
-  victim = sorted(backends)[0]
+  # The victim must be a backend that actually serves sids[0]: the
+  # phase-4 convergence check waits for IT to answer that scene, and
+  # on a >2-backend pool an arbitrary backend may not be in placement.
+  victim = router_a.placement(sids[0])[0]
 
   # Phase 1: A leads, B stands by; the fleet serves through BOTH
   # router replicas (routing never needed the lease).

@@ -328,3 +328,40 @@ def test_serve_load_chaos_dry_smoke():
       assert {"target", "attained", "burn_fast", "burn_slow",
               "pass"} <= set(obj)
   assert slo["objectives"]["availability"]["requests"] >= out["requests"]
+
+
+def test_serve_load_overload_ab_dry_smoke():
+  """The brownout A/B's tier-1 smoke: one process, a ~3x phased
+  overload ramp driven twice — ladder armed, then shed-only — and one
+  JSON line. Dry scale pins MECHANICS only (same contract as the --ab
+  and --tiled-ab dry smokes, where toy-scene verdicts are noise): the
+  ladder engages under the ramp and recovers to L0, interactive is
+  never shed below L4, neither arm 5xxs, and the JSON carries the full
+  acceptance shape. The performance verdict — brownout buys
+  interactive goodput and holds the SLO that shed-only violates —
+  belongs to real sizes (`--overload-ab --duration 10`, BENCH-style)."""
+  out = _run_dry(["--overload-ab"])
+  assert out["metric"] == "serve_load_overload_ab" and out["dry"] is True
+  assert out["latency_threshold_ms"] > 0  # calibrated, not hardcoded
+  brownout, shed_only = out["brownout"], out["shed_only"]
+  # Shape: the goodput ratio and verdicts are computed and sane, even
+  # though dry scale can't pin which way they fall.
+  assert out["interactive_goodput_x"] is not None
+  assert out["interactive_goodput_x"] > 0
+  assert isinstance(brownout["slo"]["pass"], bool)
+  assert isinstance(shed_only["slo"]["pass"], bool)
+  # Admission contract: interactive is shed ONLY at L4 — if the ladder
+  # never maxed out, interactive sheds must be exactly zero.
+  if brownout["max_level"] < 4:
+    assert brownout["sheds"]["interactive"] == 0
+  assert brownout["requests_ok"]["interactive"] > 0
+  # No 5xx storm in either arm: failures stay empty, pressure resolves
+  # as sheds (brownout) / queue rejects (shed-only).
+  assert brownout["failed"] == {} and shed_only["failed"] == {}
+  assert sum(shed_only["queue_rejects"].values()) > 0
+  # The trajectory proof: the ladder climbed under the ramp and the
+  # recovery windows walked it back to L0 before the window closed.
+  assert brownout["max_level"] >= 1
+  assert brownout["returned_to_l0"] is True and out["returned_to_l0"]
+  assert shed_only["max_level"] == 0  # the arm really ran unarmed
+  assert brownout["interactive_p99_ms"] > 0

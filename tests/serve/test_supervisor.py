@@ -397,41 +397,18 @@ def test_supervisor_feeds_router_load_table():
 # --- the real thing: supervised multi-process fleet on CPU ---------------
 
 
-N_BACKENDS = 3
-N_SCENES = 6
-IMG, PLANES = 32, 4
-
-
-def _pool_env():
-  sys.path.insert(0, REPO)
-  from _cpu_mesh import hardened_env
-
-  env = hardened_env(1)
-  env["JAX_COMPILATION_CACHE_DIR"] = os.path.join(REPO, ".jax_cache")
-  return env
-
-
 @pytest.fixture(scope="module")
-def fleet():
+def fleet(healed_backends):
   """3 real serve processes + a router with short-cooldown per-backend
   breakers (0.5 s: a restarted backend's half-open probe re-closes
-  within the test's traffic, not after minutes). Module-scoped; the
-  tests below run in definition order against one pool and leave it
-  fully serving (3 live backends) for the next."""
-  pool = BackendPool(
-      N_BACKENDS, scenes=N_SCENES, img_size=IMG, planes=PLANES,
-      env=_pool_env(),
-      extra_args=["--max-batch", "4", "--max-wait-ms", "1"],
-      log=lambda m: print(m, file=sys.stderr))
-  try:
-    backends = pool.start()
-  except Exception:
-    pool.close()
-    raise
+  within the test's traffic, not after minutes). The pool is the
+  session-shared one (conftest.backend_pool), re-gated healthy here;
+  the tests below run in definition order against it and leave it
+  fully serving (3 live backends) for the next suite."""
+  pool, backends = healed_backends
   router = Router(backends, replication=2, breaker_threshold=2,
                   breaker_reset_s=0.5, render_timeout_s=120.0)
   yield pool, router
-  pool.close()
 
 
 def _render_body(sid, tx=0.0):

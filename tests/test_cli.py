@@ -218,6 +218,41 @@ def test_serve_edge_negative_ttl_guarded():
     cli.main(["serve", "--edge-negative-ttl-s", "30", "--duration", "0.1"])
 
 
+@pytest.mark.parametrize("flag,value", [
+    ("--brownout-burn-high", "3.0"),
+    ("--brownout-queue-high", "0.7"),
+    ("--brownout-recover-burn", "0.5"),
+    ("--brownout-recover-queue", "0.1"),
+    ("--brownout-step-dwell-s", "1.0"),
+    ("--brownout-recover-dwell-s", "10.0"),
+    ("--brownout-plane-keep", "0.25"),
+    ("--brownout-warp-scale", "2.0"),
+    ("--brownout-max-level", "3"),
+])
+def test_serve_brownout_knobs_guarded(flag, value):
+  """Every ladder knob only acts through the controller; dangling any
+  of them would silently leave the operator's degradation policy off."""
+  with pytest.raises(SystemExit, match=r"require\(s\) --brownout"):
+    cli.main(["serve", flag, value, "--duration", "0.1"])
+
+
+def test_serve_brownout_requires_slo_and_validates_at_the_door():
+  """The ladder is DRIVEN by the SLO burn rate — armed without the
+  tracker it would never descend; and a closed hysteresis band must
+  fail at startup, not flap in production."""
+  with pytest.raises(SystemExit, match="--brownout requires SLO"):
+    cli.main(["serve", "--brownout", "--no-slo", "--duration", "0.1"])
+  with pytest.raises(SystemExit, match="bad brownout config"):
+    cli.main(["serve", "--brownout", "--brownout-recover-burn", "2.0",
+              "--brownout-burn-high", "2.0", "--duration", "0.1"])
+  with pytest.raises(SystemExit, match="bad brownout config"):
+    cli.main(["serve", "--brownout", "--brownout-plane-keep", "0",
+              "--duration", "0.1"])
+  with pytest.raises(SystemExit, match="bad brownout config"):
+    cli.main(["serve", "--brownout", "--brownout-max-level", "5",
+              "--duration", "0.1"])
+
+
 def test_cluster_bad_supervision_knobs_rejected():
   """Invalid supervision knobs must fail at the door: the monitor loop
   swallows tick exceptions by design, so a lazily-raised ValueError

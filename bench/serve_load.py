@@ -202,6 +202,13 @@ def build_parser() -> argparse.ArgumentParser:
                   help="trace every request (obs.Tracer) and report the "
                        "trace accounting + slowest-exemplar span names "
                        "in the JSON")
+  ap.add_argument("--incident-dir", type=str, default="",
+                  help="arm the SLO-triggered incident recorder "
+                       "(obs/incident.py) in the --overload-ab arms "
+                       "(bundles under <dir>/<arm>/) and run the "
+                       "deterministic capture drill (<dir>/drill/); the "
+                       "JSON carries per-arm incident stats + the drill "
+                       "verdict")
   ap.add_argument("--cluster", action="store_true",
                   help="measure the multi-host tier: spawn backend "
                        "processes, route through serve/cluster.Router, "
@@ -274,6 +281,38 @@ def slo_window_config(duration: float):
                    slow_window_s=max(2.0 * duration, fast),
                    bucket_s=max(fast / 8.0, 0.1),
                    quantile=0.99, per_scene=True)
+
+
+def attrib_record(stats: dict) -> dict:
+  """The bench JSON's attribution block: bounded top cells, the window
+  totals, and the conservation verdict (cell sums reconciled against
+  the metrics layer's own request/phase totals). Empty when the run's
+  service had no ledger, so older record consumers see no key at all
+  rather than a null."""
+  snap = stats.get("attrib")
+  if not snap:
+    return {}
+  return {"attrib": {
+      "cells_total": snap["cells_total"],
+      "overflow_requests": snap["overflow_requests"],
+      "totals": snap["totals"],
+      "top_cells": snap["cells"][:8],
+      "conservation": snap.get("conservation"),
+  }}
+
+
+def device_seconds_by_class(stats: dict) -> dict | None:
+  """Device seconds summed per request class from the attribution cells
+  — the overload A/B's resource answer: the ladder should shift device
+  time toward interactive work, not just admit more of it."""
+  snap = stats.get("attrib")
+  if not snap:
+    return None
+  out: dict = {}
+  for cell in snap["cells"]:
+    out[cell["class"]] = out.get(cell["class"], 0.0) + sum(
+        (cell.get("device_s") or {}).values())
+  return {c: round(s, 6) for c, s in sorted(out.items(), key=str)}
 
 
 def cluster_slo_verdict(router_stats: dict) -> dict | None:
@@ -935,6 +974,7 @@ def inprocess_run(args, inflight: int, edge: bool = False) -> dict:
   this; ``--ab`` / ``--edge-ab`` call it twice). ``edge`` serves the
   closed loop through ``RenderService.render_edge`` (the pose-quantized
   frame cache) instead of the raw scheduler path."""
+  from mpi_vision_tpu.obs import attrib as attrib_lib
   from mpi_vision_tpu.obs import slo as slo_mod
   from mpi_vision_tpu.serve import (
       FaultyEngine,
@@ -966,7 +1006,8 @@ def inprocess_run(args, inflight: int, edge: bool = False) -> dict:
       method=args.method, use_mesh=use_mesh,
       engine=engine, resilience=resilience, tracer=tracer,
       edge=(EdgeConfig(trans_cell=args.edge_trans_cell) if edge else None),
-      slo=slo_window_config(args.duration))
+      slo=slo_window_config(args.duration),
+      attrib=attrib_lib.AttribConfig())
   ids = svc.add_synthetic_scenes(
       args.scenes, height=args.img_size, width=args.img_size,
       planes=args.num_planes, seed=args.seed)
@@ -1090,6 +1131,10 @@ def inprocess_run(args, inflight: int, edge: bool = False) -> dict:
       # burn rates, and whether alerts fired — BENCH lines now trend
       # against explicit objectives instead of raw percentiles.
       "slo": slo_mod.verdict(stats.get("slo")),
+      # Resource attribution: who ate the window (scene x class x
+      # level), plus the conservation check proving the cells sum back
+      # to the metrics totals.
+      **attrib_record(stats),
   }
   if args.chaos:
     record["chaos_injected"] = stats["engine"]["fault_injection"]
@@ -1142,6 +1187,7 @@ def tiled_run(args, tile: "int | None") -> tuple[dict, dict]:
   ``(record, parity_frames)`` where ``parity_frames`` maps pool index
   -> rendered frame for the cross-arm parity checks."""
   from mpi_vision_tpu.core import camera
+  from mpi_vision_tpu.obs import attrib as attrib_lib
   from mpi_vision_tpu.obs import slo as slo_mod
   from mpi_vision_tpu.serve import RenderService
   from mpi_vision_tpu.serve.server import synthetic_tiled_scene
@@ -1158,7 +1204,8 @@ def tiled_run(args, tile: "int | None") -> tuple[dict, dict]:
       cache_bytes=args.cache_mb << 20, max_batch=args.max_batch,
       max_wait_ms=args.max_wait_ms, max_inflight=args.inflight,
       method=args.method, use_mesh=use_mesh, tile=tile,
-      slo=slo_window_config(args.duration))
+      slo=slo_window_config(args.duration),
+      attrib=attrib_lib.AttribConfig())
   svc.add_scene("tiled_scene", layers, depths, k)
   arm = f"tiled (tile {tile})" if tile is not None else "monolithic"
   _log(f"serve_load: tiled-ab arm [{arm}] — scene "
@@ -1249,6 +1296,7 @@ def tiled_run(args, tile: "int | None") -> tuple[dict, dict]:
       "mean_batch_size": stats["mean_batch_size"],
       "device": stats["engine"]["platform"],
       "slo": slo_mod.verdict(stats.get("slo")),
+      **attrib_record(stats),
   }
   if tile is not None:
     record["tiles"] = stats["tiles"]
@@ -1560,6 +1608,8 @@ def overload_run(args, with_brownout: bool,
   L0 inside one run); off, the same overload resolves by queue-full
   sheds alone — the baseline a degradation ladder must beat."""
   from mpi_vision_tpu.obs import SloConfig
+  from mpi_vision_tpu.obs import attrib as attrib_lib
+  from mpi_vision_tpu.obs import incident as incident_lib
   from mpi_vision_tpu.obs import slo as slo_mod
   from mpi_vision_tpu.serve import RenderService
   from mpi_vision_tpu.serve import brownout as brownout_mod
@@ -1584,16 +1634,23 @@ def overload_run(args, with_brownout: bool,
         recover_dwell_s=duration / 50.0,
         eval_interval_s=duration / 400.0,
         queue_high=0.6, recover_queue=0.3)
+  arm = "brownout" if with_brownout else "shed_only"
+  # --incident-dir arms the black box per arm (subdir each, so the two
+  # arms' bundles never prune each other's ring).
+  incidents = None
+  if args.incident_dir:
+    incidents = incident_lib.IncidentConfig(
+        dir=os.path.join(args.incident_dir, arm))
   svc = RenderService(
       cache_bytes=args.cache_mb << 20, max_batch=args.max_batch,
       max_wait_ms=args.max_wait_ms, max_inflight=args.inflight,
       method=args.method, use_mesh=use_mesh,
       max_queue=max(4, 2 * args.concurrency),
-      slo=slo, brownout=bo_cfg)
+      slo=slo, brownout=bo_cfg,
+      attrib=attrib_lib.AttribConfig(), incidents=incidents)
   ids = svc.add_synthetic_scenes(
       args.scenes, height=args.img_size, width=args.img_size,
       planes=args.num_planes, seed=args.seed)
-  arm = "brownout" if with_brownout else "shed_only"
   _log(f"serve_load: overload arm '{arm}' — {len(ids)} scenes "
        f"[{args.img_size}x{args.img_size}x{args.num_planes}], "
        f"base {args.concurrency} workers, ramp to {3 * args.concurrency}")
@@ -1704,6 +1761,70 @@ def overload_run(args, with_brownout: bool,
       "errors": stats["errors"],
       "rejected": stats["rejected"],
       "slo": slo_mod.verdict(stats.get("slo")),
+      # Who actually ate the device while the arm ran — the ladder's
+      # worth shows up here as device seconds shifted toward
+      # interactive, not just as admitted-request counts.
+      "device_seconds_by_class": device_seconds_by_class(stats),
+      **attrib_record(stats),
+      **({"incidents": {**stats["incidents"],
+                        "index": [b["id"] for b in svc.incidents.list()]}}
+         if "incidents" in stats else {}),
+  }
+
+
+def incident_drill(args, drill_dir: str) -> dict:
+  """Deterministic end-to-end black-box proof: a one-scene service with
+  a latency objective no render can meet (sub-microsecond threshold,
+  min_requests=1), so the burn-rate alert MUST fire within a handful of
+  requests — and the incident recorder must turn that fire edge into a
+  bundle on disk carrying the run's attribution cells. The two A/B arms
+  only capture when THIS box's overload actually breaches the
+  calibrated objective; the drill pins the capture path itself, every
+  run, dry included."""
+  from mpi_vision_tpu.obs import SloConfig
+  from mpi_vision_tpu.obs import attrib as attrib_lib
+  from mpi_vision_tpu.obs import incident as incident_lib
+  from mpi_vision_tpu.serve import RenderService
+
+  use_mesh = {"auto": None, "on": True, "off": False}[args.sharded]
+  slo = SloConfig(fast_window_s=0.5, slow_window_s=1.0, bucket_s=0.1,
+                  min_requests=1, latency_threshold_s=1e-6)
+  svc = RenderService(
+      cache_bytes=args.cache_mb << 20, max_batch=args.max_batch,
+      max_wait_ms=args.max_wait_ms, max_inflight=args.inflight,
+      method=args.method, use_mesh=use_mesh, slo=slo,
+      attrib=attrib_lib.AttribConfig(),
+      incidents=incident_lib.IncidentConfig(dir=drill_dir, keep=4))
+  try:
+    ids = svc.add_synthetic_scenes(
+        1, height=args.img_size, width=args.img_size,
+        planes=args.num_planes, seed=args.seed)
+    svc.warmup()
+    rng = np.random.default_rng(args.seed)
+    deadline = time.perf_counter() + 30.0
+    while (svc.incidents.stats()["captures"] == 0
+           and time.perf_counter() < deadline):
+      # Every request breaches the impossible threshold; recording
+      # evaluates the alert edges, the fire edge queues the capture.
+      svc.render_request(ids[0], random_pose(rng),
+                         request_class="interactive", timeout=60)
+      time.sleep(0.05)  # let windows age + the capture thread run
+    index = svc.incidents.list()
+    stats = svc.stats()
+  finally:
+    svc.close()
+  if not index:
+    raise SystemExit("serve_load: incident drill captured no bundle — "
+                     "the alert->capture path is broken")
+  bundle = svc.incidents.get(index[0]["id"])
+  return {
+      "dir": drill_dir,
+      "captures": stats["incidents"]["captures"],
+      "bundle_id": bundle["id"],
+      "alert": bundle["alert"]["alert"],
+      "bundle_keys": sorted(bundle),
+      "attrib_cells": len(bundle.get("attrib_top") or []),
+      "conservation_ok": stats["attrib"]["conservation"]["ok"],
   }
 
 
@@ -1713,7 +1834,9 @@ def overload_ab_main(args) -> int:
   503s alone, in one process. The headline number is the interactive
   goodput ratio — degrading low-priority work and render fidelity must
   buy MORE completed interactive requests than indiscriminate
-  shedding, with the level trajectory back at L0 by the tail."""
+  shedding, with the level trajectory back at L0 by the tail. With
+  ``--incident-dir`` both arms run with the black box armed and a
+  deterministic incident drill proves the alert->bundle path."""
   threshold_s = _overload_calibrate(args)
   _log("serve_load: overload A/B arm 1/2 — brownout ladder armed")
   brownout = overload_run(args, with_brownout=True,
@@ -1736,8 +1859,15 @@ def overload_ab_main(args) -> int:
       "returned_to_l0": brownout["returned_to_l0"],
       "brownout": brownout,
       "shed_only": shed_only,
+      "device_seconds_by_class": {
+          "brownout": brownout.get("device_seconds_by_class"),
+          "shed_only": shed_only.get("device_seconds_by_class"),
+      },
       "dry": bool(args.dry),
   }
+  if args.incident_dir:
+    record["incident_drill"] = incident_drill(
+        args, os.path.join(args.incident_dir, "drill"))
   print(json.dumps(record))
   return 0
 

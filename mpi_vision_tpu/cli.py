@@ -555,6 +555,32 @@ def cmd_serve(args: argparse.Namespace) -> dict:
     # The ladder is DRIVEN by the SLO burn rate; without the tracker it
     # would be a queue-only controller pretending to watch the SLO.
     raise SystemExit("--brownout requires SLO tracking (drop --no-slo)")
+  if args.attrib_scenes is not None and not args.attrib:
+    # The cap only acts through the ledger; the usual dangling-flag
+    # guard.
+    raise SystemExit("--attrib-scenes requires --attrib")
+  if args.attrib_scenes is not None and args.attrib_scenes < 1:
+    raise SystemExit(
+        f"--attrib-scenes must be >= 1, got {args.attrib_scenes}")
+  if not args.incident_dir:
+    # Incident knobs only act through the recorder; a silently inert
+    # black box is the dangling-flag failure mode.
+    wants_incident = [flag for flag, on in (
+        ("--incident-keep", args.incident_keep is not None),
+        ("--incident-window-s", args.incident_window_s is not None),
+        ("--incident-top-cells", args.incident_top_cells is not None),
+        ("--incident-profile", args.incident_profile is not None)) if on]
+    if wants_incident:
+      raise SystemExit(
+          f"{', '.join(wants_incident)} require(s) --incident-dir")
+  if args.incident_dir and not args.slo:
+    # Bundles capture on SLO alert FIRE edges; without the tracker the
+    # recorder would sit armed forever and never capture.
+    raise SystemExit(
+        "--incident-dir requires SLO tracking (drop --no-slo)")
+  if args.incident_profile is not None and not args.profile_dir:
+    # The wrapped capture rides the device profiler.
+    raise SystemExit("--incident-profile requires --profile-dir")
   if args.event_log_max_bytes > 0 and not args.event_log:
     # Rotation only acts on the JSONL sink; the in-memory ring is
     # already bounded.
@@ -706,6 +732,32 @@ def cmd_serve(args: argparse.Namespace) -> dict:
       # BrownoutConfig's own validation (hysteresis-band ordering,
       # plane-keep range, ...) speaks in flag terms already.
       raise SystemExit(f"bad brownout config: {e}") from None
+  attrib = None
+  if args.attrib:
+    from mpi_vision_tpu.obs import attrib as attrib_lib
+
+    attrib = attrib_lib.AttribConfig(
+        scene_cap=(args.attrib_scenes if args.attrib_scenes is not None
+                   else attrib_lib.SCENE_CAP))
+  incidents = None
+  if args.incident_dir:
+    from mpi_vision_tpu.obs import incident as incident_lib
+
+    inc_defaults = {}
+    if args.incident_keep is not None:
+      inc_defaults["keep"] = args.incident_keep
+    if args.incident_window_s is not None:
+      inc_defaults["tsdb_window_s"] = args.incident_window_s
+    if args.incident_top_cells is not None:
+      inc_defaults["top_k_cells"] = args.incident_top_cells
+    if args.incident_profile is not None:
+      inc_defaults["profile_seconds"] = args.incident_profile
+    try:
+      incidents = incident_lib.IncidentConfig(dir=args.incident_dir,
+                                              **inc_defaults)
+    except ValueError as e:
+      # IncidentConfig's own validation speaks in flag terms already.
+      raise SystemExit(f"bad incident config: {e}") from None
   profile_hook = None
   if args.profile_hook:
     import shlex
@@ -751,7 +803,7 @@ def cmd_serve(args: argparse.Namespace) -> dict:
       cpu_fallback=args.cpu_fallback, tracer=tracer,
       profile_dir=args.profile_dir or None, profile_hook=profile_hook,
       alert_hook=alert_hook, slo=slo, brownout=brownout, events=events,
-      tsdb=tsdb, ship=ship,
+      tsdb=tsdb, ship=ship, attrib=attrib, incidents=incidents,
       metrics_ttl_s=args.metrics_ttl_ms / 1e3)
   if args.mpi_dir:
     from mpi_vision_tpu.core.camera import intrinsics_matrix, inv_depths
@@ -934,6 +986,17 @@ def cmd_serve(args: argparse.Namespace) -> dict:
           "spooled": stats["ship"]["spooled"],
           "spool_dropped": stats["ship"]["spool_dropped"],
       }} if "ship" in stats else {}),
+      **({"attrib": {
+          "cells": stats["attrib"]["cells_total"],
+          "overflow_requests": stats["attrib"]["overflow_requests"],
+          "conservation_ok": stats["attrib"]["conservation"]["ok"],
+      }} if "attrib" in stats else {}),
+      **({"incidents": {
+          "captures": stats["incidents"]["captures"],
+          "suppressed": stats["incidents"]["suppressed"],
+          "bundles": stats["incidents"]["bundles"],
+          "capture_errors": stats["incidents"]["capture_errors"],
+      }} if "incidents" in stats else {}),
       "events_emitted": stats["events"]["emitted"],
       **({"traces": svc.tracer.finished} if args.trace else {}),
       **({"ckpt_step": ckpt_info["step"],
@@ -1878,6 +1941,38 @@ def build_parser() -> argparse.ArgumentParser:
   s.add_argument("--ship-spool-mb", type=int, default=None,
                  help="spool byte budget (default 64; oldest dropped "
                       "past it); requires --ship-url")
+  s.add_argument("--attrib", action="store_true",
+                 help="resource-attribution ledger: account every "
+                      "completed request's device phase-seconds, queue "
+                      "wait, bytes, and edge serves into bounded "
+                      "(scene x class x brownout-level) cells at GET "
+                      "/debug/attrib, /stats, and additive "
+                      "mpi_serve_attrib_* families the cluster router "
+                      "pool-sums into a fleet ledger")
+  s.add_argument("--attrib-scenes", type=int, default=None,
+                 help="distinct scenes tracked before folding into "
+                      "_other (default 32); requires --attrib")
+  s.add_argument("--incident-dir", default="",
+                 help="capture a self-contained incident bundle (alert "
+                      "+ burn numbers, slowest traces, tsdb window, "
+                      "events, brownout state, top attribution cells) "
+                      "into this directory on every SLO alert FIRE edge "
+                      "(deduplicated until the clear), served at GET "
+                      "/debug/incidents and shipped through --ship-url's "
+                      "spool; requires SLO tracking")
+  s.add_argument("--incident-keep", type=int, default=None,
+                 help="bundles retained on disk, oldest pruned (default "
+                      "8); requires --incident-dir")
+  s.add_argument("--incident-window-s", type=float, default=None,
+                 help="tsdb history frozen into each bundle (default "
+                      "300); requires --incident-dir")
+  s.add_argument("--incident-top-cells", type=int, default=None,
+                 help="attribution cells frozen into each bundle "
+                      "(default 8); requires --incident-dir")
+  s.add_argument("--incident-profile", type=float, default=None,
+                 help="additionally wrap a device-profiler capture of "
+                      "this many seconds into each bundle; requires "
+                      "--incident-dir and --profile-dir")
   s.add_argument("--metrics-ttl-ms", type=float, default=250.0,
                  help="memoize the /metrics exposition string this long "
                       "(scrape storms cost one snapshot render per "

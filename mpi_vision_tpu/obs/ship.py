@@ -60,6 +60,13 @@ MAX_PENDING_ALERTS = 256
 # outage window is bounded.
 MAX_CLAIMED_SEGMENTS = 32
 
+# Incident bundles retained in memory between ticks. Bundles are big
+# (a frozen tsdb window each) and rare (one per fire edge, deduplicated
+# by the recorder) — a deep backlog here would mean the interval is
+# longer than the incident cadence, and the recorder's own disk ring
+# still holds everything this cap sheds.
+MAX_PENDING_INCIDENTS = 8
+
 
 @dataclasses.dataclass(frozen=True)
 class ShipConfig:
@@ -147,6 +154,7 @@ class TelemetryShipper:
     self._rng = random.Random(seed)
     self._lock = threading.Lock()
     self._pending_alerts: deque = deque(maxlen=MAX_PENDING_ALERTS)
+    self._pending_incidents: deque = deque(maxlen=MAX_PENDING_INCIDENTS)
     self._alerts_dropped_marker = 0
     self._stop = threading.Event()
     self._thread: threading.Thread | None = None
@@ -158,6 +166,8 @@ class TelemetryShipper:
     self.retries = 0
     self.alert_edges = 0
     self.alert_edges_dropped = 0
+    self.incident_bundles = 0
+    self.incident_bundles_dropped = 0
     self.segments_shipped = 0
     self.segments_dropped = 0
     self.segment_errors = 0
@@ -205,6 +215,19 @@ class TelemetryShipper:
         self.alert_edges_dropped += 1
       self._pending_alerts.append(dict(record))
       self.alert_edges += 1
+
+  def note_incident(self, bundle: dict) -> None:
+    """Queue one incident bundle (``obs.incident``) for the next batch.
+
+    Bundles ride the same batch -> retry -> disk-spool arc as alert
+    edges, so a sink outage shorter than the spool budget loses none of
+    them and recovery drains them in capture order. O(1) append — the
+    recorder's daemon worker calls this, never the request path."""
+    with self._lock:
+      if len(self._pending_incidents) == self._pending_incidents.maxlen:
+        self.incident_bundles_dropped += 1
+      self._pending_incidents.append(bundle)
+      self.incident_bundles += 1
 
   # -- shipping ------------------------------------------------------------
 
@@ -432,11 +455,15 @@ class TelemetryShipper:
     with self._lock:
       alerts = list(self._pending_alerts)
       self._pending_alerts.clear()
+      incidents = list(self._pending_incidents)
+      self._pending_incidents.clear()
       tsdb_cursor = self._last_tsdb_ts
     cursor = tsdb_cursor
     items: list[dict] = []
     if alerts:
       items.append({"kind": "slo_alert_edges", "edges": alerts})
+    if incidents:
+      items.append({"kind": "incidents", "bundles": incidents})
     if self.tsdb is not None:
       families = self.tsdb.snapshot_since(tsdb_cursor)
       if families:
@@ -512,6 +539,9 @@ class TelemetryShipper:
           "alert_edges": self.alert_edges,
           "alert_edges_dropped": self.alert_edges_dropped,
           "alert_edges_pending": len(self._pending_alerts),
+          "incident_bundles": self.incident_bundles,
+          "incident_bundles_dropped": self.incident_bundles_dropped,
+          "incident_bundles_pending": len(self._pending_incidents),
           "segments_shipped": self.segments_shipped,
           "segments_dropped": self.segments_dropped,
           "segment_errors": self.segment_errors,
@@ -545,6 +575,13 @@ def registry(stats: dict | None) -> prom.Registry:
   reg.counter(p + "alert_edges_dropped_total",
               "Alert edges dropped from the pending ring while the sink "
               "was down.", stats.get("alert_edges_dropped", 0))
+  reg.counter(p + "incident_bundles_total",
+              "Incident bundles queued for shipping (obs.incident).",
+              stats.get("incident_bundles", 0))
+  reg.counter(p + "incident_bundles_dropped_total",
+              "Incident bundles dropped from the pending ring (the "
+              "recorder's disk ring still holds them).",
+              stats.get("incident_bundles_dropped", 0))
   reg.counter(p + "segments_shipped_total",
               "Rotated event-log segments delivered and deleted locally.",
               stats.get("segments_shipped", 0))

@@ -112,6 +112,12 @@ class TsdbRecorder:
     # stride drops the same points at 1/stride the cost.
     self._compact_countdown = self.config.compact_stride
 
+  def now(self) -> float:
+    """The recorder's wall clock — public so bundle builders (the
+    incident recorder's collector) window ``snapshot_since`` against
+    the same source that stamped the points."""
+    return self._clock()
+
   # -- sampling ------------------------------------------------------------
 
   def sample(self) -> int:

@@ -46,6 +46,7 @@ import numpy as np
 from concurrent.futures import ThreadPoolExecutor
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from mpi_vision_tpu.obs import attrib as attrib_mod
 from mpi_vision_tpu.obs import prom
 from mpi_vision_tpu.obs import hist as hist_mod
 from mpi_vision_tpu.obs import tsdb as tsdb_mod
@@ -1178,6 +1179,7 @@ class Router:
         "backends": {b: per_backend[b] for b in sorted(per_backend)},
         "slo": slo_block,
         "brownout": self._brownout_summary(per_backend),
+        "attrib": self._attrib_summary(per_backend),
     }
     if self.retry_budget is not None:
       out["retry_budget"] = self.retry_budget.snapshot()
@@ -1261,6 +1263,46 @@ class Router:
         "sheds": sheds,
         "degraded_total": degraded,
     }
+
+  @staticmethod
+  def _attrib_summary(per_backend_stats: dict) -> dict:
+    """The fleet attribution ledger: every reporting backend's
+    ``attrib`` block merged cell-wise (``obs.attrib.merge_snapshots``) —
+    the same aggregation the pool-summed ``mpi_serve_attrib_*`` families
+    get in ``/metrics``, here with the cells as JSON. Backends running
+    without the ledger simply contribute nothing."""
+    return attrib_mod.merge_snapshots(
+        st.get("attrib") for st in per_backend_stats.values()
+        if isinstance(st, dict))
+
+  def attrib_snapshot(self) -> dict:
+    """The aggregated ``/debug/attrib``: every backend's ledger (one
+    fan-out) plus the fleet merge — who is eating the fleet, by cell."""
+    per_backend = self._fan_out_get("/debug/attrib",
+                                    self.health_timeout_s)
+    return {
+        "fleet": attrib_mod.merge_snapshots(
+            st for st in per_backend.values()
+            if isinstance(st, dict) and "error" not in st),
+        "backends": {b: per_backend[b] for b in sorted(per_backend)},
+    }
+
+  def incidents_snapshot(self, incident_id: str | None = None) -> dict:
+    """The aggregated ``/debug/incidents``: every backend's bundle ring
+    index (or, with ``incident_id``, the full bundle from whichever
+    backends hold it — ids are per-backend sequences, so several may).
+    Backends running without a recorder contribute their 503 body."""
+    qs = "/debug/incidents"
+    if incident_id:
+      qs += f"?id={urllib.parse.quote(str(incident_id))}"
+    per_backend = self._fan_out_get(qs, self.health_timeout_s)
+    out: dict = {"backends": {b: per_backend[b]
+                              for b in sorted(per_backend)}}
+    if not incident_id:
+      out["incidents_total"] = sum(
+          len(st.get("incidents") or []) for st in per_backend.values()
+          if isinstance(st, dict))
+    return out
 
   def events_snapshot(self, recent: int = 128) -> dict:
     """The aggregated ``/debug/events``: the router's own lifecycle log
@@ -1604,6 +1646,12 @@ class _RouterHandler(BaseHTTPRequestHandler):
         return
       self._send_json(self.router.tsdb_snapshot(
           family=family, recent_s=recent, points=points))
+    elif parsed.path == "/debug/attrib":
+      # One fan-out reads the whole fleet's ledger + the cell-wise merge.
+      self._send_json(self.router.attrib_snapshot())
+    elif parsed.path == "/debug/incidents":
+      iid = urllib.parse.parse_qs(parsed.query).get("id", [None])[0]
+      self._send_json(self.router.incidents_snapshot(incident_id=iid))
     elif parsed.path == "/scenes":
       self._send_json(self.router.scenes())
     elif parsed.path.startswith("/scene/"):

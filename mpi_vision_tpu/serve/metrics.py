@@ -54,11 +54,22 @@ class ServeMetrics:
     # Called OUTSIDE this object's lock: the tracker locks itself, and
     # its alert callback may fan out to the event log.
     self.slo = None
+    # Optional obs.attrib.AttribLedger fed from record_request (set by
+    # RenderService). Feeding it HERE is what makes the conservation
+    # invariant structural: the ledger's request count and this object's
+    # ``requests`` increment on the same call. Like slo, called outside
+    # this lock (the ledger locks itself).
+    self.attrib = None
     self.reset()
 
   def reset(self) -> None:
     """Zero every counter and restart the uptime clock (load generators
     call this after warm-up so measurements are steady-state only)."""
+    if self.attrib is not None:
+      # The ledger must forget warm-up traffic together with the totals
+      # it reconciles against, or conservation breaks at the first
+      # post-warmup snapshot.
+      self.attrib.reset()
     with self._lock:
       self._t0 = self._clock()
       self._latencies = collections.deque(maxlen=self._window)
@@ -149,12 +160,21 @@ class ServeMetrics:
       self.slo.reset()
 
   def record_request(self, latency_s: float, scene_id: str | None = None,
-                     trace_id: str | None = None) -> None:
+                     trace_id: str | None = None,
+                     attrib: dict | None = None) -> None:
     """One request completed, queue-to-response latency.
 
     ``scene_id`` feeds the bounded per-scene breakdown; None (legacy
     callers) skips it. ``trace_id`` becomes the latency bucket's
     exemplar so a quantile reading links to a recorded trace.
+
+    ``attrib`` carries the request's attribution context when a ledger
+    is attached (``{"class", "level", "device", "queue_wait_s",
+    "edge"}`` — all optional): the scheduler passes the flight's
+    per-request device share and queue wait, the edge cache passes the
+    hit/warp kind. With no ledger attached it is ignored; with a ledger
+    attached but no context the request still lands in a default cell,
+    so the request-count conservation holds for every caller.
     """
     with self._lock:
       self.requests += 1
@@ -179,6 +199,13 @@ class ServeMetrics:
         entry[1] += latency_s
         entry[2] = max(entry[2], latency_s)
         entry[3].append(latency_s)
+    ledger = self.attrib
+    if ledger is not None:
+      ctx = attrib or {}
+      ledger.record(scene_id, ctx.get("class"), ctx.get("level", 0),
+                    device=ctx.get("device"),
+                    queue_wait_s=ctx.get("queue_wait_s", 0.0),
+                    edge=ctx.get("edge"))
     if self.slo is not None:
       # trace_id rides into the SLO windows' native histograms too, so
       # quantile alerts (global AND per-scene) carry a worst-offender
@@ -390,6 +417,14 @@ class ServeMetrics:
   def set_queue_depth(self, depth: int) -> None:
     with self._lock:
       self._queue_depth = int(depth)
+
+  def attrib_reference(self) -> dict:
+    """The attribution ledger's conservation reference — the UNROUNDED
+    request/phase totals (``snapshot()`` rounds to 3 decimals, which
+    would swamp the reconciliation's 1e-6 tolerance)."""
+    with self._lock:
+      return {"requests": self.requests,
+              "device_phase_seconds": dict(self.phase_seconds)}
 
   def snapshot(self, cache_stats: dict | None = None) -> dict:
     """JSON-ready state: latency percentiles, throughput, batch shape."""

@@ -93,6 +93,10 @@ class _Pending:
   key: str = ""                  # batch/scene-provider key (tile signature
                                  # appended for tiled scenes); defaults to
                                  # scene_id in submit()
+  attrib: tuple | None = None    # (request_class, brownout_level) for the
+                                 # attribution ledger; None = unlabeled
+  t_dispatch: float = 0.0        # when the flight claimed the request
+                                 # (queue wait = t_dispatch - t_enqueue)
 
 
 @dataclasses.dataclass
@@ -294,7 +298,8 @@ class MicroBatcher:
   # -- request path -------------------------------------------------------
 
   def submit(self, scene_id: str, pose, timeout: float | None = None,
-             trace=NULL_TRACE, degrade: int = 0) -> Future:
+             trace=NULL_TRACE, degrade: int = 0,
+             attrib: tuple | None = None) -> Future:
     """Enqueue one pose render; the future resolves to ``[H, W, 3]``.
 
     ``timeout`` (seconds) sets the request's deadline: retries/backoff
@@ -308,6 +313,11 @@ class MicroBatcher:
     ``degrade`` is the brownout render tier (0 = full quality) threaded
     to the batch keyer, which folds it into the batch key — degraded and
     full-quality requests can never coalesce into one flight.
+
+    ``attrib`` is the request's ``(request_class, brownout_level)``
+    attribution coordinates (the service's front door sets them); they
+    ride the pending entry so the flight can account this request's
+    share of device time into the right ledger cell at retirement.
     """
     pose = np.asarray(pose, np.float32)
     if pose.shape != (4, 4):
@@ -338,7 +348,7 @@ class MicroBatcher:
     req = _Pending(str(scene_id), pose, fut, now,
                    deadline=None if timeout is None else now + timeout,
                    trace=trace, qspan=trace.start_span("queue_wait"),
-                   key=key)
+                   key=key, attrib=attrib)
     with self._cond:
       if self._stop or self._thread is None:
         raise RuntimeError("scheduler is not running")
@@ -367,7 +377,8 @@ class MicroBatcher:
       return len(self._queue) / self.max_queue
 
   def render(self, scene_id: str, pose, timeout: float = 60.0,
-             trace=NULL_TRACE, degrade: int = 0) -> np.ndarray:
+             trace=NULL_TRACE, degrade: int = 0,
+             attrib: tuple | None = None) -> np.ndarray:
     """Synchronous render: submit + wait.
 
     On timeout the request is cancelled (best-effort) so an overloaded
@@ -382,7 +393,7 @@ class MicroBatcher:
     """
     try:
       fut = self.submit(scene_id, pose, timeout=timeout, trace=trace,
-                        degrade=degrade)
+                        degrade=degrade, attrib=attrib)
     except Exception as e:
       trace.finish(error=repr(e))
       raise
@@ -490,6 +501,7 @@ class MicroBatcher:
       return None
     assembly = self._last_assembly
     for req in live:
+      req.t_dispatch = now  # queue wait ends where the qspan ends
       req.trace.end_span(req.qspan)
       if assembly is not None:
         req.trace.add_span("batch_assembly", assembly[0], assembly[1],
@@ -773,10 +785,29 @@ class MicroBatcher:
     d1 = self._clock()
     self.metrics.record_batch(len(batch), render_s, phases=phases)
     done = self._clock()
+    # Attribution: each member of the flight carries an equal share of
+    # the dispatch's phase split, so the ledger's cell sums re-add to
+    # exactly what record_batch just put into phase_seconds (the
+    # conservation invariant). Built once per flight, only with a
+    # ledger attached — the default path stays allocation-free.
+    share = None
+    if getattr(self.metrics, "attrib", None) is not None:
+      n = len(batch)
+      share = {phase: float((phases or {}).get(phase + "_s", 0.0)) / n
+               for phase in ("h2d", "compute", "readback")}
     for i, req in enumerate(batch):
+      # The attrib kwarg is only passed alongside a live ledger, so
+      # drop-in metrics stubs predating it keep working unchanged.
+      kwargs = {}
+      if share is not None:
+        cls, level = req.attrib if req.attrib is not None else (None, 0)
+        kwargs["attrib"] = {
+            "class": cls, "level": level, "device": share,
+            "queue_wait_s": max(req.t_dispatch - req.t_enqueue, 0.0)}
       self.metrics.record_request(done - req.t_enqueue,
                                   scene_id=req.scene_id,
-                                  trace_id=req.trace.trace_id or None)
+                                  trace_id=req.trace.trace_id or None,
+                                  **kwargs)
       dspan = req.trace.add_span("dispatch", d0, d1, size=len(batch))
       if recorder is not None:
         recorder.replay(req.trace, parent=dspan)

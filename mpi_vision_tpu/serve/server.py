@@ -24,6 +24,14 @@ stdlib ``http.server`` front end:
                    traffic (409 while one is in flight; 503 unless the
                    service was built with a profile dir); a configured
                    profile hook receives the finished capture dir
+  GET  /debug/attrib -> the resource-attribution ledger: per
+                   (scene x class x brownout-level) cell device
+                   phase-seconds, queue wait, bytes out, edge serves,
+                   plus the conservation reconciliation (?top=K bounds
+                   the cell list; 503 unless built with attrib)
+  GET  /debug/incidents -> the incident-bundle ring index (?id=
+                   fetches one full bundle; 503 unless built with an
+                   incident dir)
   GET  /scenes  -> {"scenes": [...]} — the asset tier's discovery
                    endpoint (what a SceneFetcher sweeps)
   GET  /scene/{id}/manifest -> versioned JSON manifest (tile grid,
@@ -86,6 +94,8 @@ import numpy as np
 from mpi_vision_tpu.core import camera
 from mpi_vision_tpu.core.camera import inv_depths
 from mpi_vision_tpu.core.sampling import Convention  # noqa: F401 - API re-export
+from mpi_vision_tpu.obs import attrib as attrib_mod
+from mpi_vision_tpu.obs import incident as incident_mod
 from mpi_vision_tpu.obs import prom
 from mpi_vision_tpu.obs import ship as ship_mod
 from mpi_vision_tpu.obs import tsdb as tsdb_mod
@@ -264,6 +274,24 @@ class RenderService:
       daemon thread (retry + disk spool; counted, never fatal, never on
       the request path); pass a pre-built ``TelemetryShipper`` to adopt
       it un-started (tests drive ``tick()``); None disables shipping.
+    attrib: resource attribution (``obs.attrib``): pass an
+      ``AttribConfig`` to account every completed request's device
+      phase-seconds, queue wait, bytes, and edge serves into bounded
+      ``(scene x class x brownout-level)`` cells served at
+      ``GET /debug/attrib`` (+ an ``attrib`` block in ``/stats`` and
+      additive ``mpi_serve_attrib_*`` families the cluster router's
+      pool merge sums into a fleet ledger); pass a pre-built
+      ``AttribLedger`` to adopt it; None disables the endpoint (503).
+    incidents: the SLO-triggered incident recorder (``obs.incident``):
+      pass an ``IncidentConfig`` to capture a self-contained bundle
+      (alert + burn numbers, slowest traces, tsdb window, events,
+      brownout state, top attribution cells) on every alert FIRE edge
+      — deduplicated until the clear — into a bounded on-disk ring
+      served at ``GET /debug/incidents`` and shipped off-host through
+      the telemetry shipper's spool; pass a pre-built
+      ``IncidentRecorder`` to adopt it un-started (tests drive
+      ``drain()``); None disables the endpoint (503). Requires SLO
+      tracking (the alert edges are the trigger).
     metrics_ttl_s: ``/metrics`` exposition-string cache TTL
       (``obs.prom.ExpositionCache``) — scrape storms on the aggregated
       cluster endpoint cost one snapshot render per window instead of
@@ -291,6 +319,8 @@ class RenderService:
                events: EventLog | None = None,
                tsdb: "tsdb_mod.TsdbConfig | tsdb_mod.TsdbRecorder | None" = None,
                ship: "ship_mod.ShipConfig | ship_mod.TelemetryShipper | None" = None,
+               attrib: "attrib_mod.AttribConfig | attrib_mod.AttribLedger | None" = None,
+               incidents: "incident_mod.IncidentConfig | incident_mod.IncidentRecorder | None" = None,
                metrics_ttl_s: float = 0.25, clock=time.monotonic):
     if cpu_fallback not in ("auto", "on", "off"):
       raise ValueError(
@@ -340,6 +370,12 @@ class RenderService:
             "brownout degraded rendering requires an XLA method "
             "('fused'/'scan'/'assoc'); method='fused_pallas' cannot "
             "render reduced-resolution targets")
+    if incidents is not None and slo is None:
+      # The recorder only ever triggers on SLO alert edges; without the
+      # tracker it would sit armed forever and never capture — fail the
+      # misconfiguration at construction (the brownout precedent).
+      raise ValueError("incidents require SLO tracking (slo=None "
+                       "disables the alert edges that trigger capture)")
     # "auto" derives a per-scene size from its dims at publish
     # (tiles_mod.auto_tile); every `self.tile is not None` gate below
     # treats it exactly like an explicit size.
@@ -356,6 +392,16 @@ class RenderService:
         max_inflight=max(8, 2 * engine_window), **engine_kw)
     self.cache = cache_mod.SceneCache(byte_budget=cache_bytes)
     self.metrics = ServeMetrics()
+    # Resource-attribution ledger (obs/attrib.py): installed ON the
+    # metrics object so the one record_request recording point feeds
+    # both sides of the conservation invariant.
+    if isinstance(attrib, attrib_mod.AttribLedger):
+      self.attrib = attrib
+    elif attrib is not None:
+      self.attrib = attrib_mod.AttribLedger(attrib)
+    else:
+      self.attrib = None
+    self.metrics.attrib = self.attrib
     self.events = events if events is not None else EventLog()
     # SLO judgment layer: alert edges land in the event log, request
     # outcomes feed the tracker via ServeMetrics (one recording point).
@@ -491,6 +537,26 @@ class RenderService:
       self.shipper = ship_mod.TelemetryShipper(ship, tsdb=self.tsdb).start()
     else:
       self.shipper = None
+    # Incident recorder (obs/incident.py): built last — its collector
+    # freezes every surface wired above (slo, tracer, tsdb, events,
+    # brownout, attrib, profiler). Configs build + START the worker;
+    # pre-built recorders are adopted un-started (tests drive drain())
+    # with the service's collector/shipper wired in if absent (the
+    # shipper.tsdb adoption precedent).
+    if isinstance(incidents, incident_mod.IncidentRecorder):
+      self.incidents = incidents
+      if self.incidents.collect is None:
+        self.incidents.collect = self._incident_context
+      if self.incidents.on_bundle is None and self.shipper is not None:
+        self.incidents.on_bundle = self.shipper.note_incident
+    elif incidents is not None:
+      self.incidents = incident_mod.IncidentRecorder(
+          incidents, collect=self._incident_context,
+          on_bundle=(self.shipper.note_incident
+                     if self.shipper is not None else None),
+          events=self.events, clock=clock).start()
+    else:
+      self.incidents = None
     self._closed = False
 
   def _on_slo_alert(self, name: str, firing: bool, details: dict) -> None:
@@ -505,6 +571,12 @@ class RenderService:
       # O(1) queue append — the off-host delivery happens on the
       # shipper's own thread, never inside the alert (request) path.
       shipper.note_alert(record)
+    incidents = getattr(self, "incidents", None)
+    if incidents is not None:
+      # Same contract: O(1) edge note here, bundle capture on the
+      # recorder's own worker thread. Fire edges queue one capture
+      # (deduplicated until the clear edge releases the latch).
+      incidents.note_alert(name, firing, details)
     if self.alert_hook is None:
       return
     # Off the request path: alert edges fire inside SloTracker.check()
@@ -543,6 +615,40 @@ class RenderService:
   def _on_brownout_transition(self, old: int, new: int,
                               reason: str) -> None:
     self.events.emit("brownout_level", old=old, new=new, reason=reason)
+
+  def _incident_context(self, alert: dict) -> dict:
+    """One incident bundle's context (the recorder's ``collect`` hook):
+    every surface an operator would hand-stitch after a page — the SLO
+    burn numbers, the slowest traces, the tsdb window over the spike,
+    the recent events, the brownout ladder state, the hottest
+    attribution cells — frozen at the fire edge, plus optionally a
+    device-profile capture. Runs on the recorder's worker thread, never
+    the request path. Absent subsystems contribute nothing rather than
+    fail the capture (and the recorder survives this raising anyway)."""
+    del alert  # the recorder already embeds the alert record itself
+    cfg = self.incidents.config
+    out: dict = {}
+    if self.slo is not None:
+      out["slo"] = self.slo.snapshot()
+    if self.tracer is not NULL_TRACER:
+      out["traces"] = self.tracer.snapshot(recent=cfg.traces_recent)
+    if self.tsdb is not None:
+      out["tsdb_window"] = {
+          "window_s": cfg.tsdb_window_s,
+          "families": self.tsdb.snapshot_since(
+              self.tsdb.now() - cfg.tsdb_window_s)}
+    out["events"] = self.events.snapshot(recent=cfg.events_recent)
+    if self.brownout is not None:
+      out["brownout"] = self.brownout.snapshot()
+    if self.attrib is not None:
+      out["attrib_top"] = self.attrib.top_cells(cfg.top_k_cells)
+    if cfg.profile_seconds > 0 and self.profiler is not None:
+      try:
+        out["profile"] = self.profile(cfg.profile_seconds)
+      except Exception as e:  # noqa: BLE001 - a busy/failing profiler
+        # must not cost the bundle its other slices.
+        out["profile"] = {"error": repr(e)}
+    return out
 
   # -- scenes -------------------------------------------------------------
 
@@ -1172,16 +1278,40 @@ class RenderService:
       raise KeyError(f"unknown scene {sid!r}")
     return int(entry[0].shape[0]), int(entry[0].shape[1])
 
+  def _attrib_kwargs(self, attrib: "tuple | None",
+                     edge: str | None) -> dict:
+    """``record_request``'s attribution context for an edge-served
+    request — kwargs form, so with the ledger off nothing is passed and
+    drop-in metrics stubs predating the kwarg keep working."""
+    if self.attrib is None:
+      return {}
+    cls, level = attrib if attrib is not None else (None, 0)
+    return {"attrib": {"class": cls, "level": level, "edge": edge}}
+
+  def _attrib_bytes(self, scene_id, attrib: "tuple | None",
+                    nbytes) -> None:
+    """Account response payload bytes to the request's attribution cell
+    (no-op with the ledger off). Recorded at the serving front doors
+    (``render_edge``/``render_request``); raw ``render()`` callers get
+    no bytes attribution — they never serialized a response."""
+    if self.attrib is None:
+      return
+    cls, level = attrib if attrib is not None else (None, 0)
+    self.attrib.record_bytes(scene_id, cls, level, nbytes=int(nbytes))
+
   def _render_scheduled(self, scene_id: str, pose, timeout: float,
-                        trace, degrade: int) -> np.ndarray:
+                        trace, degrade: int,
+                        attrib: "tuple | None" = None) -> np.ndarray:
     """Scheduler render at the admitted degrade tier. L2+ renders at
     half resolution on-device (a quarter of the compositing FLOPs) and
     nearest-upsamples back to the full raster host-side at readback, so
     every response keeps the scene's shape contract."""
-    # degrade is passed only when nonzero: drop-in scheduler.render
-    # replacements (fault stubs, tests) predating the kwarg keep
-    # working for the full-quality path they were written against.
+    # degrade/attrib are passed only when engaged: drop-in
+    # scheduler.render replacements (fault stubs, tests) predating the
+    # kwargs keep working for the paths they were written against.
     kwargs = {"degrade": min(degrade, 2)} if degrade else {}
+    if self.attrib is not None and attrib is not None:
+      kwargs["attrib"] = attrib
     img = self.scheduler.render(scene_id, pose, timeout=timeout,
                                 trace=trace, **kwargs)
     if degrade >= 2:
@@ -1266,8 +1396,9 @@ class RenderService:
                            plane_depth, tiles=tiles)
 
   def render_edge(self, scene_id: str, pose, timeout: float = 60.0,
-                  trace=NULL_TRACE, degrade: int = 0) -> tuple[np.ndarray,
-                                                               dict]:
+                  trace=NULL_TRACE, degrade: int = 0,
+                  attrib: "tuple | None" = None) -> tuple[np.ndarray,
+                                                          dict]:
     """Render through the edge frame cache -> ``(image, info)``.
 
     ``info``: ``{"edge": "off" | "hit" | "warp" | "miss", "etag":
@@ -1291,10 +1422,11 @@ class RenderService:
     poison the bit-exact ETag contract.
     """
     if self.edge is None:
-      return (self._render_scheduled(str(scene_id), pose, timeout, trace,
-                                     degrade),
-              {"edge": "off", "etag": None, "max_age_s": None,
-               "degraded": degrade > 0})
+      img = self._render_scheduled(str(scene_id), pose, timeout, trace,
+                                   degrade, attrib)
+      self._attrib_bytes(scene_id, attrib, img.nbytes)
+      return (img, {"edge": "off", "etag": None, "max_age_s": None,
+                    "degraded": degrade > 0})
     t0 = self._clock()
     try:
       # Everything before the scheduler hand-off owns the trace's error
@@ -1313,7 +1445,9 @@ class RenderService:
         span = trace.start_span("edge_hit", cell=list(cell))
         trace.end_span(span)
         self.metrics.record_request(self._clock() - t0, scene_id=scene_id,
-                                    trace_id=trace.trace_id or None)
+                                    trace_id=trace.trace_id or None,
+                                    **self._attrib_kwargs(attrib, "hit"))
+        self._attrib_bytes(scene_id, attrib, entry.frame.nbytes)
         trace.finish()
         # An exact hit is the stored full-quality frame whatever the
         # brownout level — it keeps its strong ETag and is NOT degraded.
@@ -1333,7 +1467,9 @@ class RenderService:
         self.metrics.record_warp_pose_error(
             warp_trans, warp_rot_deg, trace_id=trace.trace_id or None)
         self.metrics.record_request(self._clock() - t0, scene_id=scene_id,
-                                    trace_id=trace.trace_id or None)
+                                    trace_id=trace.trace_id or None,
+                                    **self._attrib_kwargs(attrib, "warp"))
+        self._attrib_bytes(scene_id, attrib, img.nbytes)
         trace.finish()
         # A warp served only because L3 widened the tolerance is
         # labelled degraded; one within the base tolerance is ordinary
@@ -1367,7 +1503,7 @@ class RenderService:
         else None
     try:
       img = self._render_scheduled(str(scene_id), pose, timeout, trace,
-                                   degrade)
+                                   degrade, attrib)
     except QueueFullError as e:
       # Shed for real: plant the negative entry so the NEXT request for
       # this cell (and everyone piling behind it) skips the queue.
@@ -1375,6 +1511,12 @@ class RenderService:
       if ttl is not None and e.retry_after_s is None:
         e.retry_after_s = ttl
       raise
+    self._attrib_bytes(scene_id, attrib, img.nbytes)
+    if self.attrib is not None and tiles:
+      # Tile-tier demand: the source tiles this miss's frustum could
+      # sample (hits/warps reuse pixels — no new tile reads).
+      cls, level = attrib if attrib is not None else (None, 0)
+      self.attrib.record_tiles(scene_id, cls, level, tiles=len(tiles))
     if degrade > 0:
       # Degraded render: labelled, un-ETag'd, and NEVER cached — the
       # cell stays empty until a full-quality render fills it.
@@ -1404,13 +1546,16 @@ class RenderService:
     brings the burn rate DOWN; counting it as failure would wedge the
     ladder at max level.
     """
+    # The front door is where the request class is known — normalize it
+    # here (brownout or not) so the attribution ledger's class dimension
+    # reflects admission classes, not raw header strings.
+    cls = brownout_mod.normalize_class(request_class)
     if self.brownout is None:
       img, info = self.render_edge(scene_id, pose, timeout=timeout,
-                                   trace=trace)
+                                   trace=trace, attrib=(cls, 0))
       info.setdefault("degraded", False)
       info["level"] = 0
       return img, info
-    cls = brownout_mod.normalize_class(request_class)
     try:
       level = self.brownout.admit(cls)
     except brownout_mod.BrownoutShedError as e:
@@ -1419,7 +1564,8 @@ class RenderService:
       raise
     degrade = min(level, 3)
     img, info = self.render_edge(scene_id, pose, timeout=timeout,
-                                 trace=trace, degrade=degrade)
+                                 trace=trace, degrade=degrade,
+                                 attrib=(cls, level))
     info["level"] = level
     if info.get("degraded"):
       self.metrics.record_degraded(level)
@@ -1441,6 +1587,18 @@ class RenderService:
 
   # -- observability ------------------------------------------------------
 
+  def attrib_snapshot(self, top: int | None = None) -> dict:
+    """The ``/debug/attrib`` payload: the ledger snapshot plus the
+    conservation reconciliation against the metrics layer's own
+    (unrounded) request/phase totals. Raises ``RuntimeError`` when the
+    service was built without attribution (handlers map it to 503)."""
+    if self.attrib is None:
+      raise RuntimeError(
+          "attribution disabled: construct RenderService with attrib "
+          "(serve --attrib)")
+    return self.attrib.snapshot(top=top,
+                                reference=self.metrics.attrib_reference())
+
   def _render_metrics_text(self) -> str:
     text = prom.render_serve_metrics(self.stats(),
                                      self.metrics.latency_histogram())
@@ -1454,6 +1612,12 @@ class RenderService:
     shipper = getattr(self, "shipper", None)
     text += ship_mod.registry(
         shipper.stats() if shipper is not None else None).render()
+    ledger = getattr(self, "attrib", None)
+    text += attrib_mod.registry(
+        ledger.snapshot() if ledger is not None else None).render()
+    incidents = getattr(self, "incidents", None)
+    text += incident_mod.registry(
+        incidents.stats() if incidents is not None else None).render()
     return text
 
   def metrics_text(self) -> str:
@@ -1522,6 +1686,10 @@ class RenderService:
       out["tsdb"] = self.tsdb.stats()
     if self.shipper is not None:
       out["ship"] = self.shipper.stats()
+    if self.attrib is not None:
+      out["attrib"] = self.attrib_snapshot()
+    if self.incidents is not None:
+      out["incidents"] = self.incidents.stats()
     if self.profiler is not None:
       out["profile"] = {"captures": self.profiler.captures,
                         "hook_failures": self.profile_hook_failures}
@@ -1634,6 +1802,11 @@ class RenderService:
       self._closed = True
       if self.tsdb is not None:
         self.tsdb.stop()
+      # Incidents stop BEFORE the shipper: the stop sentinel lands
+      # behind queued fire edges, so a capture racing close still
+      # reaches disk AND still hands its bundle to a live shipper.
+      if self.incidents is not None:
+        self.incidents.stop()
       if self.shipper is not None:
         self.shipper.stop()
       self.scheduler.stop()
@@ -1778,6 +1951,10 @@ class _Handler(BaseHTTPRequestHandler):
                                                    kind=kind))
     elif parsed.path == "/debug/tsdb":
       self._do_tsdb(parsed.query)
+    elif parsed.path == "/debug/attrib":
+      self._do_attrib(parsed.query)
+    elif parsed.path == "/debug/incidents":
+      self._do_incidents(parsed.query)
     elif parsed.path == "/debug/profile":
       self._do_profile(parsed.query)
     elif parsed.path == "/scenes":
@@ -1876,6 +2053,41 @@ class _Handler(BaseHTTPRequestHandler):
       self._send_json({"families": self.service.tsdb.families(),
                        "stats": self.service.tsdb.stats()})
 
+  def _do_attrib(self, query: str) -> None:
+    """``/debug/attrib?top=K``: the resource-attribution ledger plus
+    the conservation reconciliation against the metrics totals."""
+    if self.service.attrib is None:
+      self._send_json(
+          {"error": "attribution disabled: construct RenderService with "
+                    "attrib (serve --attrib)"}, status=503)
+      return
+    try:
+      raw = urllib.parse.parse_qs(query).get("top", [None])[0]
+      top = int(raw) if raw is not None else None
+    except ValueError:
+      self._send_json({"error": "top must be an integer"}, status=400)
+      return
+    self._send_json(self.service.attrib_snapshot(top=top))
+
+  def _do_incidents(self, query: str) -> None:
+    """``/debug/incidents``: the bundle ring index (newest first) +
+    recorder stats; ``?id=incident-NNNNNN`` fetches one full bundle."""
+    if self.service.incidents is None:
+      self._send_json(
+          {"error": "incidents disabled: construct RenderService with "
+                    "incidents (serve --incident-dir)"}, status=503)
+      return
+    iid = urllib.parse.parse_qs(query).get("id", [None])[0]
+    if iid:
+      try:
+        self._send_json(self.service.incidents.get(iid))
+      except KeyError:
+        self._send_json({"error": f"unknown incident {iid!r}"},
+                        status=404)
+      return
+    self._send_json({"incidents": self.service.incidents.list(),
+                     "stats": self.service.incidents.stats()})
+
   def _do_profile(self, query: str) -> None:
     try:
       seconds = float(
@@ -1964,8 +2176,12 @@ class _Handler(BaseHTTPRequestHandler):
     if tr.trace_id:
       tid_hdr = {"X-Trace-Id": tr.trace_id}
     bo_on = self.service.brownout is not None
+    # The attribution ledger also needs the class-aware path: with only
+    # --attrib on, the plain render() branch would drop X-Request-Class
+    # and every cell would land "unlabeled".
+    attrib_on = self.service.attrib is not None
     try:
-      if edge_on or bo_on:
+      if edge_on or bo_on or attrib_on:
         img, edge_info = self.service.render_request(
             scene_id, pose,
             request_class=self.headers.get(brownout_mod.REQUEST_CLASS_HEADER),

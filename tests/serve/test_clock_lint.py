@@ -103,7 +103,12 @@ def test_lint_covers_the_ckpt_package_and_train_loop():
           "edge/cache.py", "edge/lattice.py", "edge/warp.py",
           "obs/slo.py", "obs/events.py", "obs/trace.py",
           "obs/prom.py", "obs/hist.py", "obs/tsdb.py",
-          "obs/ship.py"} <= rel
+          "obs/ship.py",
+          # The incident lens (PR 18): the attribution ledger stamps
+          # queue-wait and device seconds, and the recorder timestamps
+          # bundles — bare clock calls would make conservation and
+          # capture dedup untestable.
+          "obs/attrib.py", "obs/incident.py"} <= rel
 
 
 def test_lint_actually_catches_calls():

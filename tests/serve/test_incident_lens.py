@@ -1,0 +1,532 @@
+"""Incident lens + resource attribution: the acceptance pins.
+
+Two layers under test. ``obs/attrib.py``: the bounded
+``(scene x class x level)`` resource ledger whose cell sums must
+reconcile exactly with the metrics layer's pre-existing ``requests`` /
+``phase_seconds`` totals — in-process AND through the cluster router's
+pool merge (every ``mpi_serve_attrib_*`` family additive, never in a
+NON_ADDITIVE drop list). ``obs/incident.py``: the SLO-triggered black
+box — one bundle per fire edge (dedup until clear), bounded keep-K disk
+ring, resume across processes, and the shipper hand-off that survives a
+sink outage with zero loss.
+
+The acceptance drill at the bottom is the end-to-end arc: a one-scene
+latency fault under real load fires ``latency_p99:scene_*``, the
+recorder auto-captures a bundle whose exemplar trace id resolves at
+``/debug/traces``, whose tsdb window spans the spike, and whose
+attribution cells name the hot scene.
+"""
+
+import json
+import os
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mpi_vision_tpu.obs import attrib as attrib_mod
+from mpi_vision_tpu.obs import hist as hist_mod
+from mpi_vision_tpu.obs import incident as incident_mod
+from mpi_vision_tpu.obs import prom
+from mpi_vision_tpu.obs import ship as ship_mod
+from mpi_vision_tpu.obs import slo as slo_mod
+from mpi_vision_tpu.obs import tsdb as tsdb_mod
+from mpi_vision_tpu.obs.slo import SloConfig, SloTracker
+from mpi_vision_tpu.obs.trace import Tracer
+from mpi_vision_tpu.serve import (
+    Fault,
+    FaultyEngine,
+    RenderEngine,
+    RenderService,
+    make_http_server,
+)
+from mpi_vision_tpu.serve import brownout as brownout_mod
+from mpi_vision_tpu.serve.cluster.router import Router
+
+H = W = 16
+P = 4
+
+
+class FakeClock:
+  def __init__(self, t=1000.0):
+    self.t = t
+
+  def __call__(self):
+    return self.t
+
+  def advance(self, dt):
+    self.t += dt
+    return self.t
+
+
+def _pose(tx=0.0):
+  pose = np.eye(4, dtype=np.float32)
+  pose[0, 3] = tx
+  return pose
+
+
+def _get(port, path):
+  with urllib.request.urlopen(
+      f"http://127.0.0.1:{port}{path}", timeout=30) as resp:
+    return resp.status, resp.read()
+
+
+def _get_json(port, path):
+  status, body = _get(port, path)
+  return status, json.loads(body)
+
+
+# --- the attribution ledger ------------------------------------------------
+
+
+class TestAttribLedger:
+
+  def test_cells_accumulate_and_rank_hottest_first(self):
+    led = attrib_mod.AttribLedger()
+    for _ in range(3):
+      led.record("a", "interactive", 0,
+                 device={"h2d": 0.001, "compute": 0.01, "readback": 0.001},
+                 queue_wait_s=0.002)
+    led.record("b", "background", 2,
+               device={"h2d": 0.01, "compute": 0.2, "readback": 0.01})
+    led.record_bytes("a", "interactive", 0, nbytes=4096)
+    led.record_tiles("a", "interactive", 0, tiles=7)
+    led.record("a", "interactive", 0, edge="hit")
+    led.record("a", "interactive", 0, edge="warp")
+    snap = led.snapshot()
+    assert snap["cells_total"] == 2 and snap["scenes"] == 2
+    hot, cold = snap["cells"]
+    # "b" burned more device time despite fewer requests — ranking is by
+    # device-seconds, not request count.
+    assert (hot["scene"], hot["class"], hot["level"]) == ("b", "background", 2)
+    assert (cold["scene"], cold["class"]) == ("a", "interactive")
+    assert cold["requests"] == 5
+    assert cold["bytes_out"] == 4096 and cold["tiles_touched"] == 7
+    assert cold["edge_hits"] == 1 and cold["edge_warps"] == 1
+    assert cold["queue_wait_s"] == pytest.approx(0.006)
+    totals = snap["totals"]
+    assert totals["requests"] == 6
+    assert totals["device_s"]["compute"] == pytest.approx(0.23)
+    # top= truncates the list, not the population count.
+    top = led.snapshot(top=1)
+    assert len(top["cells"]) == 1 and top["cells_total"] == 2
+    assert led.top_cells(1)[0]["scene"] == "b"
+    led.reset()
+    assert led.snapshot()["cells_total"] == 0
+
+  def test_scene_cap_folds_overflow_and_unlabeled_class(self):
+    led = attrib_mod.AttribLedger(attrib_mod.AttribConfig(scene_cap=1))
+    led.record("a", "interactive", 0)
+    led.record("b", None, 0)  # past the cap AND unlabeled
+    led.record("c", "prefetch", 1)
+    snap = led.snapshot()
+    assert snap["scenes"] == 1 and snap["overflow_requests"] == 2
+    scenes = {c["scene"] for c in snap["cells"]}
+    assert scenes == {"a", attrib_mod.OVERFLOW_SCENE}
+    other = [c for c in snap["cells"]
+             if c["scene"] == attrib_mod.OVERFLOW_SCENE]
+    assert {(c["class"], c["level"]) for c in other} == \
+        {(attrib_mod.UNLABELED_CLASS, 0), ("prefetch", 1)}
+    with pytest.raises(ValueError):
+      attrib_mod.AttribConfig(scene_cap=0)
+
+  def test_conservation_reconciles_and_catches_leaks(self):
+    led = attrib_mod.AttribLedger()
+    led.record("a", "interactive", 0,
+               device={"h2d": 0.25, "compute": 1.5, "readback": 0.0625})
+    led.record("b", "prefetch", 1,
+               device={"h2d": 0.125, "compute": 0.5, "readback": 0.03125})
+    ref = {"h2d": 0.375, "compute": 2.0, "readback": 0.09375}
+    con = led.conservation(2, ref)
+    assert con["ok"] is True and con["request_delta"] == 0
+    # A dropped request or leaked device second must flip the verdict.
+    assert led.conservation(3, ref)["ok"] is False
+    bad = dict(ref, compute=2.5)
+    assert led.conservation(2, bad)["ok"] is False
+    # snapshot(reference=...) carries the same block.
+    snap = led.snapshot(reference={"requests": 2,
+                                   "device_phase_seconds": ref})
+    assert snap["conservation"]["ok"] is True
+
+  def test_merge_snapshots_aggregates_the_fleet(self):
+    a, b = attrib_mod.AttribLedger(), attrib_mod.AttribLedger()
+    a.record("s", "interactive", 0, device={"compute": 0.5})
+    a.record("only_a", "background", 0)
+    b.record("s", "interactive", 0, device={"compute": 0.25})
+    merged = attrib_mod.merge_snapshots(
+        [a.snapshot(), b.snapshot(), None, {}])
+    assert merged["backends"] == 2
+    shared = next(c for c in merged["cells"] if c["scene"] == "s")
+    assert shared["requests"] == 2
+    assert shared["device_s"]["compute"] == pytest.approx(0.75)
+    assert merged["totals"]["requests"] == 3
+    assert {c["scene"] for c in merged["cells"]} == {"s", "only_a"}
+
+  def test_families_additive_and_conserved_through_pool_merge(self):
+    """The router-merge conservation pin: two backends' expositions,
+    summed exactly the way ``Router._render_metrics_text`` sums them
+    (same drop set), must carry the fleet ledger — and no
+    ``mpi_serve_attrib_*`` family may ever sit in a NON_ADDITIVE drop
+    list, or the merge silently loses the ledger."""
+    drop = (slo_mod.NON_ADDITIVE_FAMILIES | hist_mod.NON_ADDITIVE_FAMILIES
+            | brownout_mod.NON_ADDITIVE_FAMILIES)
+    assert not {f for f in drop if f.startswith(attrib_mod.PREFIX)}
+    a, b = attrib_mod.AttribLedger(), attrib_mod.AttribLedger()
+    a.record("s", "interactive", 0,
+             device={"h2d": 0.125, "compute": 0.5, "readback": 0.0625},
+             queue_wait_s=0.25)
+    b.record("s", "interactive", 0,
+             device={"h2d": 0.0625, "compute": 0.25, "readback": 0.03125})
+    b.record_bytes("s", "interactive", 0, nbytes=1024)
+    texts = [attrib_mod.registry(led.snapshot()).render() for led in (a, b)]
+    families = prom.parse_metrics_text(
+        prom.aggregate_metrics_texts(texts, drop=drop))
+
+    def sample(family, want):
+      for (_, labels), value in families[family]["samples"].items():
+        if dict(labels) == want:
+          return value
+      raise AssertionError(f"no {family} sample labelled {want}")
+
+    cell = {"scene": "s", "class": "interactive", "level": "0"}
+    assert sample(attrib_mod.PREFIX + "requests_total", cell) == 2
+    assert sample(attrib_mod.PREFIX + "device_seconds_total",
+                  {**cell, "phase": "compute"}) == pytest.approx(0.75)
+    assert sample(attrib_mod.PREFIX + "bytes_out_total", cell) == 1024
+    # The summed exposition agrees with the structured fleet merge.
+    merged = attrib_mod.merge_snapshots([a.snapshot(), b.snapshot()])
+    assert merged["totals"]["device_s"]["compute"] == pytest.approx(0.75)
+
+  def test_router_stats_merge_uses_backend_attrib_blocks(self):
+    led = attrib_mod.AttribLedger()
+    led.record("s", "interactive", 0, device={"compute": 0.5})
+    per_backend = {"b0": {"attrib": led.snapshot()},
+                   "b1": {"attrib": led.snapshot()},
+                   "dead": {"error": "connection refused"}}
+    fleet = Router._attrib_summary(per_backend)
+    assert fleet["backends"] == 2
+    assert fleet["totals"]["requests"] == 2
+    assert fleet["totals"]["device_s"]["compute"] == pytest.approx(1.0)
+
+
+# --- the incident recorder -------------------------------------------------
+
+
+def _recorder(tmp_path, collect=None, on_bundle=None, **cfg_kw):
+  cfg = incident_mod.IncidentConfig(dir=str(tmp_path / "inc"), **cfg_kw)
+  clock = FakeClock()
+  return incident_mod.IncidentRecorder(
+      cfg, collect=collect, on_bundle=on_bundle,
+      clock=clock, wall=FakeClock(2000.0))
+
+
+class TestIncidentRecorder:
+
+  def test_fire_edge_captures_bundle_on_disk(self, tmp_path):
+    rec = _recorder(tmp_path,
+                    collect=lambda alert: {"slo": {"seen": alert["alert"]}})
+    rec.note_alert("latency_p99", True, {"fast_ms": 80.0})
+    assert rec.stats()["pending"] == 1
+    assert rec.drain() == 1
+    stats = rec.stats()
+    assert stats["captures"] == 1 and stats["pending"] == 0
+    assert stats["firing"] == ["latency_p99"]
+    (entry,) = rec.list()
+    assert entry["id"] == "incident-000001"
+    bundle = rec.get(entry["id"])
+    assert bundle["kind"] == "mpi_incident"
+    assert bundle["alert"]["alert"] == "latency_p99"
+    assert bundle["alert"]["details"]["fast_ms"] == 80.0
+    assert bundle["slo"] == {"seen": "latency_p99"}
+    on_disk = os.path.join(str(tmp_path / "inc"), "incident-000001.json")
+    assert os.path.exists(on_disk)
+    assert not os.path.exists(on_disk + ".tmp")  # atomic publish
+
+  def test_dedup_until_clear_then_one_bundle_per_fire_edge(self, tmp_path):
+    rec = _recorder(tmp_path)
+    rec.note_alert("latency", True)
+    rec.note_alert("latency", True)  # still firing: suppressed
+    assert rec.drain() == 1
+    assert rec.stats()["suppressed"] == 1
+    rec.note_alert("latency", False)  # clear releases the latch...
+    assert rec.drain() == 0  # ...but never captures
+    rec.note_alert("latency", True)
+    assert rec.drain() == 1
+    assert rec.stats()["captures"] == 2
+
+  def test_keep_k_prunes_oldest(self, tmp_path):
+    rec = _recorder(tmp_path, keep=2)
+    for i in range(3):
+      rec.note_alert(f"alert_{i}", True)
+    assert rec.drain() == 3
+    stats = rec.stats()
+    assert stats["pruned"] == 1 and stats["bundles"] == 2
+    ids = [e["id"] for e in rec.list()]
+    assert ids == ["incident-000003", "incident-000002"]
+    assert not os.path.exists(
+        os.path.join(str(tmp_path / "inc"), "incident-000001.json"))
+    with pytest.raises(KeyError):
+      rec.get("incident-000001")
+
+  def test_resume_continues_sequence_past_resident_bundles(self, tmp_path):
+    first = _recorder(tmp_path)
+    first.note_alert("latency", True)
+    first.drain()
+    second = _recorder(tmp_path)
+    assert [e["id"] for e in second.list()] == ["incident-000001"]
+    assert second.list()[0]["alert"] == "latency"
+    assert second.get("incident-000001")["seq"] == 1
+    second.note_alert("availability", True)
+    second.drain()
+    # The sequence resumed: the new bundle did NOT overwrite the old.
+    assert [e["id"] for e in second.list()] == \
+        ["incident-000002", "incident-000001"]
+
+  def test_get_rejects_traversal_and_unknown_ids(self, tmp_path):
+    rec = _recorder(tmp_path)
+    for bad in ("../../etc/passwd", "incident-1x", "", "incident-000009"):
+      with pytest.raises(KeyError):
+        rec.get(bad)
+
+  def test_failing_collector_still_leaves_a_bundle(self, tmp_path):
+    def collect(alert):
+      raise RuntimeError("stats deadlock")
+    rec = _recorder(tmp_path, collect=collect)
+    rec.note_alert("latency", True)
+    assert rec.drain() == 1
+    stats = rec.stats()
+    assert stats["captures"] == 1 and stats["capture_errors"] == 1
+    bundle = rec.get("incident-000001")
+    assert "stats deadlock" in bundle["collect_error"]
+    assert bundle["alert"]["alert"] == "latency"
+
+  def test_on_bundle_failure_counts_ship_errors(self, tmp_path):
+    def on_bundle(bundle):
+      raise ConnectionError("sink down")
+    rec = _recorder(tmp_path, on_bundle=on_bundle)
+    rec.note_alert("latency", True)
+    rec.drain()
+    stats = rec.stats()
+    assert stats["ship_errors"] == 1
+    assert stats["captures"] == 1  # the bundle is durable regardless
+
+  def test_worker_thread_stop_flushes_pending_jobs(self, tmp_path):
+    rec = _recorder(tmp_path).start()
+    rec.note_alert("latency", True)
+    rec.stop()  # sentinel lands BEHIND the job: capture still happens
+    assert rec.stats()["captures"] == 1
+
+  def test_config_validation(self, tmp_path):
+    with pytest.raises(ValueError):
+      incident_mod.IncidentConfig(dir="")
+    with pytest.raises(ValueError):
+      incident_mod.IncidentConfig(dir=str(tmp_path), keep=0)
+    with pytest.raises(ValueError):
+      incident_mod.IncidentConfig(dir=str(tmp_path), tsdb_window_s=0)
+
+  def test_registry_families_always_exposed(self):
+    text = incident_mod.registry(None).render()
+    families = prom.parse_metrics_text(text)
+    assert {incident_mod.PREFIX + name for name in (
+        "captures_total", "capture_errors_total", "suppressed_total",
+        "pruned_total", "ship_errors_total", "pending", "bundles",
+        "bundle_bytes")} == set(families)
+
+
+# --- shipper hand-off: a sink outage loses nothing -------------------------
+
+
+class FlakySink:
+  def __init__(self, down=True):
+    self.down = down
+    self.bodies: list[dict] = []
+
+  def post(self, url, body, timeout):
+    if self.down:
+      raise ConnectionError("sink down")
+    self.bodies.append(json.loads(body))
+    return 200
+
+
+def test_bundles_survive_sink_outage_and_drain_in_order(tmp_path):
+  clock = FakeClock()
+  sink = FlakySink(down=True)
+  shipper = ship_mod.TelemetryShipper(
+      ship_mod.ShipConfig(url="http://sink.invalid/ingest",
+                          spool_dir=str(tmp_path / "spool")),
+      transport=sink, clock=clock, sleep=lambda s: None)
+  rec = _recorder(tmp_path, on_bundle=shipper.note_incident)
+  rec.note_alert("latency_p99", True)
+  rec.drain()
+  shipper.tick()  # sink down: the bundle batch spools to disk
+  clock.advance(1)
+  rec.note_alert("availability", True)
+  rec.drain()
+  shipper.tick()
+  stats = shipper.stats()
+  assert stats["batches_shipped"] == 0 and stats["spool_files"] == 2
+  assert rec.stats()["ship_errors"] == 0  # hand-off itself never raised
+  sink.down = False
+  shipper.tick()  # recovery drains the spool oldest-first
+  assert shipper.stats()["spool_files"] == 0
+  shipped = [b["id"] for body in sink.bodies
+             for item in body["items"] if item["kind"] == "incidents"
+             for b in item["bundles"]]
+  assert shipped == ["incident-000001", "incident-000002"]  # zero loss
+
+
+# --- the acceptance drill --------------------------------------------------
+
+
+@pytest.fixture
+def drill_service(tmp_path):
+  """A real service under a one-scene latency fault: FaultyEngine for
+  the injected slowness, SLO tracker on a fake clock (deterministic
+  window edges), tracer for exemplars, tsdb ring + attribution ledger +
+  an un-started incident recorder (drained manually)."""
+  clock = FakeClock()
+  tracker = SloTracker(
+      SloConfig(fast_window_s=10.0, slow_window_s=60.0, bucket_s=1.0,
+                min_requests=5, latency_threshold_s=0.05,
+                quantile=0.99, per_scene=True),
+      clock=clock)
+  engine = FaultyEngine(RenderEngine(use_mesh=False))
+  recorder = incident_mod.IncidentRecorder(
+      incident_mod.IncidentConfig(dir=str(tmp_path / "inc")),
+      clock=FakeClock(), wall=FakeClock(2000.0))
+  holder = {}
+  ring = tsdb_mod.TsdbRecorder(
+      lambda: holder["svc"]._render_metrics_text(), clock=clock)
+  svc = RenderService(engine=engine, resilience=None, max_batch=2,
+                      max_wait_ms=1.0, slo=tracker, tracer=Tracer(),
+                      tsdb=ring, attrib=attrib_mod.AttribConfig(),
+                      incidents=recorder, metrics_ttl_s=0.0)
+  holder["svc"] = svc
+  svc.add_synthetic_scenes(2, height=H, width=W, planes=P)
+  svc.warmup()
+  svc.metrics.reset()
+  yield svc, engine, tracker, recorder, ring, clock
+  svc.close()
+
+
+def test_acceptance_drill_latency_fault_to_black_box(drill_service):
+  svc, engine, tracker, recorder, ring, clock = drill_service
+  # Healthy traffic on scene_000, then a latency fault pinned to
+  # scene_001: every one of its dispatches sleeps past the 50 ms
+  # objective while scene_000 stays fast.
+  for i in range(8):
+    svc.render_traced("scene_000", _pose(0.001 * i), timeout=60)
+  for i in range(6):
+    engine.inject(Fault(kind="slow", seconds=0.08))
+    svc.render_traced("scene_001", _pose(0.001 * i), timeout=60)
+  ring.sample()  # the spike lands in the tsdb window
+  firing = tracker.alerts_firing()
+  assert "latency_p99:scene_001" in firing
+  assert "latency_p99:scene_000" not in firing
+
+  # Every fire edge captured exactly one bundle — no duplicates while
+  # the alerts stay firing.
+  recorder.drain()
+  tracker.alerts_firing()  # re-evaluation: no new edges, no new bundles
+  assert recorder.drain() == 0
+  index = recorder.list()
+  captured = [e["alert"] for e in index]
+  assert sorted(captured) == sorted(set(captured))  # one per alert
+  assert "latency_p99:scene_001" in captured
+
+  entry = next(e for e in index if e["alert"] == "latency_p99:scene_001")
+  bundle = recorder.get(entry["id"])
+  # The bundle is the whole stitch: burn numbers, traces, the tsdb
+  # window spanning the spike, events, and the attribution cells naming
+  # the hot scene.
+  details = bundle["alert"]["details"]
+  assert details["scene"] == "scene_001"
+  assert details["fast_ms"] > 50.0
+  window = bundle["tsdb_window"]
+  assert window["window_s"] == recorder.config.tsdb_window_s
+  assert "mpi_serve_requests_total" in window["families"]
+  assert bundle["slo"]["alerts_firing"]
+  assert {c["scene"] for c in bundle["attrib_top"]} >= {"scene_001"}
+  assert bundle["traces"]["finished"] >= 14
+
+  # The exemplar trace id in the fire details resolves at /debug/traces.
+  exemplar = details["exemplar"]["trace_id"]
+  httpd = make_http_server(svc)
+  port = httpd.server_address[1]
+  threading.Thread(target=httpd.serve_forever, daemon=True).start()
+  try:
+    _, found = _get_json(port, f"/debug/traces?id={exemplar}")
+    assert found["traces"] and found["traces"][0]["trace_id"] == exemplar
+
+    # /debug/incidents serves the ring: index, one bundle, 404s.
+    _, listing = _get_json(port, "/debug/incidents")
+    assert [e["id"] for e in listing["incidents"]] == \
+        [e["id"] for e in index]
+    assert listing["stats"]["captures"] == len(index)
+    _, fetched = _get_json(port, f"/debug/incidents?id={entry['id']}")
+    assert fetched["id"] == entry["id"]
+    with pytest.raises(urllib.error.HTTPError) as err:
+      _get(port, "/debug/incidents?id=incident-999999")
+    assert err.value.code == 404
+
+    # /debug/attrib serves the ledger with the conservation verdict.
+    _, attrib = _get_json(port, "/debug/attrib")
+    assert attrib["conservation"]["ok"] is True
+    assert attrib["totals"]["requests"] == 14
+    _, top1 = _get_json(port, "/debug/attrib?top=1")
+    assert len(top1["cells"]) == 1 and top1["cells_total"] >= 2
+    with pytest.raises(urllib.error.HTTPError) as err:
+      _get(port, "/debug/attrib?top=x")
+    assert err.value.code == 400
+  finally:
+    httpd.shutdown()
+
+  # Dedup holds across a raw re-fire of a still-firing alert; the clear
+  # edge releases it, and the next fire captures a fresh bundle.
+  before = recorder.stats()["captures"]
+  svc._on_slo_alert("latency_p99:scene_001", True, {})
+  assert recorder.drain() == 0
+  assert recorder.stats()["suppressed"] == 1
+  clock.advance(11)  # the fast window drains: clears fire
+  for i in range(6):
+    svc.render_traced("scene_000", _pose(0.001 * i), timeout=60)
+  tracker.alerts_firing()
+  assert recorder.stats()["firing"] == []
+  svc._on_slo_alert("latency_p99:scene_001", True, {})
+  recorder.drain()
+  assert recorder.stats()["captures"] == before + 1
+
+  # /stats carries both blocks; /metrics carries both families.
+  stats = svc.stats()
+  assert stats["attrib"]["conservation"]["ok"] is True
+  assert stats["incidents"]["captures"] == before + 1
+  families = prom.parse_metrics_text(svc._render_metrics_text())
+  assert attrib_mod.PREFIX + "requests_total" in families
+  assert incident_mod.PREFIX + "captures_total" in families
+
+
+def test_attrib_and_incident_endpoints_503_when_disabled():
+  svc = RenderService(use_mesh=False, metrics_ttl_s=0.0)
+  httpd = make_http_server(svc)
+  port = httpd.server_address[1]
+  threading.Thread(target=httpd.serve_forever, daemon=True).start()
+  try:
+    for path in ("/debug/attrib", "/debug/incidents"):
+      with pytest.raises(urllib.error.HTTPError) as err:
+        _get(port, path)
+      assert err.value.code == 503
+    with pytest.raises(RuntimeError, match="attribution disabled"):
+      svc.attrib_snapshot()
+  finally:
+    httpd.shutdown()
+    svc.close()
+
+
+def test_incidents_require_slo():
+  # slo=None disables the alert edges that trigger capture — a recorder
+  # without them would be a black box that never records.
+  with pytest.raises(ValueError, match="incidents require SLO"):
+    RenderService(use_mesh=False, metrics_ttl_s=0.0, slo=None,
+                  incidents=incident_mod.IncidentConfig(dir="/tmp/x"))

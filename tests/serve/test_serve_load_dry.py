@@ -17,6 +17,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 
 def _run_dry(extra_args=()):
   repo = os.path.dirname(os.path.dirname(os.path.dirname(
@@ -37,8 +39,20 @@ def _run_dry(extra_args=()):
   return json.loads(proc.stdout.strip().splitlines()[-1])
 
 
-def test_serve_load_dry_emits_headline_json():
-  out = _run_dry()
+@pytest.fixture(scope="module")
+def traced_dry_run():
+  """ONE ``--trace`` subprocess shared by the headline and trace smokes.
+
+  The trace-enabled run is a strict superset of the plain one — same
+  ``inprocess_run`` arc, same JSON contract, plus the ``trace`` block —
+  and each dry run is a full JAX child-process spawn, the unit of cost
+  in this file. Budget reclamation round 3: two spawns became one.
+  """
+  return _run_dry(["--trace"])
+
+
+def test_serve_load_dry_emits_headline_json(traced_dry_run):
+  out = traced_dry_run
   assert out["metric"] == "serve_load" and out["dry"] is True
   assert out["device"] == "cpu"
   assert out["renders_per_sec"] > 0
@@ -86,13 +100,21 @@ def test_serve_load_dry_emits_headline_json():
   per_scene = slo["per_scene"]
   assert per_scene["scenes"] >= 1
   assert isinstance(per_scene["failing"], list)
+  # The attribution ledger rides every serve_load run: cells name the
+  # dry scenes and the conservation invariant reconciles exactly even
+  # under the closed-loop worker pool.
+  attrib = out["attrib"]
+  assert attrib["cells_total"] >= 1
+  assert attrib["conservation"]["ok"] is True
+  assert attrib["totals"]["requests"] >= out["requests"]
+  assert attrib["top_cells"][0]["scene"].startswith("scene_")
 
 
-def test_serve_load_trace_dry_smoke():
+def test_serve_load_trace_dry_smoke(traced_dry_run):
   """The trace-enabled smoke: closed-loop traffic under a live Tracer
   must finish, and the slowest-exemplar span trees must cover the whole
   request path (the acceptance span set + attempt children)."""
-  out = _run_dry(["--trace"])
+  out = traced_dry_run
   assert out["metric"] == "serve_load" and out["dry"] is True
   assert out["renders_per_sec"] > 0
   trace = out["trace"]
@@ -330,7 +352,7 @@ def test_serve_load_chaos_dry_smoke():
   assert slo["objectives"]["availability"]["requests"] >= out["requests"]
 
 
-def test_serve_load_overload_ab_dry_smoke():
+def test_serve_load_overload_ab_dry_smoke(tmp_path):
   """The brownout A/B's tier-1 smoke: one process, a ~3x phased
   overload ramp driven twice — ladder armed, then shed-only — and one
   JSON line. Dry scale pins MECHANICS only (same contract as the --ab
@@ -339,8 +361,16 @@ def test_serve_load_overload_ab_dry_smoke():
   never shed below L4, neither arm 5xxs, and the JSON carries the full
   acceptance shape. The performance verdict — brownout buys
   interactive goodput and holds the SLO that shed-only violates —
-  belongs to real sizes (`--overload-ab --duration 10`, BENCH-style)."""
-  out = _run_dry(["--overload-ab"])
+  belongs to real sizes (`--overload-ab --duration 10`, BENCH-style).
+
+  With --incident-dir this smoke also rides the incident-lens arc
+  (PR 18): both arms carry an attribution block whose conservation
+  invariant holds through real multithreaded load, the per-class
+  device-seconds split is computed, and the deterministic incident
+  drill captures exactly the induced bundle end-to-end — alert edge ->
+  black-box file on disk — without a second subprocess."""
+  out = _run_dry(["--overload-ab", "--incident-dir",
+                  str(tmp_path / "bb")])
   assert out["metric"] == "serve_load_overload_ab" and out["dry"] is True
   assert out["latency_threshold_ms"] > 0  # calibrated, not hardcoded
   brownout, shed_only = out["brownout"], out["shed_only"]
@@ -365,3 +395,25 @@ def test_serve_load_overload_ab_dry_smoke():
   assert brownout["returned_to_l0"] is True and out["returned_to_l0"]
   assert shed_only["max_level"] == 0  # the arm really ran unarmed
   assert brownout["interactive_p99_ms"] > 0
+  # Attribution rode both arms: the ledger reconciled exactly against
+  # the phase/request totals under concurrent load, and the cells name
+  # real scenes (hottest first).
+  for arm in (brownout, shed_only):
+    attrib = arm["attrib"]
+    assert attrib["conservation"]["ok"] is True
+    assert attrib["cells_total"] >= 1
+    assert attrib["top_cells"][0]["scene"].startswith("scene_")
+    assert set(arm["device_seconds_by_class"]) == {
+        "interactive", "prefetch", "background"}
+    # The recorder ran in both arms even if dry scale fired no natural
+    # alert; every capture it did make is indexed on disk.
+    assert arm["incidents"]["captures"] == len(arm["incidents"]["index"])
+  # The drill is the deterministic end-to-end pin: an induced latency
+  # alert produced exactly one self-contained bundle.
+  drill = out["incident_drill"]
+  assert drill["captures"] >= 1
+  assert drill["alert"]
+  assert drill["attrib_cells"] >= 1
+  assert drill["conservation_ok"] is True
+  bundles = list((tmp_path / "bb" / "drill").glob("incident-*.json"))
+  assert len(bundles) >= 1

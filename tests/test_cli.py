@@ -165,6 +165,44 @@ def test_ship_knobs_without_url_rejected():
     cli.main(["serve", "--ship-interval-s", "5", "--duration", "0.1"])
 
 
+def test_attrib_knobs_without_attrib_rejected():
+  """The scene cap only shapes a ledger that exists."""
+  with pytest.raises(SystemExit, match="--attrib-scenes requires --attrib"):
+    cli.main(["serve", "--attrib-scenes", "16", "--duration", "0.1"])
+  with pytest.raises(SystemExit, match="--attrib-scenes must be >= 1"):
+    cli.main(["serve", "--attrib", "--attrib-scenes", "0",
+              "--duration", "0.1"])
+
+
+def test_incident_knobs_without_dir_rejected():
+  """Recorder knobs only act with a bundle directory; dangling they'd
+  silently record nothing."""
+  for flag, value in (("--incident-keep", "4"),
+                      ("--incident-window-s", "60"),
+                      ("--incident-top-cells", "4"),
+                      ("--incident-profile", "0.5")):
+    with pytest.raises(SystemExit,
+                       match=r"require\(s\) --incident-dir"):
+      cli.main(["serve", flag, value, "--duration", "0.1"])
+
+
+def test_incident_dir_without_slo_rejected(tmp_path):
+  """Captures trigger off SLO alert edges; without the tracker the
+  black box would never write a bundle."""
+  with pytest.raises(SystemExit, match="--incident-dir requires SLO"):
+    cli.main(["serve", "--no-slo", "--incident-dir", str(tmp_path),
+              "--duration", "0.1"])
+
+
+def test_incident_profile_without_profile_dir_rejected(tmp_path):
+  """The in-bundle profiler capture reuses the serve profiler; it needs
+  somewhere to write traces."""
+  with pytest.raises(SystemExit,
+                     match="--incident-profile requires --profile-dir"):
+    cli.main(["serve", "--incident-dir", str(tmp_path),
+              "--incident-profile", "0.5", "--duration", "0.1"])
+
+
 def test_cluster_rolling_restart_requires_a_local_pool():
   """--join fronts backends some OTHER supervisor owns; a rolling
   restart needs process control. (--supervise on --join is legal now:

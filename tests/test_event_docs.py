@@ -1,0 +1,76 @@
+"""Event-kind drift self-check: README's event table vs reality.
+
+Every literal kind any `events.emit("...")` call site in the package
+can produce must have a row in README's event-kinds table (the block
+between the `<!-- event-kinds -->` markers), and vice versa: a kind
+documented there that no call site emits is a doc for an event that
+does not exist. Either direction failing means the event reference
+rotted silently — the same tier-1 pin as `test_metric_docs.py`, for
+the other operator-facing vocabulary.
+
+Only the first (kind) column counts: the payload column is full of
+backticked FIELD names (`backend`, `old`, `step`) that are not kinds.
+Kinds built dynamically (none today) would need a literal mention in
+source or an explicit allowlist here — by design, so "grep the repo
+for the kind you saw in /debug/events" always lands on the emitter.
+"""
+
+import pathlib
+import re
+
+ROOT = pathlib.Path(__file__).parent.parent
+README = ROOT / "README.md"
+PACKAGE = ROOT / "mpi_vision_tpu"
+
+_EMIT = re.compile(r'\.emit\(\s*"([a-z_]+)"')
+_SECTION = re.compile(r"<!-- event-kinds -->(.*?)<!-- /event-kinds -->",
+                      re.DOTALL)
+_KIND = re.compile(r"`([a-z_]+)`")
+
+
+def _emitted_kinds() -> set[str]:
+  kinds: set[str] = set()
+  for path in sorted(PACKAGE.rglob("*.py")):
+    kinds.update(_EMIT.findall(path.read_text()))
+  return kinds
+
+
+def _documented_kinds() -> set[str]:
+  section = _SECTION.search(README.read_text())
+  assert section, "README lost its <!-- event-kinds --> table markers"
+  kinds: set[str] = set()
+  for line in section.group(1).splitlines():
+    if not line.startswith("|"):
+      continue
+    cells = line.split("|")
+    first = cells[1] if len(cells) > 1 else ""
+    if "---" in first or first.strip() == "kind":
+      continue
+    kinds.update(_KIND.findall(first))
+  return kinds
+
+
+def test_every_emitted_kind_is_documented():
+  missing = _emitted_kinds() - _documented_kinds()
+  assert not missing, (
+      "event kinds emitted in source but absent from README's "
+      f"event-kinds table: {sorted(missing)}")
+
+
+def test_every_documented_kind_is_emitted():
+  phantom = _documented_kinds() - _emitted_kinds()
+  assert not phantom, (
+      "README documents event kinds no call site emits "
+      f"(doc rot or a typo): {sorted(phantom)}")
+
+
+def test_scans_actually_find_kinds():
+  # Both scans must really extract names — an empty-vs-empty pass would
+  # be meaningless — and the doc scan must not leak payload fields.
+  emitted = _emitted_kinds()
+  assert "slo_alert" in emitted and "incident_captured" in emitted
+  assert len(emitted) > 25
+  documented = _documented_kinds()
+  assert "breaker" in documented
+  # Payload-column fields must not count as kinds.
+  assert "backend" not in documented and "old" not in documented

@@ -19,6 +19,8 @@ are deliberately NOT counted as family names.
 import pathlib
 import re
 
+from mpi_vision_tpu.obs import attrib as attrib_mod
+from mpi_vision_tpu.obs import incident as incident_mod
 from mpi_vision_tpu.obs import prom
 from mpi_vision_tpu.obs import ship as ship_mod
 from mpi_vision_tpu.obs import tsdb as tsdb_mod
@@ -64,7 +66,9 @@ def _cluster_families() -> set[str]:
 def _obs_families() -> set[str]:
   # The flight-recorder families are always exposed (zeros while off).
   return ({metric.name for metric in tsdb_mod.registry(None)._metrics}
-          | {metric.name for metric in ship_mod.registry(None)._metrics})
+          | {metric.name for metric in ship_mod.registry(None)._metrics}
+          | {metric.name for metric in attrib_mod.registry(None)._metrics}
+          | {metric.name for metric in incident_mod.registry(None)._metrics})
 
 
 def _train_families() -> set[str]:

@@ -1302,6 +1302,86 @@ def cmd_cluster(args: argparse.Namespace) -> dict:
     if args.restart_hook_timeout_s <= 0:
       raise SystemExit(f"--restart-hook-timeout-s must be > 0, "
                        f"got {args.restart_hook_timeout_s}")
+  autoscale_knobs = [flag for flag, on in (
+      ("--autoscale-min", args.autoscale_min is not None),
+      ("--autoscale-max", args.autoscale_max is not None),
+      ("--autoscale-up-sustain-s", args.autoscale_up_sustain_s is not None),
+      ("--autoscale-down-sustain-s",
+       args.autoscale_down_sustain_s is not None),
+      ("--autoscale-up-cooldown-s",
+       args.autoscale_up_cooldown_s is not None),
+      ("--autoscale-down-cooldown-s",
+       args.autoscale_down_cooldown_s is not None),
+      ("--autoscale-queue-high", args.autoscale_queue_high is not None),
+      ("--autoscale-burn-high", args.autoscale_burn_high is not None),
+      ("--autoscale-util-low", args.autoscale_util_low is not None),
+      ("--autoscale-budget", args.autoscale_budget is not None),
+      ("--autoscale-budget-window-s",
+       args.autoscale_budget_window_s is not None),
+      ("--autoscale-drain-s", args.autoscale_drain_s is not None),
+      ("--autoscale-interval-s", args.autoscale_interval_s is not None),
+  ) if on]
+  if autoscale_knobs and not args.autoscale:
+    raise SystemExit(
+        f"{', '.join(autoscale_knobs)} require(s) --autoscale")
+  if args.autoscale and not args.supervise:
+    raise SystemExit(
+        "--autoscale requires --supervise (only the lease-holding "
+        "supervisor may scale the fleet)")
+  if args.provision_hook is not None:
+    if not args.autoscale:
+      raise SystemExit("--provision-hook requires --autoscale (it is "
+                       "only invoked by the autoscaler's spawn path)")
+    if args.backends:
+      raise SystemExit(
+          "--provision-hook requires --join (a local pool spawns its "
+          "own children; the hook is for fleets this process cannot)")
+  if args.autoscale and not args.backends and args.provision_hook is None:
+    raise SystemExit(
+        "--autoscale with --join requires --provision-hook (this "
+        "process has no way to spawn remote capacity)")
+  if args.autoscale_interval_s is not None and args.autoscale_interval_s <= 0:
+    raise SystemExit(f"--autoscale-interval-s must be > 0, "
+                     f"got {args.autoscale_interval_s}")
+  if args.autoscale_drain_s is not None and args.autoscale_drain_s < 0:
+    raise SystemExit(f"--autoscale-drain-s must be >= 0, "
+                     f"got {args.autoscale_drain_s}")
+  autoscale_config = None
+  if args.autoscale:
+    from mpi_vision_tpu.serve.cluster import AutoscaleConfig
+
+    kw = {}
+    if args.autoscale_min is not None:
+      kw["min_backends"] = args.autoscale_min
+    if args.autoscale_max is not None:
+      kw["max_backends"] = args.autoscale_max
+    if args.autoscale_up_sustain_s is not None:
+      kw["up_sustain_s"] = args.autoscale_up_sustain_s
+    if args.autoscale_down_sustain_s is not None:
+      kw["down_sustain_s"] = args.autoscale_down_sustain_s
+    if args.autoscale_up_cooldown_s is not None:
+      kw["up_cooldown_s"] = args.autoscale_up_cooldown_s
+    if args.autoscale_down_cooldown_s is not None:
+      kw["down_cooldown_s"] = args.autoscale_down_cooldown_s
+    if args.autoscale_queue_high is not None:
+      # Recover thresholds keep the default trip:recover ratio so one
+      # knob moves the whole hysteresis band.
+      kw["queue_high"] = args.autoscale_queue_high
+      kw["queue_recover"] = args.autoscale_queue_high * 0.25
+    if args.autoscale_burn_high is not None:
+      kw["burn_high"] = args.autoscale_burn_high
+      kw["burn_recover"] = args.autoscale_burn_high * 0.5
+    if args.autoscale_util_low is not None:
+      kw["util_low"] = args.autoscale_util_low
+      kw["util_recover"] = max(0.35, args.autoscale_util_low * 7.0 / 3.0)
+    if args.autoscale_budget is not None:
+      kw["budget"] = args.autoscale_budget
+    if args.autoscale_budget_window_s is not None:
+      kw["budget_window_s"] = args.autoscale_budget_window_s
+    try:
+      autoscale_config = AutoscaleConfig(**kw)
+    except ValueError as e:
+      raise SystemExit(f"bad autoscale config: {e}") from None
   if args.lease_dir is not None and not args.supervise:
     raise SystemExit("--lease-dir requires --supervise (the lease "
                      "elects which router replica supervises)")
@@ -1348,6 +1428,7 @@ def cmd_cluster(args: argparse.Namespace) -> dict:
 
   pool = None
   supervisor = None
+  autoscaler = None
   try:
     if args.backends:
       extra = []
@@ -1391,6 +1472,38 @@ def cmd_cluster(args: argparse.Namespace) -> dict:
                               if args.route_rot_bucket_deg is not None
                               else 10.0),
         metrics_ttl_s=args.metrics_ttl_ms / 1e3, tracer=tracer)
+    incidents = None
+    if args.incident_dir:
+      from mpi_vision_tpu.obs import incident as incident_lib
+
+      try:
+        inc_cfg = incident_lib.IncidentConfig(dir=args.incident_dir)
+      except ValueError as e:
+        raise SystemExit(f"bad incident config: {e}") from None
+
+      def _collect_fleet(job):  # noqa: ARG001 - collector signature
+        out = {"router": router.metrics.snapshot(),
+               "events": router.events.snapshot(recent=64)}
+        if supervisor is not None:
+          out["supervisor"] = supervisor.snapshot()
+        return out
+
+      incidents = incident_lib.IncidentRecorder(
+          inc_cfg, collect=_collect_fleet).start()
+      router.set_incidents(incidents)
+      # Tee the lifecycle tap into the event log's sink: quarantines,
+      # crash loops, gossip peer deaths, and autoscale decisions each
+      # capture one black-box bundle into /debug/incidents.
+      tap = incident_lib.LifecycleIncidentTap(incidents)
+      prev_sink = router.events.sink
+      if prev_sink is None:
+        router.events.sink = tap
+      else:
+        def _tee(line, _prev=prev_sink, _tap=tap):
+          _prev(line)
+          _tap(line)
+        router.events.sink = _tee
+      _log(f"cluster: lifecycle incident capture -> {args.incident_dir}")
     node_id = (args.node_id if args.node_id is not None
                else f"router-{os.getpid()}")
     lease_ttl_s = (args.lease_ttl_s if args.lease_ttl_s is not None
@@ -1427,12 +1540,41 @@ def cmd_cluster(args: argparse.Namespace) -> dict:
                           if args.restart_hook_timeout_s is not None
                           else 30.0),
           log=_log)
+      autoscaler = None
+      if args.autoscale:
+        import shlex
+
+        from mpi_vision_tpu.serve.cluster import (
+            AutoscalePolicy,
+            Autoscaler,
+        )
+
+        autoscaler = Autoscaler(
+            AutoscalePolicy(autoscale_config),
+            sup_pool, router, gossip=gossip_state,
+            events=router.events,
+            provision_hook=(shlex.split(args.provision_hook)
+                            if args.provision_hook else None),
+            scenes=(pool.scene_ids() if pool is not None else ()),
+            eval_interval_s=(args.autoscale_interval_s
+                             if args.autoscale_interval_s is not None
+                             else 1.0),
+            drain_s=(args.autoscale_drain_s
+                     if args.autoscale_drain_s is not None else 0.5),
+            log=_log)
+        _log("cluster: autoscaler armed "
+             f"[{autoscale_config.min_backends}.."
+             f"{autoscale_config.max_backends} backends, "
+             f"budget {autoscale_config.budget}/"
+             f"{autoscale_config.budget_window_s:g}s"
+             + (", provision hook" if args.provision_hook else "")
+             + "]")
       supervisor = FleetSupervisor(
           sup_pool, router=router, events=router.events,
           probe_s=args.probe_s, wedge_after=args.wedge_after,
           restart_budget=args.restart_budget,
           budget_window_s=args.restart_window_s, log=_log,
-          lease=lease, gossip=gossip_state)
+          lease=lease, gossip=gossip_state, autoscaler=autoscaler)
       supervisor.start()
       _log(f"cluster: supervisor on (probe every {args.probe_s:g}s, "
            f"budget {args.restart_budget} restarts / "
@@ -1493,6 +1635,8 @@ def cmd_cluster(args: argparse.Namespace) -> dict:
         gossip_node.stop()
       httpd.shutdown()
       router.close()
+      if incidents is not None:
+        incidents.stop()
       for sig, handler in previous_handlers.items():
         signal.signal(sig, handler)
       _log("cluster: router closed")
@@ -1508,10 +1652,14 @@ def cmd_cluster(args: argparse.Namespace) -> dict:
         "router": snap,
         **({"supervisor": supervisor.snapshot()}
            if supervisor is not None else {}),
+        **({"autoscale": autoscaler.snapshot()}
+           if autoscaler is not None else {}),
         **({"gossip": gossip_node.snapshot()}
            if gossip_node is not None else {}),
         **({"rolling_restart": rolling_report}
            if rolling_report is not None else {}),
+        **({"incidents": incidents.stats()}
+           if incidents is not None else {}),
         **({"traces": tracer.finished} if tracer is not None else {}),
     }
   finally:
@@ -2175,6 +2323,67 @@ def build_parser() -> argparse.ArgumentParser:
                  help="kill the restart hook after this long (default "
                       "30; a real respawn behind the webhook can be "
                       "slow — size this to it); requires --restart-hook")
+  c.add_argument("--autoscale", action="store_true",
+                 help="elastic fleet: the lease-holding supervisor "
+                      "grows the pool on sustained SLO fast-burn / "
+                      "queue pressure / nonzero brownout level and "
+                      "shrinks it on sustained low utilization; new "
+                      "backends are warmed (manifest diff or render "
+                      "warm) BEFORE the ring admits them and victims "
+                      "retire drainlessly (eject -> drain -> SIGTERM); "
+                      "requires --supervise")
+  c.add_argument("--autoscale-min", type=int, default=None,
+                 help="pool floor the autoscaler never shrinks below "
+                      "(default 1)")
+  c.add_argument("--autoscale-max", type=int, default=None,
+                 help="pool ceiling the autoscaler never grows past "
+                      "(default 4)")
+  c.add_argument("--autoscale-up-sustain-s", type=float, default=None,
+                 help="seconds a scale-up trigger must hold before "
+                      "acting (default 2)")
+  c.add_argument("--autoscale-down-sustain-s", type=float, default=None,
+                 help="seconds of low utilization before a scale-down "
+                      "(default 20)")
+  c.add_argument("--autoscale-up-cooldown-s", type=float, default=None,
+                 help="minimum seconds after any scale action before "
+                      "the next scale-up (default 10)")
+  c.add_argument("--autoscale-down-cooldown-s", type=float, default=None,
+                 help="minimum seconds after any scale action before "
+                      "the next scale-down (default 30)")
+  c.add_argument("--autoscale-queue-high", type=float, default=None,
+                 help="mean backend queue depth that trips scale-up "
+                      "(default 8; the recover threshold scales with "
+                      "it to keep the hysteresis band)")
+  c.add_argument("--autoscale-burn-high", type=float, default=None,
+                 help="worst SLO fast-burn rate that trips scale-up "
+                      "(default 2.0; recover threshold scales with it)")
+  c.add_argument("--autoscale-util-low", type=float, default=None,
+                 help="fleet busy-fraction at or below which idle time "
+                      "accumulates toward scale-down (default 0.15)")
+  c.add_argument("--autoscale-budget", type=int, default=None,
+                 help="scale actions allowed per "
+                      "--autoscale-budget-window-s (RestartBudget "
+                      "semantics; default 4) — a flapping signal "
+                      "cannot thrash the ring")
+  c.add_argument("--autoscale-budget-window-s", type=float, default=None,
+                 help="the scaling-budget window (default 300)")
+  c.add_argument("--autoscale-drain-s", type=float, default=None,
+                 help="scale-down drain pause between eject and "
+                      "SIGTERM (default 0.5)")
+  c.add_argument("--autoscale-interval-s", type=float, default=None,
+                 help="minimum seconds between autoscale signal "
+                      "evaluations (default 1.0)")
+  c.add_argument("--provision-hook", default=None,
+                 help="command (shlex argv; new backend id appended) "
+                      "the autoscaler runs to provision capacity for a "
+                      "--join fleet; must print the new backend's "
+                      "host:port on stdout; requires --autoscale")
+  c.add_argument("--incident-dir", default=None,
+                 help="router-side black-box bundles: fleet-lifecycle "
+                      "edges (quarantine, crash loop, gossip peer "
+                      "death, autoscale decisions) each capture one "
+                      "deduped incident bundle here, served at "
+                      "/debug/incidents")
   c.add_argument("--probe-s", type=float, default=1.0,
                  help="supervisor health-probe period")
   c.add_argument("--wedge-after", type=int, default=3,

@@ -345,3 +345,78 @@ def registry(stats: dict | None) -> prom.Registry:
   reg.gauge(p + "bundle_bytes", "Bytes of bundles resident on disk.",
             stats.get("bundle_bytes", 0))
   return reg
+
+
+class LifecycleIncidentTap:
+  """Turn fleet-lifecycle EVENTS into incident fire/clear edges.
+
+  The SLO engine owns alert edges on the request path; fleet-lifecycle
+  incidents (a quarantine, a crash loop, a gossip peer death, an
+  autoscale action) surface only in the event stream. This tap is an
+  ``EventLog`` sink (tee it next to ``file_sink``): each JSON line is
+  parsed and mapped onto ``IncidentRecorder.note_alert`` episodes, so
+  the `/debug/incidents` ring captures ONE black-box bundle per
+  lifecycle episode with the recorder's existing dedup latch:
+
+    * ``backend_quarantined`` fires ``quarantine:{backend}`` (and
+      closes any crash-loop episode — the quarantine verdict subsumes
+      it); ``backend_readmit`` clears both.
+    * ``backend_restart`` with ``attempt >= 2`` fires
+      ``crash_loop:{backend}`` (the first restart of an episode is
+      routine; the second consecutive one is a loop); a successful
+      first-attempt restart clears it.
+    * ``gossip_peer_failure`` fires ``gossip_peer:{peer}``;
+      ``gossip_peer_recovered`` clears it.
+    * ``autoscale_{up,down,abort}`` are point-in-time decisions, not
+      conditions: each fires AND immediately clears a key unique per
+      event (the log's own seq), so every decision captures exactly
+      one bundle and can never latch.
+
+  Parse or mapping failures are counted, never raised — a sink that
+  throws would take the event log down with it.
+  """
+
+  def __init__(self, recorder: IncidentRecorder):
+    self.recorder = recorder
+    self.taps = 0
+    self.errors = 0
+
+  def __call__(self, line: str) -> None:
+    self.sink(line)
+
+  def sink(self, line: str) -> None:
+    try:
+      record = json.loads(line)
+      self.note_event(record)
+    except Exception:  # noqa: BLE001 - sinks must never throw upward
+      self.errors += 1
+
+  def note_event(self, record: dict) -> None:
+    kind = record.get("kind")
+    note = self.recorder.note_alert
+    if kind == "backend_quarantined":
+      backend = record.get("backend")
+      note(f"crash_loop:{backend}", firing=False)
+      note(f"quarantine:{backend}", firing=True, details=record)
+    elif kind == "backend_readmit":
+      backend = record.get("backend")
+      note(f"quarantine:{backend}", firing=False)
+      note(f"crash_loop:{backend}", firing=False)
+    elif kind == "backend_restart" and record.get("ok"):
+      backend = record.get("backend")
+      if (record.get("attempt") or 0) >= 2:
+        note(f"crash_loop:{backend}", firing=True, details=record)
+      else:
+        note(f"crash_loop:{backend}", firing=False)
+    elif kind == "gossip_peer_failure":
+      note(f"gossip_peer:{record.get('peer')}", firing=True,
+           details=record)
+    elif kind == "gossip_peer_recovered":
+      note(f"gossip_peer:{record.get('peer')}", firing=False)
+    elif kind in ("autoscale_up", "autoscale_down", "autoscale_abort"):
+      name = f"{kind}:{record.get('seq')}"
+      note(name, firing=True, details=record)
+      note(name, firing=False)
+    else:
+      return
+    self.taps += 1

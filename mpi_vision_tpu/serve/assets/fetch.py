@@ -306,3 +306,86 @@ class SceneSyncWatcher(PollWatcher):
         "last_error": self.last_error,
         "last_sweep": self.last_sweep,
     }
+
+
+def warm_backend(address: str, scenes, *, donors=(), transport=None,
+                 timeout_s: float = 30.0, clock=time.monotonic,
+                 sleep=time.sleep, poll_s: float = 0.25) -> dict:
+  """Pre-admit warming: block until ``address`` can serve ``scenes``.
+
+  The autoscaler's gate between *spawned* and *routed* (the FastNeRF
+  lesson: un-warmed capacity tanks p99 worse than no capacity). Per
+  scene, two probes race a shared deadline:
+
+    * **manifest diff** — the new backend's ``/scene/{id}/manifest``
+      ``scene_digest`` equals a donor's (the first already-admitted
+      backend that answers): the tile store is converged, the cheap
+      verdict.
+    * **render warm** — a real identity-pose ``/render`` returns 200:
+      the scene is resident and servable even where manifests are
+      unavailable or still syncing; the render itself primes the
+      backend's bake/crop caches for exactly the keys the ring will
+      route to it.
+
+  Both probes declare themselves background traffic, so a browned-out
+  fleet sheds warming before a single interactive render. Returns
+  ``{"ok", "warmed", "failed", "modes", "elapsed_s"}`` and never
+  raises — an un-warmable backend is the CALLER's abort decision.
+  ``transport`` is router-style (``request(method, url, ...)``); the
+  default is the cluster tier's ``HttpTransport``.
+  """
+  if transport is None:
+    from mpi_vision_tpu.serve.cluster.router import HttpTransport
+
+    transport = HttpTransport()
+  headers = {brownout_mod.REQUEST_CLASS_HEADER: "background"}
+  pose = [[1.0, 0.0, 0.0, 0.0], [0.0, 1.0, 0.0, 0.0],
+          [0.0, 0.0, 1.0, 0.0], [0.0, 0.0, 0.0, 1.0]]
+  start = clock()
+  deadline = start + timeout_s
+
+  def _manifest_digest(host: str, quoted: str) -> str | None:
+    try:
+      status, _, body = transport.request(
+          "GET", f"http://{host}/scene/{quoted}/manifest",
+          headers=headers, timeout=min(timeout_s, 5.0))
+      if status != 200:
+        return None
+      payload = json.loads(body)
+    except (ConnectionError, ValueError, UnicodeDecodeError):
+      return None
+    digest = payload.get("scene_digest") if isinstance(payload, dict) \
+        else None
+    return digest if isinstance(digest, str) else None
+
+  warmed: list[str] = []
+  modes: dict[str, str] = {}
+  for scene_id in scenes:
+    quoted = urllib.parse.quote(str(scene_id), safe="")
+    want = None
+    for donor in donors:
+      want = _manifest_digest(donor, quoted)
+      if want is not None:
+        break
+    body = json.dumps({"scene_id": str(scene_id),
+                       "pose": pose}).encode()
+    while clock() < deadline:
+      if want is not None and _manifest_digest(address, quoted) == want:
+        warmed.append(str(scene_id))
+        modes[str(scene_id)] = "manifest"
+        break
+      try:
+        status, _, _ = transport.request(
+            "POST", f"http://{address}/render", body=body,
+            headers={**headers, "Content-Type": "application/json"},
+            timeout=min(timeout_s, 10.0))
+      except ConnectionError:
+        status = None
+      if status == 200:
+        warmed.append(str(scene_id))
+        modes[str(scene_id)] = "render"
+        break
+      sleep(min(poll_s, max(0.0, deadline - clock())))
+  failed = [str(s) for s in scenes if str(s) not in modes]
+  return {"ok": not failed, "warmed": warmed, "failed": failed,
+          "modes": modes, "elapsed_s": round(clock() - start, 3)}

@@ -296,3 +296,26 @@ class BrownoutController:
     with self._lock:
       self.transitions_down = 0
       self.transitions_up = 0
+
+
+def fleet_scale_signal(summary: dict | None) -> dict:
+  """Distill the router's fleet brownout summary into the autoscaler's
+  scale-up signal (``serve/cluster/autoscale.py`` consumes this).
+
+  Brownout is the bridge while capacity spawns: any backend riding a
+  nonzero ladder level is already paying for overload with quality, so
+  a fleet-wide nonzero ``max_level`` is a scale-up trigger on its own —
+  the autoscaler's new capacity is what lets the ladder descend back to
+  L0 instead of camping in degraded service. Tolerates a missing or
+  partial summary (backends without the controller contribute nothing).
+  """
+  summary = summary or {}
+  levels = summary.get("levels") or {}
+  max_level = summary.get("max_level")
+  if max_level is None:
+    max_level = max(levels.values(), default=0)
+  return {
+      "max_level": int(max_level),
+      "backends_browned": len(levels),
+      "backends_enabled": int(summary.get("backends_enabled") or 0),
+  }

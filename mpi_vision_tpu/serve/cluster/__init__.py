@@ -13,13 +13,23 @@ budgeted restarts with crash-loop quarantine, and rolling restarts under
 live traffic; ``gossip`` + ``lease`` replicate the control plane itself —
 N peered routers exchange versioned health/quarantine observations over
 ``/gossip`` and exactly one holds the supervision lease at a time, with
-takeover adopting the dead leader's budget state (the router-HA tier).
+takeover adopting the dead leader's budget state (the router-HA
+tier); ``autoscale`` closes the elastic-fleet loop — the
+lease-holding supervisor grows the pool on sustained SLO burn /
+queue pressure / brownout and shrinks it on sustained idleness,
+warming every new backend's ring assignment before it takes
+traffic and retiring victims drainlessly.
 Live checkpoint reload
 rides the backends themselves (``serve --ckpt --reload-ckpt-s N``,
 ``ckpt.watch.CheckpointWatcher``) — the router needs no coordination to
 benefit: scenes swap in place under the same ids.
 """
 
+from mpi_vision_tpu.serve.cluster.autoscale import (
+    AutoscaleConfig,
+    AutoscalePolicy,
+    Autoscaler,
+)
 from mpi_vision_tpu.serve.cluster.gossip import GossipNode, GossipState
 from mpi_vision_tpu.serve.cluster.lease import (
     FileLease,
@@ -47,6 +57,9 @@ from mpi_vision_tpu.serve.cluster.supervisor import FleetSupervisor
 
 __all__ = [
     "AllReplicasOpenError",
+    "AutoscaleConfig",
+    "AutoscalePolicy",
+    "Autoscaler",
     "BackendPool",
     "BackendSpawnError",
     "FileLease",

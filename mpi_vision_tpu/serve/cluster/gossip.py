@@ -294,7 +294,11 @@ class GossipNode:
         if status != 200:
           raise ConnectionError(f"/gossip returned http {status}")
         self.receive(json.loads(reply))
-        self._note_peer(peer, ok=True)
+        recovered = self._note_peer(peer, ok=True)
+        if recovered and self._events is not None:
+          # The fire/clear pair incident capture latches on: a peer
+          # death fires an episode, this edge closes it.
+          self._events.emit("gossip_peer_recovered", peer=peer)
         results[peer] = "ok"
       except Exception as e:  # noqa: BLE001 - a dead peer is routine
         self._note_peer(peer, ok=False, error=repr(e))
@@ -306,12 +310,16 @@ class GossipNode:
         results[peer] = repr(e)
     return results
 
-  def _note_peer(self, peer: str, ok: bool, error: str | None = None):
+  def _note_peer(self, peer: str, ok: bool,
+                 error: str | None = None) -> bool:
+    """Update the peer table; True = this success ended a failure run
+    (the ``gossip_peer_recovered`` edge)."""
     with self._lock:
       entry = self._peer_table.setdefault(
           peer, {"ok": None, "last_success_unix_s": None,
                  "last_failure_unix_s": None, "failures": 0,
                  "last_error": None})
+      recovered = ok and entry["ok"] is False
       entry["ok"] = ok
       if ok:
         entry["last_success_unix_s"] = self._clock()
@@ -320,6 +328,7 @@ class GossipNode:
         entry["last_failure_unix_s"] = self._clock()
         entry["failures"] += 1
         entry["last_error"] = error
+      return recovered
 
   def snapshot(self) -> dict:
     with self._lock:

@@ -251,6 +251,72 @@ class BackendPool:
     self._log(f"pool: {backend_id} resurrected on {self.host}:{proc.port}")
     return f"{self.host}:{proc.port}"
 
+  # -- elastic sizing (the autoscaler's primitives) -----------------------
+
+  def spawn_backend(self, backend_id: str | None = None) -> tuple[str, str]:
+    """Grow the pool by ONE backend on a fresh ephemeral port and gate
+    it healthy (the autoscaler's scale-up primitive; ``start()`` sizes
+    only the initial pool). Returns ``(backend_id, address)``.
+
+    Registers before gating, like ``start()``/``restart()``, so
+    ``close()`` can always sweep the child. A failed gate reaps the
+    corpse and unregisters it — a failed grow leaves the pool exactly
+    as it was (the caller's scale-up aborts, nothing is stranded).
+    """
+    if self._closed:
+      raise RuntimeError("pool is closed; not spawning a new backend")
+    if backend_id is None:
+      i = 0
+      while f"b{i}" in self._procs:
+        i += 1
+      backend_id = f"b{i}"
+    existing = self._procs.get(backend_id)
+    if existing is not None and existing.popen.poll() is None:
+      raise ValueError(f"{backend_id} is already running")
+    backend_id, popen, port_file, log_path = self._spawn(backend_id)
+    proc = _Proc(backend_id, popen, 0, log_path)
+    self._procs[backend_id] = proc
+    if self._closed:  # close() raced the spawn (restart()'s idiom)
+      popen.terminate()
+      try:
+        popen.wait(10)
+      except subprocess.TimeoutExpired:
+        popen.kill()
+        popen.wait(10)
+      self._procs.pop(backend_id, None)
+      raise RuntimeError(f"pool closed during spawn of {backend_id}")
+    try:
+      proc.port = self._await_port(backend_id, popen, port_file)
+      self._await_healthy(proc)
+    except BackendSpawnError:
+      if popen.poll() is None:
+        popen.kill()
+        try:
+          popen.wait(10)
+        except subprocess.TimeoutExpired:
+          pass
+      self._procs.pop(backend_id, None)
+      raise
+    self._log(f"pool: {backend_id} grown onto {self.host}:{proc.port}")
+    return backend_id, f"{self.host}:{proc.port}"
+
+  def retire(self, backend_id: str) -> None:
+    """Remove a backend from the pool for good (scale-down): SIGTERM if
+    still alive (the serve CLI drains in-flight requests), wait, and
+    forget the record. Idempotent — retiring an unknown or already-dead
+    backend is a no-op, never an error."""
+    proc = self._procs.pop(str(backend_id), None)
+    if proc is None:
+      return
+    if proc.popen.poll() is None:
+      proc.popen.terminate()
+      try:
+        proc.popen.wait(30)
+      except subprocess.TimeoutExpired:
+        proc.popen.kill()
+        proc.popen.wait(10)
+    self._log(f"pool: {backend_id} retired")
+
   # -- teardown / forensics ----------------------------------------------
 
   def tail_log(self, backend_id: str, n: int = 2000) -> str:
@@ -369,6 +435,21 @@ class RemoteBackendPool:
           f"restart hook {argv[0]!r} exited {rc} for {backend_id}")
     self._log(f"remote pool: restart hook ok for {backend_id}")
     return address
+
+  def add_address(self, backend_id: str, address: str) -> None:
+    """Register a backend some provisioner just created (the
+    autoscaler's ``--provision-hook`` hands the new address here so the
+    next probe pass supervises it like any other member)."""
+    self._backends[str(backend_id)] = str(address)
+    self._log(f"remote pool: registered {backend_id} at {address}")
+
+  def retire(self, backend_id: str) -> None:
+    """Forget a backend (scale-down on a joined fleet): the remote
+    process belongs to its owner — only the membership entry goes.
+    Idempotent, like ``BackendPool.retire``."""
+    if self._backends.pop(str(backend_id), None) is not None:
+      self._log(f"remote pool: {backend_id} retired (process left to "
+                "its owner)")
 
   def snapshot(self) -> dict:
     return {

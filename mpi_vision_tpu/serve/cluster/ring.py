@@ -126,6 +126,37 @@ class HashRing:
           break
     return out
 
+  def resize(self, add=(), remove=(), keys=()) -> dict:
+    """Apply a membership change and return the placement diff for
+    ``keys`` — the minimal-movement receipt the autoscaler audits.
+
+    Adds land before removes (a simultaneous swap keeps every key
+    servable throughout). The returned ``moved`` maps each key whose
+    replica set changed to its old/new placement; consistent hashing
+    guarantees only keys whose replica set touched a changed backend
+    appear there, so a scale event re-warms the fewest possible scenes.
+    ``after`` carries every key's post-resize placement (how a caller
+    computes a NEW backend's (scene, tile) assignment for pre-admit
+    warming). Preview without mutating by calling this on ``clone()``.
+    """
+    keys = [str(k) for k in keys]
+    before = {k: self.placement(k) for k in keys}
+    for backend in add:
+      self.add(backend)
+    for backend in remove:
+      self.remove(backend)
+    after = {k: self.placement(k) for k in keys}
+    moved = {k: {"old": before[k], "new": after[k]}
+             for k in keys if before[k] != after[k]}
+    return {"added": sorted(str(b) for b in add),
+            "removed": sorted(str(b) for b in remove),
+            "moved": moved, "after": after}
+
+  def clone(self) -> "HashRing":
+    """An independent copy (same members/vnodes/replication) — the
+    preview substrate for ``resize`` what-ifs."""
+    return HashRing(self._backends, self.vnodes, self.replication)
+
   def primary(self, scene_id: str, tile: object | None = None) -> str | None:
     """``placement(...)[0]`` without the full replica walk: the first
     ring point clockwise IS the primary (O(log n) — the router's cell

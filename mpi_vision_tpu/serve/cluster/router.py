@@ -171,6 +171,13 @@ class RouterMetrics:
     self.gossip_peer_failures = 0
     self.supervisor_lease_held = 0
     self.supervisor_takeovers = 0
+    # Elastic-fleet decisions (serve/cluster/autoscale.py): executed
+    # scale-ups/downs, aborted actuations (spawn/warm failure, stranded
+    # scale-out), and decisions denied by the scaling budget.
+    self.autoscale_ups = 0
+    self.autoscale_downs = 0
+    self.autoscale_aborts = 0
+    self.autoscale_budget_denied = 0
     # Asset-tier routing (serve/assets/): manifest/viewer forwards,
     # digest-addressed asset forwards, fan-outs past a primary's 404
     # (any replica holding the digest may answer), fleet-wide misses.
@@ -255,6 +262,21 @@ class RouterMetrics:
     with self._lock:
       self.supervisor_takeovers += 1
 
+  def record_autoscale(self, kind: str) -> None:
+    """One autoscale outcome: ``up``/``down`` (executed), ``abort``
+    (actuation failed or a stranded scale-out was abandoned), or
+    ``budget_denied`` (the per-window scaling budget refused a
+    decision — the anti-thrash guard doing its job)."""
+    with self._lock:
+      if kind == "up":
+        self.autoscale_ups += 1
+      elif kind == "down":
+        self.autoscale_downs += 1
+      elif kind == "abort":
+        self.autoscale_aborts += 1
+      else:
+        self.autoscale_budget_denied += 1
+
   def record_scene_get(self, kind: str) -> None:
     """One asset-tier GET routed (kind: "manifest" covers manifest AND
     viewer — both are scene-generation lookups; "asset" is a
@@ -316,6 +338,12 @@ class RouterMetrics:
           "gossip_peer_failures": self.gossip_peer_failures,
           "supervisor_lease_held": self.supervisor_lease_held,
           "supervisor_takeovers": self.supervisor_takeovers,
+          "autoscale": {
+              "ups": self.autoscale_ups,
+              "downs": self.autoscale_downs,
+              "aborts": self.autoscale_aborts,
+              "budget_denied": self.autoscale_budget_denied,
+          },
           "scene_sync": {
               "manifest_forwards": self.scene_manifest_forwards,
               "asset_forwards": self.scene_asset_forwards,
@@ -487,6 +515,7 @@ class Router:
     self._closed = False
     self.gossip = None  # GossipNode, via set_gossip (router peering)
     self.lease = None  # supervision lease, via set_lease
+    self.incidents = None  # fleet IncidentRecorder, via set_incidents
     if backends:
       items = (backends.items() if isinstance(backends, dict)
                else ((f"b{i}", addr) for i, addr in enumerate(backends)))
@@ -572,6 +601,42 @@ class Router:
     with self._lock:
       return sorted(self._backends)
 
+  def addresses(self) -> dict[str, str]:
+    """``backend_id -> host:port`` for every registered backend (the
+    autoscaler's donor list for pre-admit warming)."""
+    with self._lock:
+      return {b: be.address for b, be in sorted(self._backends.items())}
+
+  # -- elastic membership (the autoscaler's ring actuation) ----------------
+
+  def resize_preview(self, add=(), remove=(), keys=()) -> dict:
+    """What ``resize`` WOULD move, without touching the live ring: the
+    ``HashRing.resize`` diff computed on a clone. The autoscaler warms a
+    new backend's ``after``-assignment from this before admitting it —
+    placement must be known pre-admit or warming warms the wrong keys."""
+    with self._lock:
+      trial = self._ring.clone()
+    return trial.resize(add=add, remove=remove, keys=keys)
+
+  def resize(self, add=None, remove=(), keys=()) -> dict:
+    """Apply a membership change and return the ``HashRing.resize``
+    placement diff for ``keys``.
+
+    ``add`` maps new backend ids to addresses (full ``add_backend``
+    registration: fresh breaker, ring points); ``remove`` retires ids
+    outright (ring points gone — unlike ``eject``, placement moves, but
+    consistent hashing moves ONLY keys whose replica set touched a
+    changed backend; the diff is the receipt). The preview-then-apply
+    split exists so callers can warm before keys move.
+    """
+    add = dict(add or {})
+    diff = self.resize_preview(add=list(add), remove=remove, keys=keys)
+    for backend_id, address in add.items():
+      self.add_backend(backend_id, address)
+    for backend_id in remove:
+      self.remove_backend(backend_id)
+    return diff
+
   # -- router peering (gossip + supervision lease) ------------------------
 
   def set_gossip(self, node) -> None:
@@ -583,6 +648,13 @@ class Router:
     """Attach the supervision lease so /stats and /healthz can report
     the current holder (the supervisor drives the lease itself)."""
     self.lease = lease
+
+  def set_incidents(self, recorder) -> None:
+    """Attach the ROUTER-side incident recorder (fleet-lifecycle black
+    box: quarantines, crash loops, gossip peer deaths, autoscale
+    decisions — edges no single backend's recorder can see). Its
+    bundles ride ``/debug/incidents`` next to the per-backend rings."""
+    self.incidents = recorder
 
   def gossip_exchange(self, remote: dict) -> dict:
     """The /gossip endpoint body: merge the peer's push, answer with
@@ -1298,10 +1370,22 @@ class Router:
     per_backend = self._fan_out_get(qs, self.health_timeout_s)
     out: dict = {"backends": {b: per_backend[b]
                               for b in sorted(per_backend)}}
+    if incident_id and self.incidents is not None:
+      # Fleet-lifecycle bundles live router-side; the id may name one
+      # of ours instead of (or as well as) a backend's.
+      try:
+        out["router"] = self.incidents.get(incident_id)
+      except KeyError:
+        pass
+    elif self.incidents is not None:
+      out["router"] = {"incidents": self.incidents.list(),
+                       "stats": self.incidents.stats()}
     if not incident_id:
       out["incidents_total"] = sum(
           len(st.get("incidents") or []) for st in per_backend.values()
-          if isinstance(st, dict))
+          if isinstance(st, dict)) + (
+              len(self.incidents.list()) if self.incidents is not None
+              else 0)
     return out
 
   def events_snapshot(self, recent: int = 128) -> dict:
@@ -1465,6 +1549,20 @@ class Router:
     reg.counter(p + "supervisor_takeovers_total",
                 "Supervision leases adopted from a dead or wedged peer "
                 "router.", snap["supervisor_takeovers"])
+    reg.counter(p + "autoscale_up_total",
+                "Executed scale-ups (backend spawned, warmed, and "
+                "admitted to the ring).", snap["autoscale"]["ups"])
+    reg.counter(p + "autoscale_down_total",
+                "Executed scale-downs (drainless eject -> drain -> "
+                "SIGTERM -> retire).", snap["autoscale"]["downs"])
+    reg.counter(p + "autoscale_aborts_total",
+                "Scale actuations abandoned (spawn/warm failure, or a "
+                "stranded scale-out reaped after leaseholder death).",
+                snap["autoscale"]["aborts"])
+    reg.counter(p + "autoscale_budget_denied_total",
+                "Autoscale decisions refused by the per-window scaling "
+                "budget (flap guard).",
+                snap["autoscale"]["budget_denied"])
     if self.retry_budget is not None:
       reg.gauge(p + "retry_budget_tokens",
                 "Failover tokens currently in the retry budget.",

@@ -112,6 +112,11 @@ class FleetSupervisor:
     gossip: optional ``gossip.GossipState`` this supervisor publishes
       its per-backend observations into (and adopts them from on
       takeover).
+    autoscaler: optional ``autoscale.Autoscaler`` — ticked after every
+      probe pass WHILE the lease is held (the single-actuator
+      guarantee: standby replicas never scale), and asked to
+      ``converge()`` a predecessor's half-finished decision on
+      takeover.
   """
 
   UP = "up"
@@ -126,7 +131,7 @@ class FleetSupervisor:
                backoff_mult: float = 2.0, backoff_max_s: float = 15.0,
                load_refresh_s: float = 2.0, transport=None,
                clock=time.monotonic, sleep=None, log=None,
-               lease=None, gossip=None):
+               lease=None, gossip=None, autoscaler=None):
     if probe_s <= 0:
       raise ValueError(f"probe_s must be > 0, got {probe_s}")
     if wedge_after < 1:
@@ -182,6 +187,10 @@ class FleetSupervisor:
     self.gossip = gossip
     self._lease_held = False
     self.takeovers_total = 0
+    self.autoscaler = autoscaler
+    self.autoscale_errors = 0
+    if autoscaler is not None:
+      autoscaler.supervisor = self  # victim selection needs quarantines
 
   # -- state access --------------------------------------------------------
 
@@ -218,7 +227,7 @@ class FleetSupervisor:
             "last_reason": st.last_reason,
             "budget": st.budget.snapshot(),
         }
-      return {
+      out = {
           "ticks": self.ticks,
           "tick_errors": self.tick_errors,
           "restarts": self.restarts_total,
@@ -229,8 +238,13 @@ class FleetSupervisor:
           "budget_window_s": self.budget_window_s,
           "lease_held": self._lease_held,
           "takeovers": self.takeovers_total,
+          "autoscale_errors": self.autoscale_errors,
           "backends": backends,
       }
+    if self.autoscaler is not None:
+      # Outside _lock: the autoscaler snapshot is its own state.
+      out["autoscale"] = self.autoscaler.snapshot()
+    return out
 
   # -- probing -------------------------------------------------------------
 
@@ -297,6 +311,13 @@ class FleetSupervisor:
               else f"wedged: {status} x{failures}")
       self._refresh_router_load()
       self._publish_observations()
+      if self.autoscaler is not None:
+        try:
+          self.autoscaler.tick()
+        except Exception as e:  # noqa: BLE001 - scaling never kills probing
+          with self._lock:
+            self.autoscale_errors += 1
+          self._log(f"supervisor: autoscale tick failed: {e!r}")
 
   # -- leased supervision (router HA) --------------------------------------
 
@@ -345,6 +366,13 @@ class FleetSupervisor:
       self._log(f"supervisor: TOOK OVER supervision from "
                 f"{got.get('previous')}")
       self._adopt_observations()
+      if self.autoscaler is not None:
+        try:
+          self.autoscaler.converge()
+        except Exception as e:  # noqa: BLE001 - takeover must complete
+          with self._lock:
+            self.autoscale_errors += 1
+          self._log(f"supervisor: autoscale converge failed: {e!r}")
     else:
       self.events.emit("supervision_lease_acquired",
                        owner=self.lease.owner)
@@ -358,9 +386,16 @@ class FleetSupervisor:
     quarantine verdict stays quarantined + ejected here."""
     if self.gossip is None:
       return
+    from mpi_vision_tpu.serve.cluster.autoscale import AUTOSCALE_KEY
+
     now = self.gossip.now()
     for backend_id, obs in sorted(self.gossip.observations().items()):
       fields = obs["fields"]
+      if backend_id == AUTOSCALE_KEY or fields.get("state") == "retired":
+        # The reserved decision record is not a backend, and a
+        # deliberately retired backend must not be resurrected as a
+        # supervision entry (the autoscaler's converge() owns both).
+        continue
       st = self._state_for(backend_id)
       staleness = max(0.0, now - obs["version"])
       ages = fields.get("budget_ages_s")
@@ -544,6 +579,20 @@ class FleetSupervisor:
         if self.router is not None:
           self.router.readmit(backend_id)
       self._log(f"supervisor: {backend_id} readmitted")
+
+  def forget(self, backend_id: str) -> None:
+    """Drop a backend's supervision record (autoscale retirement: the
+    backend is GONE by policy, and republishing its stale state would
+    overwrite the ``retired`` gossip verdict every tick). Quarantined
+    records are refused — quarantine is evidence, not capacity, and
+    the autoscaler never selects a quarantined victim."""
+    with self._lock:
+      st = self._states.get(str(backend_id))
+      if st is not None and st.state == self.QUARANTINED:
+        raise ValueError(
+            f"refusing to forget quarantined backend {backend_id!r}; "
+            "readmit() it first")
+      self._states.pop(str(backend_id), None)
 
   # -- rolling restart -----------------------------------------------------
 

@@ -97,6 +97,10 @@ def test_lint_covers_the_ckpt_package_and_train_loop():
           # heartbeats ARE timestamps — one bare clock call desyncs
           # the anti-entropy merge from the takeover math.
           "cluster/gossip.py", "cluster/lease.py",
+          # The elastic fleet (PR 19): sustain windows, cooldowns, and
+          # the scaling budget ARE the anti-flap guarantees — a bare
+          # clock call would weld them to wall time.
+          "cluster/autoscale.py",
           # The asset tier (PR 16): sync sweep timing and watcher polls
           # ride the same injected clocks as the checkpoint watcher.
           "assets/store.py", "assets/fetch.py",

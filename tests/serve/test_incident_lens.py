@@ -350,6 +350,76 @@ class FlakySink:
     return 200
 
 
+class TestLifecycleIncidentTap:
+  """Fleet-lifecycle events -> incident episodes (PR 19): the black
+  box covers quarantines, crash loops, gossip peer deaths, and
+  autoscale decisions, deduped per episode through the recorder's
+  existing fire/clear latch."""
+
+  def _tap(self, tmp_path):
+    rec = _recorder(tmp_path)
+    return rec, incident_mod.LifecycleIncidentTap(rec)
+
+  def test_quarantine_fires_once_per_episode(self, tmp_path):
+    rec, tap = self._tap(tmp_path)
+    ev = {"kind": "backend_quarantined", "backend": "b1", "restarts": 3}
+    tap.note_event(ev)
+    tap.note_event(ev)  # same episode: latched, no second bundle
+    assert rec.drain() == 1
+    assert rec.stats()["suppressed"] == 1
+    (entry,) = rec.list()
+    assert rec.get(entry["id"])["alert"]["alert"] == "quarantine:b1"
+    # The readmit closes the episode; a NEW quarantine captures again.
+    tap.note_event({"kind": "backend_readmit", "backend": "b1"})
+    tap.note_event(ev)
+    assert rec.drain() == 1
+
+  def test_crash_loop_fires_on_second_attempt_only(self, tmp_path):
+    rec, tap = self._tap(tmp_path)
+    tap.note_event({"kind": "backend_restart", "backend": "b0",
+                    "ok": True, "attempt": 1})
+    assert rec.drain() == 0  # one restart is routine
+    tap.note_event({"kind": "backend_restart", "backend": "b0",
+                    "ok": True, "attempt": 2})
+    assert rec.drain() == 1  # the loop is the incident
+    # The quarantine verdict subsumes the crash-loop episode: it
+    # closes that latch and opens its own.
+    tap.note_event({"kind": "backend_quarantined", "backend": "b0"})
+    assert rec.drain() == 1
+    assert rec.stats()["firing"] == ["quarantine:b0"]
+
+  def test_gossip_peer_death_clears_on_recovery(self, tmp_path):
+    rec, tap = self._tap(tmp_path)
+    down = {"kind": "gossip_peer_failure", "peer": "routerB",
+            "error": "timeout"}
+    tap.note_event(down)
+    tap.note_event(down)
+    assert rec.drain() == 1  # one bundle per outage, not per round
+    tap.note_event({"kind": "gossip_peer_recovered", "peer": "routerB"})
+    tap.note_event(down)
+    assert rec.drain() == 1  # a NEW outage is a new episode
+
+  def test_autoscale_decisions_capture_point_in_time(self, tmp_path):
+    rec, tap = self._tap(tmp_path)
+    # Distinct decisions (the gossip seq) each capture; the
+    # self-clearing latch means none of them can ever wedge open.
+    tap.note_event({"kind": "autoscale_up", "seq": 4, "backend": "b1"})
+    tap.note_event({"kind": "autoscale_down", "seq": 5, "backend": "b1"})
+    tap.note_event({"kind": "autoscale_abort", "seq": 6, "backend": "b2"})
+    assert rec.drain() == 3
+    assert rec.stats()["firing"] == []  # nothing latched
+    assert tap.taps == 3
+
+  def test_sink_parses_event_lines_and_never_throws(self, tmp_path):
+    rec, tap = self._tap(tmp_path)
+    tap.sink(json.dumps({"kind": "backend_quarantined", "backend": "b2",
+                         "seq": 9, "ts_unix_s": 1.0}))
+    tap.sink("not json {")          # counted, swallowed
+    tap.sink(json.dumps({"kind": "scene_swap"}))  # unmapped: ignored
+    assert rec.drain() == 1
+    assert tap.errors == 1 and tap.taps == 1
+
+
 def test_bundles_survive_sink_outage_and_drain_in_order(tmp_path):
   clock = FakeClock()
   sink = FlakySink(down=True)

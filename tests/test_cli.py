@@ -249,6 +249,66 @@ def test_cluster_router_ha_knobs_guarded(argv, msg):
     cli.main(argv)
 
 
+@pytest.mark.parametrize("argv,msg", [
+    # Every autoscale knob only acts through the armed autoscaler;
+    # dangling any of them would silently leave elasticity off.
+    (["cluster", "--backends", "1", "--supervise",
+      "--autoscale-min", "1"], r"require\(s\) --autoscale"),
+    (["cluster", "--backends", "1", "--supervise",
+      "--autoscale-max", "4"], r"require\(s\) --autoscale"),
+    (["cluster", "--backends", "1", "--supervise",
+      "--autoscale-up-sustain-s", "2"], r"require\(s\) --autoscale"),
+    (["cluster", "--backends", "1", "--supervise",
+      "--autoscale-down-sustain-s", "20"], r"require\(s\) --autoscale"),
+    (["cluster", "--backends", "1", "--supervise",
+      "--autoscale-up-cooldown-s", "10"], r"require\(s\) --autoscale"),
+    (["cluster", "--backends", "1", "--supervise",
+      "--autoscale-down-cooldown-s", "30"], r"require\(s\) --autoscale"),
+    (["cluster", "--backends", "1", "--supervise",
+      "--autoscale-queue-high", "8"], r"require\(s\) --autoscale"),
+    (["cluster", "--backends", "1", "--supervise",
+      "--autoscale-burn-high", "2"], r"require\(s\) --autoscale"),
+    (["cluster", "--backends", "1", "--supervise",
+      "--autoscale-util-low", "0.1"], r"require\(s\) --autoscale"),
+    (["cluster", "--backends", "1", "--supervise",
+      "--autoscale-budget", "4"], r"require\(s\) --autoscale"),
+    (["cluster", "--backends", "1", "--supervise",
+      "--autoscale-budget-window-s", "300"], r"require\(s\) --autoscale"),
+    (["cluster", "--backends", "1", "--supervise",
+      "--autoscale-drain-s", "0.5"], r"require\(s\) --autoscale"),
+    (["cluster", "--backends", "1", "--supervise",
+      "--autoscale-interval-s", "1"], r"require\(s\) --autoscale"),
+    # Scaling is the leaseholder's act alone.
+    (["cluster", "--backends", "1", "--autoscale"],
+     "--autoscale requires --supervise"),
+    # The hook is the --join fleet's spawn path, nothing else's.
+    (["cluster", "--backends", "1", "--supervise", "--autoscale",
+      "--provision-hook", "echo"], "--provision-hook requires --join"),
+    (["cluster", "--join", "h:1", "--supervise",
+      "--provision-hook", "echo"],
+     "--provision-hook requires --autoscale"),
+    # A --join autoscaler without a hook cannot create capacity.
+    (["cluster", "--join", "h:1", "--supervise", "--autoscale"],
+     "--autoscale with --join requires --provision-hook"),
+    # Value floors are validated at the door, not in the tick loop.
+    (["cluster", "--backends", "1", "--supervise", "--autoscale",
+      "--autoscale-interval-s", "0"], "--autoscale-interval-s must be"),
+    (["cluster", "--backends", "1", "--supervise", "--autoscale",
+      "--autoscale-drain-s", "-1"], "--autoscale-drain-s must be"),
+    # AutoscaleConfig's own validation surfaces as a door-time exit.
+    (["cluster", "--backends", "1", "--supervise", "--autoscale",
+      "--autoscale-min", "3", "--autoscale-max", "2"],
+     "bad autoscale config"),
+])
+def test_cluster_autoscale_knobs_guarded(argv, msg):
+  """Elastic-fleet knobs are validated at the door — the supervisor
+  tick swallows autoscaler exceptions by design (a scaling bug must
+  not kill supervision), so a lazily-raised ValueError would leave
+  autoscaling silently dead."""
+  with pytest.raises(SystemExit, match=msg):
+    cli.main(argv)
+
+
 def test_serve_edge_negative_ttl_guarded():
   """Negative caching only acts through the edge cache; dangling the
   TTL would silently drop the shed behaviour the operator asked for."""

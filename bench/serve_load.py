@@ -247,6 +247,21 @@ def build_parser() -> argparse.ArgumentParser:
                        "+ backend-count + brownout-level trajectories, "
                        "a calibrated SLO verdict per arm, and the "
                        "scale-down zero-drop check")
+  ap.add_argument("--session", action="store_true",
+                  help="serve the closed loop through pose-in/frame-out "
+                       "streaming sessions (POST /session over real "
+                       "sockets): per-client smooth camera trajectories, "
+                       "pipelined poses fused into shared device "
+                       "flights, trajectory-predictive edge-cache "
+                       "prefetch (serve/session/)")
+  ap.add_argument("--session-ab", action="store_true",
+                  help="session-vs-request-per-frame A/B: the same "
+                       "smooth trajectories replayed once through "
+                       "streaming sessions and once as one POST /render "
+                       "per frame, in one process, plus the PINNED "
+                       "bit-exactness check (session frames == direct "
+                       "renders of the same poses, edge off); "
+                       "--session-ab --dry is the tier-1 smoke")
   return ap
 
 
@@ -1916,6 +1931,289 @@ def asset_ab_main(args) -> int:
   return 0
 
 
+def session_trajectory(idx: int, seed: int, step: float):
+  """Infinite smooth constant-velocity camera path for client ``idx``.
+
+  The step outruns the edge warp radius (a camera FLYING through the
+  scene, not orbiting one viewpoint), so every frame lands in a fresh
+  view cell: without prefetch it is a full render, with prefetch the
+  constant-velocity predictor's next-cell guess is exactly where the
+  camera arrives a few frames later — the design load for
+  trajectory-predictive prefetch. Bounces off +-1.6 so long windows stay
+  bounded; the box is wide relative to the step so straight segments are
+  much longer than the prefetch lead (a bounce mid-prediction is a miss,
+  and the EMA predictor re-converges within a frame or two)."""
+  rng = np.random.default_rng([seed, 4242, idx])
+  pos = rng.uniform(-0.05, 0.05, 3).astype(np.float64)
+  vel = rng.normal(size=3)
+  vel *= step / max(float(np.linalg.norm(vel)), 1e-9)
+  while True:
+    pose = np.eye(4, dtype=np.float32)
+    pose[:3, 3] = pos.astype(np.float32)
+    yield pose
+    pos = pos + vel
+    for axis in range(3):
+      if abs(pos[axis]) > 1.6:
+        vel[axis] = -vel[axis]
+
+
+def _session_service(args, session_cfg, edge: bool):
+  """A served-over-real-sockets RenderService for the session bench:
+  returns ``(svc, ids, httpd, host, port)`` with warm-up done and the
+  measured window's metrics reset."""
+  from mpi_vision_tpu.obs import attrib as attrib_lib
+  from mpi_vision_tpu.serve import RenderService, make_http_server
+  from mpi_vision_tpu.serve.edge import EdgeConfig
+
+  use_mesh = {"auto": None, "on": True, "off": False}[args.sharded]
+  svc = RenderService(
+      cache_bytes=args.cache_mb << 20, max_batch=args.max_batch,
+      max_wait_ms=args.max_wait_ms, max_inflight=args.inflight,
+      method=args.method, use_mesh=use_mesh,
+      # Warp tolerance scaled to the lattice (not the absolute default):
+      # the flying-camera trajectory must be able to OUTRUN warp serving,
+      # or both arms degenerate into a warp microbenchmark.
+      edge=(EdgeConfig(trans_cell=args.edge_trans_cell,
+                       warp_max_trans=2.0 * args.edge_trans_cell)
+            if edge else None),
+      session=session_cfg,
+      slo=slo_window_config(args.duration),
+      attrib=attrib_lib.AttribConfig())
+  ids = svc.add_synthetic_scenes(
+      args.scenes, height=args.img_size, width=args.img_size,
+      planes=args.num_planes, seed=args.seed)
+  svc.warmup()
+  svc.metrics.reset()
+  svc.scheduler.reset_gap_clock()
+  httpd = make_http_server(svc, port=0)
+  threading.Thread(target=httpd.serve_forever, daemon=True).start()
+  host, port = httpd.server_address[0], httpd.server_address[1]
+  return svc, ids, httpd, host, port
+
+
+def session_run(args, streaming: bool) -> dict:
+  """One measured window of smooth-trajectory traffic over real sockets —
+  through streaming sessions when ``streaming``, else one POST /render
+  per frame on the same service shape. Client-side per-frame latency is
+  the headline (both arms pay the same transport), server stats ride
+  along."""
+  import urllib.request
+
+  from mpi_vision_tpu.obs import slo as slo_mod
+  from mpi_vision_tpu.serve.session import SessionConfig
+  from mpi_vision_tpu.serve.session.protocol import SessionClient
+
+  # One fresh view cell per frame: past the scaled warp radius (2x
+  # cell), so a frame is either a real render or a prefetch-warmed hit.
+  step = 3.0 * args.edge_trans_cell
+  session_cfg = SessionConfig(
+      max_sessions=max(8, args.concurrency)) if streaming else None
+  svc, ids, httpd, host, port = _session_service(args, session_cfg,
+                                                 edge=True)
+  _log(f"serve_load: session arm "
+       f"({'streaming' if streaming else 'request-per-frame'}) — "
+       f"{args.concurrency} clients, step {step:.4f} "
+       f"({args.edge_trans_cell:g} cell)")
+  stop = threading.Event()
+  errors: list[Exception] = []
+  counts = [0] * args.concurrency
+  latencies: list[list[float]] = [[] for _ in range(args.concurrency)]
+  # Poses a streaming client keeps in flight: deep enough that the
+  # session drains multi-pose flushes (the fusion under test), shallow
+  # enough that per-frame latency stays a latency, not a queue length.
+  window = 2 * (session_cfg.fuse_max if session_cfg else 4)
+
+  def stream_worker(idx: int) -> None:
+    poses = session_trajectory(idx, args.seed, step)
+    sid = ids[idx % len(ids)]
+    try:
+      client = SessionClient(host, port, sid, timeout=120)
+    except Exception as e:  # noqa: BLE001 - open failure aborts the arm
+      errors.append(e)
+      return
+    send_times: list[float] = []
+    credit = threading.Semaphore(window)
+
+    def writer() -> None:
+      try:
+        while not stop.is_set():
+          if not credit.acquire(timeout=0.2):
+            continue
+          send_times.append(time.perf_counter())
+          client.send_pose(next(poses))
+        client.end()
+      except (OSError, ValueError):
+        pass  # reader side reports the failure
+
+    wt = threading.Thread(target=writer, daemon=True)
+    wt.start()
+    try:
+      for seq, _img in client.frames():
+        latencies[idx].append(time.perf_counter() - send_times[seq])
+        counts[idx] += 1
+        credit.release()
+    except Exception as e:  # noqa: BLE001 - error frame / torn socket
+      errors.append(e)
+    finally:
+      wt.join(30)
+      client.close()
+
+  def request_worker(idx: int) -> None:
+    poses = session_trajectory(idx, args.seed, step)
+    sid = ids[idx % len(ids)]
+    base = f"http://{host}:{port}/render"
+    while not stop.is_set():
+      body = json.dumps(
+          {"scene_id": sid, "pose": next(poses).tolist()}).encode()
+      t0 = time.perf_counter()
+      try:
+        req = urllib.request.Request(
+            base, data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as resp:
+          resp.read()
+      except Exception as e:  # noqa: BLE001 - clean arms: first error aborts
+        errors.append(e)
+        return
+      latencies[idx].append(time.perf_counter() - t0)
+      counts[idx] += 1
+
+  worker = stream_worker if streaming else request_worker
+  threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+             for i in range(args.concurrency)]
+  t0 = time.perf_counter()
+  for t in threads:
+    t.start()
+  time.sleep(args.duration)
+  stop.set()
+  for t in threads:
+    t.join(60)
+  elapsed = time.perf_counter() - t0
+  httpd.shutdown()
+  svc.close()
+
+  if errors:
+    raise SystemExit(f"serve_load: session worker failed: {errors[0]!r}")
+  total = sum(counts)
+  if total == 0:
+    raise SystemExit("serve_load: no frames completed in the window")
+  lat_ms = np.sort(np.concatenate(
+      [np.asarray(l) for l in latencies if l])) * 1e3
+  stats = svc.stats()
+  record = {
+      "mode": "session" if streaming else "request_per_frame",
+      "frames": total,
+      "frames_per_sec": round(total / elapsed, 3),
+      "p50_ms": round(float(np.percentile(lat_ms, 50)), 3),
+      "p99_ms": round(float(np.percentile(lat_ms, 99)), 3),
+      "mean_batch_size": stats["mean_batch_size"],
+      "edge": stats.get("edge"),
+      "errors": stats["errors"],
+      "rejected": stats["rejected"],
+      "slo": slo_mod.verdict(stats.get("slo")),
+      "device_seconds_by_class": device_seconds_by_class(stats),
+      **attrib_record(stats),
+  }
+  if streaming:
+    record["session"] = stats["session"]
+  return record
+
+
+def session_parity_check(args) -> dict:
+  """The PINNED cross-path check: frames streamed through a session must
+  be bit-identical to direct renders of the same poses. Edge cache OFF —
+  a view-cell hit legitimately serves a cell-mate's pixels, which is
+  exactly what this check must not excuse — and prefetch off with it."""
+  from mpi_vision_tpu.serve.session import SessionConfig
+  from mpi_vision_tpu.serve.session.protocol import SessionClient
+
+  n_poses = 6
+  svc, ids, httpd, host, port = _session_service(
+      args, SessionConfig(prefetch_horizon=0), edge=False)
+  poses_iter = session_trajectory(0, args.seed, 3.0 * args.edge_trans_cell)
+  poses = [next(poses_iter) for _ in range(n_poses)]
+  try:
+    direct = [np.asarray(svc.render(ids[0], p, timeout=600)) for p in poses]
+    client = SessionClient(host, port, ids[0], timeout=120)
+    with client:
+      for p in poses:
+        client.send_pose(p)
+      client.end()
+      streamed = {seq: img for seq, img in client.frames()}
+  finally:
+    httpd.shutdown()
+    svc.close()
+  if len(streamed) != n_poses:
+    raise SystemExit(f"serve_load: PINNED parity failure — session "
+                     f"returned {len(streamed)}/{n_poses} frames")
+  worst = 0.0
+  for i, want in enumerate(direct):
+    got = streamed[i]
+    if not np.array_equal(got, want):
+      worst = max(worst, float(np.abs(got - want).max()))
+  if worst:
+    raise SystemExit(
+        "serve_load: PINNED parity failure — session frames differ from "
+        f"direct renders of the same poses (max abs diff {worst:g})")
+  return {"poses": n_poses, "bit_exact": True}
+
+
+def session_ab_main(args) -> int:
+  """The session-vs-request-per-frame A/B: the same smooth trajectories,
+  the same service shape, real sockets in both arms — once as streaming
+  sessions (pipelined poses, fused flights, predictive prefetch) and
+  once as one POST /render per frame. The parity block is PINNED."""
+  parity = session_parity_check(args)
+  _log("serve_load: session A/B arm 1/2 — streaming sessions")
+  sess = session_run(args, streaming=True)
+  _log("serve_load: session A/B arm 2/2 — request per frame")
+  req = session_run(args, streaming=False)
+  throughput_x = (sess["frames_per_sec"] / req["frames_per_sec"]
+                  if req["frames_per_sec"] else None)
+  sess_stats = sess.get("session") or {}
+  prefetch = dict(sess_stats.get("prefetch") or {})
+  issued = prefetch.get("issued") or 0
+  prefetch["hit_rate"] = (round(prefetch.get("hits", 0) / issued, 4)
+                          if issued else None)
+  record = {
+      "metric": "serve_load_session_ab",
+      "value": round(throughput_x, 4) if throughput_x is not None else None,
+      "unit": "x_session_over_request",
+      "frames_per_sec_session": sess["frames_per_sec"],
+      "frames_per_sec_request": req["frames_per_sec"],
+      "p50_ms_session": sess["p50_ms"],
+      "p50_ms_request": req["p50_ms"],
+      "p99_ms_session": sess["p99_ms"],
+      "p99_ms_request": req["p99_ms"],
+      # The fusion win: poses per fused flush (session bookkeeping) and
+      # poses per device flight (scheduler bookkeeping) — the number
+      # BENCH_r08 recorded stuck at ~1 for request-per-frame traffic.
+      "mean_flush_size": sess_stats.get("mean_flush_size"),
+      "mean_batch_size_session": sess["mean_batch_size"],
+      "mean_batch_size_request": req["mean_batch_size"],
+      "prefetch": prefetch,
+      "parity": parity,
+      "session": sess,
+      "request": req,
+      "dry": bool(args.dry),
+  }
+  print(json.dumps(record))
+  return 0
+
+
+def session_main(args) -> int:
+  """Single-arm session mode: the streaming window plus the pinned
+  parity block, no request-per-frame comparison arm."""
+  parity = session_parity_check(args)
+  record = dict(session_run(args, streaming=True))
+  record.update({"metric": "serve_load_session",
+                 "value": record["frames_per_sec"],
+                 "unit": "frames/s", "parity": parity,
+                 "dry": bool(args.dry)})
+  print(json.dumps(record))
+  return 0
+
+
 def _overload_calibrate(args) -> float:
   """Anchor the latency objective to THIS box. The single-stream render
   is what a healthy service owes one client, so the objective is a
@@ -2242,6 +2540,15 @@ def main(argv=None) -> int:
     raise SystemExit(f"--inflight must be >= 1, got {args.inflight}")
   if args.tile_size < 8:
     raise SystemExit(f"--tile-size must be >= 8, got {args.tile_size}")
+  if args.session or args.session_ab:
+    if (args.chaos or args.ab or args.edge_ab or args.cluster
+        or args.edge or args.tiled_ab or args.overload_ab
+        or args.asset_ab):
+      raise SystemExit("--session/--session-ab measure the streaming "
+                       "session tier on their own service; they do not "
+                       "combine with --chaos/--ab/--edge-ab/--edge/"
+                       "--cluster/--tiled-ab/--overload-ab/--asset-ab")
+    return session_ab_main(args) if args.session_ab else session_main(args)
   if args.asset_ab:
     if (args.chaos or args.ab or args.edge_ab or args.cluster
         or args.edge or args.tiled_ab or args.overload_ab):

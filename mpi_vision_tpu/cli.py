@@ -555,6 +555,18 @@ def cmd_serve(args: argparse.Namespace) -> dict:
     # The ladder is DRIVEN by the SLO burn rate; without the tracker it
     # would be a queue-only controller pretending to watch the SLO.
     raise SystemExit("--brownout requires SLO tracking (drop --no-slo)")
+  if not args.session:
+    # Session knobs only act through the SessionManager; silently inert
+    # streaming limits are the dangling-flag failure mode.
+    wants_session = [flag for flag, on in (
+        ("--session-max", args.session_max is not None),
+        ("--session-idle-s", args.session_idle_s is not None),
+        ("--session-fuse", args.session_fuse is not None),
+        ("--session-prefetch", args.session_prefetch is not None),
+    ) if on]
+    if wants_session:
+      raise SystemExit(
+          f"{', '.join(wants_session)} require(s) --session")
   if args.attrib_scenes is not None and not args.attrib:
     # The cap only acts through the ledger; the usual dangling-flag
     # guard.
@@ -732,6 +744,28 @@ def cmd_serve(args: argparse.Namespace) -> dict:
       # BrownoutConfig's own validation (hysteresis-band ordering,
       # plane-keep range, ...) speaks in flag terms already.
       raise SystemExit(f"bad brownout config: {e}") from None
+  session = None
+  if args.session:
+    from mpi_vision_tpu.serve.session import SessionConfig
+
+    sess_defaults = SessionConfig()
+    try:
+      session = SessionConfig(
+          max_sessions=(args.session_max
+                        if args.session_max is not None
+                        else sess_defaults.max_sessions),
+          idle_timeout_s=(args.session_idle_s
+                          if args.session_idle_s is not None
+                          else sess_defaults.idle_timeout_s),
+          fuse_max=(args.session_fuse
+                    if args.session_fuse is not None
+                    else sess_defaults.fuse_max),
+          prefetch_horizon=(args.session_prefetch
+                            if args.session_prefetch is not None
+                            else sess_defaults.prefetch_horizon))
+    except ValueError as e:
+      # SessionConfig's own validation speaks in flag terms already.
+      raise SystemExit(f"bad session config: {e}") from None
   attrib = None
   if args.attrib:
     from mpi_vision_tpu.obs import attrib as attrib_lib
@@ -804,7 +838,7 @@ def cmd_serve(args: argparse.Namespace) -> dict:
       profile_dir=args.profile_dir or None, profile_hook=profile_hook,
       alert_hook=alert_hook, slo=slo, brownout=brownout, events=events,
       tsdb=tsdb, ship=ship, attrib=attrib, incidents=incidents,
-      metrics_ttl_s=args.metrics_ttl_ms / 1e3)
+      session=session, metrics_ttl_s=args.metrics_ttl_ms / 1e3)
   if args.mpi_dir:
     from mpi_vision_tpu.core.camera import intrinsics_matrix, inv_depths
     from mpi_vision_tpu.viewer import export
@@ -2049,6 +2083,28 @@ def build_parser() -> argparse.ArgumentParser:
                  help="ladder ceiling 1-4; below 4 the service never "
                       "sheds, only degrades (default 4); requires "
                       "--brownout")
+  s.add_argument("--session", action=argparse.BooleanOptionalAction,
+                 default=False,
+                 help="pose-in/frame-out streaming sessions at POST "
+                      "/session: one long-lived exchange per client, "
+                      "queued poses fused into one device flight, and a "
+                      "trajectory predictor issuing speculative "
+                      "X-Request-Class: prefetch renders into the edge "
+                      "cache (serve/session/)")
+  s.add_argument("--session-max", type=int, default=None,
+                 help="concurrent session bound — opens past it get 503 "
+                      "+ Retry-After (default 8); requires --session")
+  s.add_argument("--session-idle-s", type=float, default=None,
+                 help="seconds without a pose before a session is "
+                      "reaped (default 30); requires --session")
+  s.add_argument("--session-fuse", type=int, default=None,
+                 help="max queued poses drained into one fused device "
+                      "flight (default 4); requires --session")
+  s.add_argument("--session-prefetch", type=int, default=None,
+                 help="predicted poses probed ahead per flush for "
+                      "speculative edge-cache warming; 0 disables the "
+                      "predictor (default 3); acts only with "
+                      "--edge-cache; requires --session")
   s.add_argument("--tsdb-interval-s", type=float, default=0.0,
                  help="sample every /metrics family into the on-box "
                       "time-series ring this often and serve windowed "

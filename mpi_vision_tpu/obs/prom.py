@@ -429,6 +429,43 @@ def serve_registry(stats: dict,
       "tier that produced them — never cached, never ETag'd).")
   for lvl in ("1", "2", "3", "4"):
     deg_m.sample(bo_deg.get(lvl, 0), {"level": lvl})
+  # Session streaming tier (serve/session/): always exposed (zeros while
+  # sessions are off).
+  sess = stats.get("session") or {}
+  reg.gauge(p + "session_active", "Open pose-stream sessions.",
+            sess.get("active", 0))
+  reg.counter(p + "session_opened_total",
+              "Streaming sessions admitted (POST /session accepted).",
+              sess.get("opened", 0))
+  reg.counter(p + "session_closed_total",
+              "Sessions ended for any reason (idle reaps included).",
+              sess.get("closed", 0))
+  reg.counter(p + "session_rejected_total",
+              "Session opens shed at the session bound "
+              "(503 + Retry-After).", sess.get("rejected", 0))
+  reg.counter(p + "session_idle_reaped_total",
+              "Sessions closed by the idle reaper.",
+              sess.get("idle_reaped", 0))
+  reg.counter(p + "session_frames_total",
+              "Frames streamed to session clients.", sess.get("frames", 0))
+  reg.counter(p + "session_frame_errors_total",
+              "Session frames that failed and were surfaced as in-stream "
+              "error frames.", sess.get("frame_errors", 0))
+  reg.counter(p + "session_flushes_total",
+              "Fused drains of a session's pose queue — each submits its "
+              "poses concurrently so the scheduler coalesces one flight.",
+              sess.get("flushes", 0))
+  sess_pf = sess.get("prefetch") or {}
+  reg.counter(p + "session_prefetch_issued_total",
+              "Speculative prefetch-class renders issued for predicted "
+              "view cells.", sess_pf.get("issued", 0))
+  reg.counter(p + "session_prefetch_hits_total",
+              "Real session frames served from a cell the prefetcher "
+              "warmed.", sess_pf.get("hits", 0))
+  reg.counter(p + "session_prefetch_suppressed_total",
+              "Prefetch rounds skipped because the brownout ladder sat at "
+              "L3+ (predictor muted at the source).",
+              sess_pf.get("suppressed", 0))
   cache = stats.get("cache") or {}
   reg.counter(p + "cache_hits_total", "Scene-cache hits.",
               cache.get("hits", 0))

@@ -34,6 +34,7 @@ import http.client
 import json
 import math
 import re
+import socket
 import threading
 import time
 import urllib.error
@@ -165,6 +166,7 @@ class RouterMetrics:
     self.retry_budget_exhausted = 0
     self.cell_routes = 0
     self.cell_reroutes = 0
+    self.session_proxies = 0
     self.gossip_rounds = 0
     self.gossip_merges = 0
     self.gossip_conflicts = 0
@@ -305,6 +307,11 @@ class RouterMetrics:
     with self._lock:
       self.scene_asset_revalidations += 1
 
+  def record_session_proxy(self) -> None:
+    """One streaming session tunneled to a backend (POST /session)."""
+    with self._lock:
+      self.session_proxies += 1
+
   def record_cell_route(self, rerouted: bool) -> None:
     """One request placed by its ``(scene, view-cell)`` ring key;
     ``rerouted`` when that key's primary differs from the scene-level
@@ -332,6 +339,7 @@ class RouterMetrics:
           "retry_budget_exhausted": self.retry_budget_exhausted,
           "cell_routes": self.cell_routes,
           "cell_reroutes": self.cell_reroutes,
+          "session_proxies": self.session_proxies,
           "gossip_rounds": self.gossip_rounds,
           "gossip_merges": self.gossip_merges,
           "gossip_conflicts": self.gossip_conflicts,
@@ -1512,6 +1520,10 @@ class Router:
                 "Cell-keyed placements whose primary differed from the "
                 "scene-level primary (affinity moved the request).",
                 snap["cell_reroutes"])
+    reg.counter(p + "session_proxies_total",
+                "Streaming sessions tunneled to a backend (POST "
+                "/session; cell-affine when the hello carries a pose).",
+                snap["session_proxies"])
     reg.counter(p + "scene_sync_manifest_forwards_total",
                 "Scene manifest/viewer GETs routed to a replica.",
                 snap["scene_sync"]["manifest_forwards"])
@@ -1819,6 +1831,9 @@ class _RouterHandler(BaseHTTPRequestHandler):
     if self.path == "/gossip":
       self._do_gossip()
       return
+    if self.path == "/session":
+      self._do_session_proxy()
+      return
     if self.path != "/render":
       self._send_json({"error": f"unknown path {self.path}"}, status=404)
       return
@@ -1826,6 +1841,151 @@ class _RouterHandler(BaseHTTPRequestHandler):
     trace_id = inbound_tid or new_trace_id_32()
     tid_hdr = {"X-Trace-Id": trace_id}
     return self._do_render(trace_id, tid_hdr)
+
+  def _do_session_proxy(self) -> None:
+    """POST /session: tunnel a streaming session to the scene's primary.
+
+    Sessions are long-lived sockets, not request/response — so after
+    validating the hello body and picking a backend (placement order,
+    cell-affine when the hello carries an initial ``pose``, skipping
+    ejected and breaker-refused replicas; connect failures fail over and
+    count against the breaker) the handler becomes a raw byte pump: the
+    backend's entire response — status line, headers, frame stream —
+    relays to the client verbatim, and the client's pose frames relay to
+    the backend on a companion thread. There is no mid-stream failover:
+    once any backend byte reaches the client, the session lives and dies
+    with that backend.
+    """
+    router = self.router
+    inbound_tid = _inbound_trace_id(self.headers)
+    trace_id = inbound_tid or new_trace_id_32()
+    tid_hdr = {"X-Trace-Id": trace_id}
+    try:
+      length = int(self.headers.get("Content-Length", "0"))
+      if not 0 <= length <= _MAX_BODY_BYTES:
+        raise ValueError(f"bad body length ({length} bytes)")
+      body = self.rfile.read(length)
+      req = json.loads(body or b"{}")
+      if not isinstance(req, dict):
+        raise ValueError(
+            f"body must be a JSON object, got {type(req).__name__}")
+      scene_id = req["scene_id"]
+      if not isinstance(scene_id, str):
+        raise ValueError(
+            f"scene_id must be a string, got {type(scene_id).__name__}")
+      if any(ord(c) < 0x20 for c in scene_id):
+        raise ValueError("scene_id must not contain control characters")
+    except (KeyError, TypeError, ValueError, json.JSONDecodeError) as e:
+      router.metrics.record_bad_request()
+      self._send_json({"error": f"bad request: {e}"}, status=400,
+                      extra_headers=tid_hdr)
+      return
+    except (BrokenPipeError, ConnectionResetError):
+      self.close_connection = True
+      return
+    try:
+      replicas = router._replicas(scene_id, cell=router.request_cell(req))
+    except Exception as e:  # noqa: BLE001 - the contract is 502, never 500
+      self._send_json({"error": f"routing failed: {e}"}, status=502,
+                      extra_headers=tid_hdr)
+      return
+    if not replicas:
+      self._send_json({"error": "no backends registered"}, status=503,
+                      extra_headers=tid_hdr)
+      return
+    request_class = self.headers.get(brownout_mod.REQUEST_CLASS_HEADER)
+    head_lines = [
+        b"POST /session HTTP/1.1",
+        b"Content-Type: application/json",
+        b"Content-Length: %d" % len(body),
+        b"traceparent: " + make_traceparent(trace_id).encode("ascii"),
+    ]
+    if request_class:
+      head_lines.append(
+          brownout_mod.REQUEST_CLASS_HEADER.encode("ascii") + b": "
+          + request_class.encode("latin-1"))
+    sock = None
+    attempts: list[str] = []
+    retry_afters: list[float] = []
+    for backend in replicas:
+      if backend.ejected:
+        retry_afters.append(1.0)
+        continue
+      if not backend.breaker.allow_primary():
+        retry_afters.append(backend.breaker.retry_after_s())
+        continue
+      host, _, port = backend.address.rpartition(":")
+      try:
+        sock = socket.create_connection((host, int(port)),
+                                        timeout=router.health_timeout_s)
+        lines = head_lines + [b"Host: " + backend.address.encode("ascii")]
+        sock.sendall(b"\r\n".join(lines) + b"\r\n\r\n" + body)
+      except OSError as e:
+        if sock is not None:
+          sock.close()
+          sock = None
+        backend.breaker.record_failure()
+        attempts.append(f"{backend.backend_id}: unreachable ({e})")
+        continue
+      backend.breaker.record_success()
+      router.metrics.record_session_proxy()
+      router.metrics.record_forward(backend.backend_id)
+      break
+    if sock is None:
+      if attempts:
+        router.metrics.record_replica_exhausted()
+        self._send_json({"error": f"all replicas failed for scene "
+                                  f"{scene_id!r}", "attempts": attempts},
+                        status=502, extra_headers=tid_hdr)
+      else:
+        router.metrics.record_breaker_fastfail()
+        retry_after = max(1, math.ceil(min(retry_afters))) \
+            if retry_afters else 1
+        self._send_json(
+            {"error": f"all replicas for scene {scene_id!r} are "
+                      "ejected or breaker-refused"}, status=503,
+            extra_headers={"Retry-After": str(retry_after), **tid_hdr})
+      return
+    # From here the handler is a byte pump; the connection never goes
+    # back into keep-alive rotation. Both hops carry small interactive
+    # frames, so Nagle + delayed ACK would stall them — disable it.
+    self.close_connection = True
+    sock.settimeout(None)
+    for conn in (sock, self.connection):
+      try:
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+      except OSError:
+        pass
+
+    def upstream():
+      try:
+        while True:
+          chunk = self.rfile.read1(65536)
+          if not chunk:
+            break
+          sock.sendall(chunk)
+      except (OSError, ValueError):
+        pass
+      finally:
+        try:
+          sock.shutdown(socket.SHUT_WR)
+        except OSError:
+          pass
+
+    pump = threading.Thread(target=upstream, daemon=True,
+                            name="mpi-router-session-up")
+    pump.start()
+    try:
+      while True:
+        chunk = sock.recv(65536)
+        if not chunk:
+          break
+        self.wfile.write(chunk)
+        self.wfile.flush()
+    except (OSError, ValueError):
+      pass
+    finally:
+      sock.close()
 
   def _do_gossip(self) -> None:
     """POST /gossip: a peer pushes its state, the reply is ours (one

@@ -21,12 +21,13 @@ different amounts:
   * **miss** — nothing close enough: the caller renders for real and
     ``put``s the result, populating the cell for everyone behind it.
 
-The near-miss search scans the scene's resident entries directly
-(picking the nearest by translation error among those under both
-thresholds) rather than probing lattice neighbors: the byte budget
-already bounds resident entries to ``budget / frame_bytes``, so the
-scan is small, and it finds the genuinely nearest frame instead of an
-arbitrary neighbor-cell order.
+The near-miss search is adaptive: while a scene has few residents it
+scans them directly, but past the size of the warp-radius neighborhood
+it probes the translation-cell buckets around the request instead —
+O(radius^3) dict probes rather than O(residents) pose errors, which
+matters once streaming-session trajectories leave hundreds of entries
+behind. Both paths pick the genuinely nearest candidate (translation
+error, under both thresholds), so the serving outcome is identical.
 
 ETags are per-entry nonces, not pure key hashes: an evicted cell
 re-populated by a *different* pose in the same cell would carry
@@ -40,6 +41,7 @@ from __future__ import annotations
 
 import dataclasses
 import hashlib
+import math
 import threading
 import time
 from collections import OrderedDict
@@ -47,6 +49,9 @@ from collections import OrderedDict
 import numpy as np
 
 from mpi_vision_tpu.serve.edge import lattice
+
+# Shared empty read-only bucket for neighborhood probes that miss.
+_NO_BUCKET: dict = {}
 
 
 @dataclasses.dataclass(frozen=True)
@@ -142,6 +147,12 @@ class EdgeFrameCache:
     # (scene_id, digest) -> {cell: entry}: the near-miss scan and the
     # invalidation sweep walk one scene's residents, not the whole LRU.
     self._by_scene: dict[tuple, dict[tuple, CachedFrame]] = {}
+    # (scene_id, digest) -> {(tx, ty, tz): {cell: entry}}: residents
+    # bucketed by translation cell, so the near-miss search probes the
+    # warp-radius neighborhood instead of scanning every resident — a
+    # session trajectory leaves hundreds of entries behind, and an O(n)
+    # scan under this lock was the serving ceiling.
+    self._by_trans: dict[tuple, dict[tuple, dict[tuple, CachedFrame]]] = {}
     # (scene_id, digest, cell) -> expiry clock time: view cells recently
     # shed queue-full. Consulted before the scheduler hand-off so a
     # saturated pose fails fast instead of re-queueing (negative_ttl_s).
@@ -159,6 +170,17 @@ class EdgeFrameCache:
   def cell_of(self, pose) -> tuple:
     return lattice.quantize_pose(pose, self.config.trans_cell,
                                  self.config.rot_bucket_deg)
+
+  def resident(self, scene_id: str, digest: str, cell) -> bool:
+    """Non-counting residency probe for one exact view cell.
+
+    The session prefetcher plans against cache state; its planning reads
+    must not pollute serving telemetry, so this neither bumps hit/miss
+    counters nor touches LRU order.
+    """
+    key = (str(scene_id), str(digest), tuple(cell))
+    with self._lock:
+      return key in self._entries
 
   # -- lookup -------------------------------------------------------------
 
@@ -199,8 +221,33 @@ class EdgeFrameCache:
     max_rot_deg = cfg.warp_max_rot_deg * warp_scale
     if max_trans <= 0 and max_rot_deg <= 0:
       return None
+    cells = self._by_scene.get((scene_id, digest), {})
+    if not cells:
+      return None
+    # A warp candidate's camera center lies within max_trans of the
+    # request's, so its translation cell is within ceil(max_trans/cell)
+    # lattice steps on every axis — probe that neighborhood when it is
+    # smaller than the resident set, else the straight scan is cheaper.
+    radius = math.ceil(max_trans / cfg.trans_cell) if max_trans > 0 else 0
+    span = 2 * radius + 1
+    if span ** 3 < len(cells):
+      buckets = self._by_trans.get((scene_id, digest), {})
+      t = np.asarray(pose, np.float64)[:3, 3]
+      tx = math.floor(t[0] / cfg.trans_cell)
+      ty = math.floor(t[1] / cfg.trans_cell)
+      tz = math.floor(t[2] / cfg.trans_cell)
+      candidates = [
+          entry
+          for dx in range(-radius, radius + 1)
+          for dy in range(-radius, radius + 1)
+          for dz in range(-radius, radius + 1)
+          for entry in buckets.get((tx + dx, ty + dy, tz + dz),
+                                   _NO_BUCKET).values()
+      ]
+    else:
+      candidates = cells.values()
     best, best_trans = None, None
-    for entry in self._by_scene.get((scene_id, digest), {}).values():
+    for entry in candidates:
       trans, rot_deg = lattice.pose_error(pose, entry.pose)
       if trans <= max_trans and rot_deg <= max_rot_deg \
           and (best is None or trans < best_trans):
@@ -239,6 +286,9 @@ class EdgeFrameCache:
       self._entries[key] = entry
       self._by_scene.setdefault((entry.scene_id, entry.digest),
                                 {})[entry.cell] = entry
+      self._by_trans.setdefault(
+          (entry.scene_id, entry.digest), {}).setdefault(
+              entry.cell[:3], {})[entry.cell] = entry
       self._bytes += entry.nbytes
       self._evict_locked()
       return entry
@@ -252,6 +302,15 @@ class EdgeFrameCache:
       cells.pop(entry.cell, None)
       if not cells:
         del self._by_scene[scene_key]
+    buckets = self._by_trans.get(scene_key)
+    if buckets is not None:
+      bucket = buckets.get(entry.cell[:3])
+      if bucket is not None:
+        bucket.pop(entry.cell, None)
+        if not bucket:
+          del buckets[entry.cell[:3]]
+      if not buckets:
+        del self._by_trans[scene_key]
 
   def _evict_locked(self) -> None:
     while self._bytes > self.config.byte_budget and len(self._entries) > 1:

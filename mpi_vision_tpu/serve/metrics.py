@@ -142,6 +142,21 @@ class ServeMetrics:
       self.brownout_sheds = {cls: 0 for cls in
                              ("interactive", "prefetch", "background")}
       self.brownout_degraded = {lvl: 0 for lvl in (1, 2, 3, 4)}
+      # Session-tier accounting (serve/session/): open/close/shed
+      # lifecycle, fused-flush shape, and the trajectory prefetcher's
+      # outcomes. Always present in the snapshot (zeros while sessions
+      # are off) so the mpi_serve_session_* families are always exposed.
+      self.session_opens = 0
+      self.session_closes = 0
+      self.session_rejects = 0
+      self.session_idle_reaps = 0
+      self.session_frames = 0
+      self.session_frame_errors = 0
+      self.session_flushes = 0
+      self.session_flush_poses = 0
+      self.session_prefetch_issued = 0
+      self.session_prefetch_hits = 0
+      self.session_prefetch_suppressed = 0
       # Per-scene latency breakdown (hot-scene regression hunting):
       # scene -> [count, sum_s, max_s, deque(recent latencies)].
       self._per_scene: dict = {}
@@ -388,6 +403,55 @@ class ServeMetrics:
     with self._lock:
       self.brownout_degraded[min(max(int(level), 1), 4)] += 1
 
+  def record_session_open(self) -> None:
+    """One streaming session admitted (POST /session accepted)."""
+    with self._lock:
+      self.session_opens += 1
+
+  def record_session_close(self, idle: bool = False) -> None:
+    """One session ended; ``idle`` marks reaper-driven closes."""
+    with self._lock:
+      self.session_closes += 1
+      if idle:
+        self.session_idle_reaps += 1
+
+  def record_session_reject(self) -> None:
+    """One session open shed at the bound (503 + Retry-After)."""
+    with self._lock:
+      self.session_rejects += 1
+
+  def record_session_flush(self, poses: int) -> None:
+    """One fused drain of a session's queue: ``poses`` submitted
+    concurrently so the scheduler can coalesce them into one flight."""
+    with self._lock:
+      self.session_flushes += 1
+      self.session_flush_poses += int(poses)
+
+  def record_session_frame(self) -> None:
+    """One frame streamed to a session client."""
+    with self._lock:
+      self.session_frames += 1
+
+  def record_session_frame_error(self) -> None:
+    """One session frame failed (shed/timeout/queue-full error frame)."""
+    with self._lock:
+      self.session_frame_errors += 1
+
+  def record_session_prefetch_issued(self) -> None:
+    """One speculative prefetch-class render issued for a predicted cell."""
+    with self._lock:
+      self.session_prefetch_issued += 1
+
+  def record_session_prefetch_hit(self) -> None:
+    """One real session frame served from a cell prefetch warmed."""
+    with self._lock:
+      self.session_prefetch_hits += 1
+
+  def record_session_prefetch_suppressed(self) -> None:
+    """One prefetch round skipped at brownout L3+ (predictor muted)."""
+    with self._lock:
+      self.session_prefetch_suppressed += 1
+
   def record_warp_pose_error(self, trans: float, rot_deg: float,
                              trace_id: str | None = None) -> None:
     """One edge warp-serve's pose error (how far the served frame's
@@ -507,6 +571,28 @@ class ServeMetrics:
               "sheds": dict(self.brownout_sheds),
               "degraded": {str(k): v
                            for k, v in self.brownout_degraded.items()},
+          },
+          # Session tier (serve/session/): counters here, live state
+          # ("enabled"/"active" and the knobs) overlaid by the service's
+          # stats() when a SessionManager is attached.
+          "session": {
+              "enabled": False,
+              "active": 0,
+              "opened": self.session_opens,
+              "closed": self.session_closes,
+              "rejected": self.session_rejects,
+              "idle_reaped": self.session_idle_reaps,
+              "frames": self.session_frames,
+              "frame_errors": self.session_frame_errors,
+              "flushes": self.session_flushes,
+              "mean_flush_size": (
+                  round(self.session_flush_poses / self.session_flushes, 3)
+                  if self.session_flushes else None),
+              "prefetch": {
+                  "issued": self.session_prefetch_issued,
+                  "hits": self.session_prefetch_hits,
+                  "suppressed": self.session_prefetch_suppressed,
+              },
           },
           # Native-histogram snapshots (JSON-ready, obs/hist.py): the
           # source for the mpi_serve_*_nativehist families, the request

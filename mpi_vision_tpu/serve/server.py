@@ -52,6 +52,13 @@ stdlib ``http.server`` front end:
                    inbound W3C ``traceparent`` header's trace-id is
                    honored as the id, so a fronting proxy can stitch
                    distributed traces.
+  POST /session -> pose-in / frame-out streaming session (503 unless
+                   built with ``session=``): JSON hello body
+                   {"scene_id": str} opens the session, then the same
+                   socket switches to length-prefixed binary frames —
+                   poses in, rendered frames out (serve/session/). The
+                   response streams with no Content-Length; 503 +
+                   Retry-After when the session bound is reached.
 
 Scenes register host-side (``add_scene``) and bake lazily through the
 LRU cache on first request, so cache hit/miss accounting reflects real
@@ -79,6 +86,7 @@ import hashlib
 import json
 import math
 import re
+import socket
 import threading
 import time
 import urllib.parse
@@ -124,6 +132,8 @@ from mpi_vision_tpu.serve.resilience import (
     TransientDeviceError,
 )
 from mpi_vision_tpu.serve.scheduler import MicroBatcher, QueueFullError
+from mpi_vision_tpu.serve.session import manager as session_mod
+from mpi_vision_tpu.serve.session import protocol as session_protocol
 
 
 def synthetic_scene(scene_id: str, height: int = 256, width: int = 256,
@@ -321,6 +331,7 @@ class RenderService:
                ship: "ship_mod.ShipConfig | ship_mod.TelemetryShipper | None" = None,
                attrib: "attrib_mod.AttribConfig | attrib_mod.AttribLedger | None" = None,
                incidents: "incident_mod.IncidentConfig | incident_mod.IncidentRecorder | None" = None,
+               session: "session_mod.SessionConfig | None" = None,
                metrics_ttl_s: float = 0.25, clock=time.monotonic):
     if cpu_fallback not in ("auto", "on", "off"):
       raise ValueError(
@@ -515,6 +526,12 @@ class RenderService:
             brownout, burn_fn=self.slo.fast_burn,
             queue_fn=self.scheduler.queue_fraction,
             on_transition=self._on_brownout_transition, clock=clock)
+    # Session tier (serve/session/): built after the brownout controller
+    # because the prefetcher reads its level (L3+ mutes the predictor)
+    # and after the scheduler because session frames ride render_request
+    # straight into it.
+    self.sessions = None if session is None else session_mod.SessionManager(
+        session, service=self, clock=clock)
     self._metrics_cache = prom.ExpositionCache(
         self._render_metrics_text, ttl_s=metrics_ttl_s, clock=clock)
     # Flight-recorder legs (obs/tsdb.py, obs/ship.py): configs build and
@@ -1256,6 +1273,23 @@ class RenderService:
     for scene in variants:
       for b in buckets:
         self.engine.render_batch(scene, np.broadcast_to(eye, (b, 4, 4)))
+    if self.edge is not None:
+      # The warp tier jits per frame shape too; without this, the first
+      # near-miss of each resolution pays its compile mid-stream — under
+      # a fused session flush that one slow frame stalls the whole
+      # flight behind it.
+      warmed: set[tuple[int, int]] = set()
+      for sid in ids:
+        try:
+          hw = self._full_hw(sid)
+          if hw in warmed:
+            continue
+          _, intrinsics, plane_depth, _ = self._edge_meta(sid)
+        except KeyError:
+          continue
+        warmed.add(hw)
+        frame = np.zeros((hw[0], hw[1], 3), np.float32)
+        warp_frame(frame, eye, eye, intrinsics, plane_depth)
 
   # -- request path -------------------------------------------------------
 
@@ -1571,6 +1605,21 @@ class RenderService:
       self.metrics.record_degraded(level)
     return img, info
 
+  def edge_cell_resident(self, scene_id: str, pose) -> tuple:
+    """``(view_cell, resident?)`` for a pose — the session prefetcher's
+    planning probe. Uses the edge cache's non-counting ``resident`` so
+    planning reads never pollute hit/miss telemetry. ``(None, True)``
+    when there is nothing to prefetch into (edge off, scene unknown)."""
+    if self.edge is None:
+      return None, True
+    try:
+      digest, _, _, _ = self._edge_meta(scene_id)
+    except KeyError:
+      return None, True
+    pose = np.asarray(pose, dtype=np.float32)
+    cell = self.edge.cell_of(pose)
+    return cell, self.edge.resident(str(scene_id), digest, cell)
+
   def edge_revalidate(self, scene_id: str, pose,
                       if_none_match: str | None) -> str | None:
     """The matching strong ETag when ``if_none_match`` still identifies
@@ -1679,6 +1728,10 @@ class RenderService:
       # Overlay the controller's live state onto the metrics block (the
       # snapshot's counters stay — they are the shed/degrade history).
       out["brownout"].update(self.brownout.snapshot())
+    if self.sessions is not None:
+      # Same overlay contract as brownout: live state from the manager,
+      # lifecycle/prefetch counters stay from the metrics snapshot.
+      out["session"].update(self.sessions.snapshot())
     out["events"] = {"emitted": self.events.emitted,
                      "dropped": self.events.dropped,
                      "sink_errors": self.events.sink_errors}
@@ -1809,6 +1862,10 @@ class RenderService:
         self.incidents.stop()
       if self.shipper is not None:
         self.shipper.stop()
+      # Sessions stop before the scheduler: their drain loops submit
+      # into it, and closing them first lets in-flight frames finish.
+      if self.sessions is not None:
+        self.sessions.close_all()
       self.scheduler.stop()
       with self._alert_hook_lock:
         hook_queue = self._alert_hook_queue
@@ -2108,7 +2165,96 @@ class _Handler(BaseHTTPRequestHandler):
     except RuntimeError as e:  # profiling not configured
       self._send_json({"error": str(e)}, status=503)
 
+  def _do_session(self):
+    """POST /session: one long-lived pose-in / frame-out exchange.
+
+    The JSON hello body rides the normal validation path (same length
+    cap and scene-id rules as /render); after the 200 the socket
+    switches to length-prefixed binary frames (serve/session/protocol).
+    Malformed pose streams close the session cleanly — an in-stream
+    error frame then the end frame, never a 500 and never a dead
+    dispatcher (the fuzz pin).
+    """
+    svc = self.service
+    inbound_tid = _inbound_trace_id(self.headers)
+    tid = inbound_tid or new_trace_id()
+    tid_hdr = {"X-Trace-Id": tid}
+    if svc.sessions is None:
+      self._send_json(
+          {"error": "sessions disabled: construct RenderService with "
+                    "session= (serve --session)"},
+          status=503, extra_headers=tid_hdr)
+      return
+    try:
+      length = int(self.headers.get("Content-Length", "0"))
+      if not 0 <= length <= _MAX_BODY_BYTES:
+        raise ValueError(f"bad body length ({length} bytes)")
+      req = json.loads(self.rfile.read(length) or b"{}")
+      if not isinstance(req, dict):
+        raise ValueError(f"body must be a JSON object, got {type(req).__name__}")
+      scene_id = req["scene_id"]
+      if not isinstance(scene_id, str):
+        raise ValueError(
+            f"scene_id must be a string, got {type(scene_id).__name__}")
+      if any(ord(c) < 0x20 for c in scene_id):
+        raise ValueError("scene_id must not contain control characters")
+    except (KeyError, TypeError, ValueError, json.JSONDecodeError) as e:
+      self._send_json({"error": f"bad request: {e}"}, status=400,
+                      extra_headers=tid_hdr)
+      return
+    except (BrokenPipeError, ConnectionResetError):
+      svc.metrics.record_client_disconnect()
+      self.close_connection = True
+      return
+    if svc.scene_entry(scene_id) is None:
+      self._send_json({"error": f"unknown scene {scene_id!r}"},
+                      status=404, extra_headers=tid_hdr)
+      return
+    try:
+      h, w = svc._full_hw(scene_id)
+    except KeyError:
+      self._send_json({"error": f"unknown scene {scene_id!r}"},
+                      status=404, extra_headers=tid_hdr)
+      return
+    try:
+      session = svc.sessions.open(
+          scene_id,
+          request_class=self.headers.get(brownout_mod.REQUEST_CLASS_HEADER))
+    except session_mod.SessionLimitError as e:
+      self._send_json(
+          {"error": str(e), "retry_after_s": e.retry_after_s}, status=503,
+          extra_headers={"Retry-After": str(max(1, math.ceil(e.retry_after_s))),
+                         **tid_hdr})
+      return
+    # The exchange owns the socket from here: stream with no
+    # Content-Length, and never reuse the connection afterwards.
+    self.close_connection = True
+    try:
+      # Frames are small and interactive; Nagle + delayed ACK would
+      # stall the stream for tens of milliseconds per exchange.
+      self.connection.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    except OSError:
+      pass
+    try:
+      self.send_response(200)
+      self.send_header("Content-Type", "application/octet-stream")
+      self.send_header("X-Trace-Id", tid)
+      self.send_header("X-Session-Id", session.session_id)
+      self.send_header("Connection", "close")
+      self.end_headers()
+      self.wfile.write(session_protocol.pack_hello(
+          session.session_id, scene_id, (h, w, 3)))
+      self.wfile.flush()
+      session.serve_stream(self.rfile, self.wfile)
+    except (BrokenPipeError, ConnectionResetError):
+      svc.metrics.record_client_disconnect()
+    finally:
+      session.close(session.close_reason)
+
   def do_POST(self):  # noqa: N802 - stdlib name
+    if self.path == "/session":
+      self._do_session()
+      return
     if self.path != "/render":
       self._send_json({"error": f"unknown path {self.path}"}, status=404)
       return

@@ -32,6 +32,7 @@ import mpi_vision_tpu.serve
 import mpi_vision_tpu.serve.assets
 import mpi_vision_tpu.serve.cluster
 import mpi_vision_tpu.serve.edge
+import mpi_vision_tpu.serve.session
 import mpi_vision_tpu.train.faultinject
 import mpi_vision_tpu.train.loop
 import mpi_vision_tpu.train.queue
@@ -49,6 +50,7 @@ def _package_sources(pkg):
 def _linted_sources():
   for pkg in (mpi_vision_tpu.serve, mpi_vision_tpu.serve.assets,
               mpi_vision_tpu.serve.cluster, mpi_vision_tpu.serve.edge,
+              mpi_vision_tpu.serve.session,
               mpi_vision_tpu.obs, mpi_vision_tpu.ckpt):
     yield from _package_sources(pkg)
   yield pathlib.Path(mpi_vision_tpu.train.loop.__file__)
@@ -105,6 +107,11 @@ def test_lint_covers_the_ckpt_package_and_train_loop():
           # ride the same injected clocks as the checkpoint watcher.
           "assets/store.py", "assets/fetch.py",
           "edge/cache.py", "edge/lattice.py", "edge/warp.py",
+          # The session tier (PR 20): idle reaping and frame deadlines
+          # ride the manager's injectable clock — a bare call would
+          # make reap tests flaky and weld idle timeouts to wall time.
+          "session/manager.py", "session/protocol.py",
+          "session/predictor.py",
           "obs/slo.py", "obs/events.py", "obs/trace.py",
           "obs/prom.py", "obs/hist.py", "obs/tsdb.py",
           "obs/ship.py",

@@ -20,25 +20,6 @@ import sys
 import pytest
 
 
-def _run_dry(extra_args=()):
-  repo = os.path.dirname(os.path.dirname(os.path.dirname(
-      os.path.abspath(__file__))))
-  sys.path.insert(0, repo)
-  from _cpu_mesh import hardened_env
-
-  env = hardened_env(1)
-  env["SERVE_LOAD_DRY"] = "1"
-  # Share the suite's persistent XLA cache so reruns skip the compiles.
-  env["JAX_COMPILATION_CACHE_DIR"] = os.path.join(repo, ".jax_cache")
-  proc = subprocess.run(
-      [sys.executable, os.path.join(repo, "bench", "serve_load.py"),
-       *extra_args],
-      capture_output=True, text=True, timeout=1200, env=env, cwd=repo)
-  assert proc.returncode == 0, (
-      f"serve_load dry run failed:\n{proc.stderr[-3000:]}")
-  return json.loads(proc.stdout.strip().splitlines()[-1])
-
-
 _SHARED_DRY_MODES = [
     ("trace", ["--trace"]),
     ("ab", ["--ab"]),
@@ -47,6 +28,9 @@ _SHARED_DRY_MODES = [
     # poses served, not a long window.
     ("tiled_ab", ["--tiled-ab", "--duration", "1"]),
     ("asset_ab", ["--asset-ab"]),
+    ("session_ab", ["--session-ab"]),
+    # {incident_dir} is substituted by the fixture (tmp dir per run).
+    ("overload_ab", ["--overload-ab", "--incident-dir", "{incident_dir}"]),
     ("chaos", ["--chaos"]),
 ]
 
@@ -64,22 +48,9 @@ for name, argv in json.loads(sys.argv[2]):
 """
 
 
-@pytest.fixture(scope="module")
-def shared_dry_runs():
-  """ONE subprocess runs every single-process dry smoke back to back.
-
-  Each dry run is a full JAX child-process spawn — the unit of cost in
-  this file — but the six single-process modes (trace, ab, edge-ab,
-  tiled-ab, asset-ab, chaos) share no cross-run state: every
-  ``serve_load.main(argv)`` call builds its own scenes, service, and
-  workers and tears them down. Driving them sequentially through one
-  interpreter pays the import + jit-warmup tax once (later runs also
-  reuse the process-global compile cache). Budget reclamation round 3
-  merged the headline+trace spawns; round 4 folds the other four
-  single-process smokes in too. The cluster drills keep their own
-  subprocesses: they spawn backend pools and must not share this one.
-  Returns {mode_name: parsed JSON record}.
-  """
+def _drive_shared(modes, timeout_s=1200):
+  """Run a list of ``(name, argv)`` serve_load modes through ONE child
+  interpreter; returns {mode_name: parsed JSON record}."""
   repo = os.path.dirname(os.path.dirname(os.path.dirname(
       os.path.abspath(__file__))))
   sys.path.insert(0, repo)
@@ -89,18 +60,44 @@ def shared_dry_runs():
   env["SERVE_LOAD_DRY"] = "1"
   env["JAX_COMPILATION_CACHE_DIR"] = os.path.join(repo, ".jax_cache")
   proc = subprocess.run(
-      [sys.executable, "-c", _SHARED_DRY_DRIVER, repo,
-       json.dumps(_SHARED_DRY_MODES)],
-      capture_output=True, text=True, timeout=1200, env=env, cwd=repo)
+      [sys.executable, "-c", _SHARED_DRY_DRIVER, repo, json.dumps(modes)],
+      capture_output=True, text=True, timeout=timeout_s, env=env, cwd=repo)
   assert proc.returncode == 0, (
       f"shared dry driver failed:\n{proc.stderr[-3000:]}")
   lines = [l for l in proc.stdout.strip().splitlines()
            if l.startswith("{")]
-  assert len(lines) == len(_SHARED_DRY_MODES), (
-      f"expected {len(_SHARED_DRY_MODES)} JSON lines, got {len(lines)}:"
+  assert len(lines) == len(modes), (
+      f"expected {len(modes)} JSON lines, got {len(lines)}:"
       f"\n{proc.stdout[-2000:]}")
   return {name: json.loads(line)
-          for (name, _), line in zip(_SHARED_DRY_MODES, lines)}
+          for (name, _), line in zip(modes, lines)}
+
+
+@pytest.fixture(scope="module")
+def shared_dry_runs(tmp_path_factory):
+  """ONE subprocess runs every single-process dry smoke back to back.
+
+  Each dry run is a full JAX child-process spawn — the unit of cost in
+  this file — but the single-process modes (trace, ab, edge-ab,
+  tiled-ab, asset-ab, session-ab, overload-ab, chaos) share no
+  cross-run state: every ``serve_load.main(argv)`` call builds its own
+  scenes, service, and workers and tears them down. Driving them
+  sequentially through one interpreter pays the import + jit-warmup tax
+  once (later runs also reuse the process-global compile cache). Budget
+  reclamation round 3 merged the headline+trace spawns; round 4 folded
+  the other single-process smokes in; round 5 (session tier) adds
+  session-ab and folds the overload-ab spawn in too — reclaiming more
+  spawn tax than the new session arms add. The cluster drills keep
+  their own pool-spawning subprocess (shared among themselves, below).
+  Returns {mode_name: parsed JSON record}.
+  """
+  incident_dir = str(tmp_path_factory.mktemp("bb"))
+  modes = [(name, [a.replace("{incident_dir}", incident_dir)
+                   for a in argv])
+           for name, argv in _SHARED_DRY_MODES]
+  runs = _drive_shared(modes)
+  runs["overload_ab"]["_incident_dir"] = incident_dir
+  return runs
 
 
 @pytest.fixture(scope="module")
@@ -280,6 +277,56 @@ def test_serve_load_asset_ab_dry_smoke(shared_dry_runs):
       out["diff_sync"]["bytes"] / out["full_checkpoint_bytes"], 4)
 
 
+def test_serve_load_session_ab_dry_smoke(shared_dry_runs):
+  """The session tier's tier-1 smoke (PR 20's acceptance pin): the same
+  smooth-trajectory pose load driven through streaming sessions and
+  through one POST /render per frame, one JSON line. The pins are
+  structural, not latency-noise: the session arm's pipelined flushes
+  reach a deeper effective concurrency than request-per-frame's (so it
+  must not LOSE on throughput), flushes really fuse (>1 poses per
+  drain), the trajectory predictor's speculative renders land cells the
+  camera then arrives in (prefetch hits > 0), and session frames are
+  BIT-IDENTICAL to the unbatched render path (the bench itself aborts
+  on a parity mismatch — reaching the JSON is the proof)."""
+  out = shared_dry_runs["session_ab"]
+  assert out["metric"] == "serve_load_session_ab" and out["dry"] is True
+  # Throughput: fusion + pipelining must at least match one-request-at-
+  # a-time HTTP (in practice the dry margin is ~2x; >= 1 absorbs noise).
+  assert out["value"] >= 1.0
+  assert out["frames_per_sec_session"] > 0
+  assert out["frames_per_sec_request"] > 0
+  # Flight fusion really happened: multi-pose drains, and the fused
+  # flushes coalesced into larger device batches than request-per-frame.
+  assert out["mean_flush_size"] > 1.0
+  assert out["mean_batch_size_session"] > out["mean_batch_size_request"]
+  # Trajectory-predictive prefetch: speculative renders were issued and
+  # some were consumed as exact edge hits by the advancing camera.
+  assert out["prefetch"]["issued"] > 0
+  assert out["prefetch"]["hits"] > 0
+  assert out["prefetch"]["hit_rate"] > 0
+  # The PINNED bit-exactness: streamed frames == unbatched renders.
+  assert out["parity"]["bit_exact"] is True and out["parity"]["poses"] >= 1
+  session_arm = out["session"]
+  # Sessions opened, streamed, and closed cleanly; the /stats session
+  # block rode the record.
+  sess = session_arm["session"]
+  assert sess["enabled"] is True
+  assert sess["opened"] >= 1 and sess["closed"] == sess["opened"]
+  assert sess["rejected"] == 0 and sess["frame_errors"] == 0
+  assert sess["frames"] == session_arm["frames"]
+  # Full per-request semantics: every session frame (and prefetch) went
+  # through the front door — SLO judged them, and the attribution
+  # ledger reconciles exactly with prefetch attributed to its own class.
+  assert session_arm["slo"]["pass"] is True
+  assert session_arm["attrib"]["conservation"]["ok"] is True
+  assert session_arm["device_seconds_by_class"]["prefetch"] > 0
+  prefetch_cells = [c for c in session_arm["attrib"]["top_cells"]
+                    if c["class"] == "prefetch"]
+  assert prefetch_cells and all(
+      c["scene"].startswith("scene_") for c in prefetch_cells)
+  assert out["request"]["attrib"]["conservation"]["ok"] is True
+
+
 def test_cluster_kill_failover_drill_on_shared_pool(healed_backends):
   """The multi-host failover drill, in-process on the SESSION pool
   (budget reclamation round 4: this was the ``--cluster`` dry
@@ -351,14 +398,28 @@ def test_cluster_kill_failover_drill_on_shared_pool(healed_backends):
 # bench flag wiring stays guarded in test_cli. One fewer 19s JAX spawn.
 
 
-def test_serve_load_cluster_chaos_router_dry_smoke():
+@pytest.fixture(scope="module")
+def cluster_dry_runs():
+  """The two cluster drills (router-HA chaos + autoscale A/B) through
+  ONE child interpreter — budget reclamation round 5. Each drill still
+  spawns its own backend pool (that is the drill), but the parent's
+  JAX import + warmup tax is paid once instead of twice. They stay out
+  of ``shared_dry_runs``: pool spawns must not contend with the
+  single-process modes' in-process servers."""
+  return _drive_shared([
+      ("chaos_router", ["--cluster", "--chaos-router"]),
+      ("autoscale_ab", ["--cluster", "--autoscale-ab"]),
+  ])
+
+
+def test_serve_load_cluster_chaos_router_dry_smoke(cluster_dry_runs):
   """The router-HA drill's tier-1 smoke (ISSUE 15's acceptance pin):
   TWO gossiping router replicas front the pool, closed-loop clients
   hammer the SURVIVOR, and the supervising router is SIGKILLed
   mid-window. The run must record zero failed requests on the survivor,
   a bounded lease takeover, and a backend killed AFTER the takeover
   respawned by the new leader through the --restart-hook webhook."""
-  out = _run_dry(["--cluster", "--chaos-router"])
+  out = cluster_dry_runs["chaos_router"]
   assert out["metric"] == "serve_load" and out["dry"] is True
   assert out["renders_per_sec"] > 0 and out["requests"] > 0
   cluster = out["cluster"]
@@ -389,7 +450,7 @@ def test_serve_load_cluster_chaos_router_dry_smoke():
   assert drill["gossip"]["rounds"] > 0
 
 
-def test_serve_load_autoscale_ab_dry_smoke():
+def test_serve_load_autoscale_ab_dry_smoke(cluster_dry_runs):
   """The elastic-fleet A/B's tier-1 smoke (PR 19's acceptance pin):
   the same bounded-queue surge replayed against a fixed single-backend
   pool and an autoscaled one, one JSON line. The pins: the autoscaler
@@ -399,7 +460,7 @@ def test_serve_load_autoscale_ab_dry_smoke():
   bounded queue; scaled capacity can — a capacity bound, deterministic
   where dry-scale latency quantiles are not), SHRINKS back in the idle
   tail, and drops ZERO requests inside any scale-down window."""
-  out = _run_dry(["--cluster", "--autoscale-ab"])
+  out = cluster_dry_runs["autoscale_ab"]
   assert out["metric"] == "serve_load_autoscale_ab" and out["dry"] is True
   fixed, scaled = out["fixed"], out["autoscale"]
   # THE verdict contrast: same ramp, same objective, opposite verdicts.
@@ -465,7 +526,7 @@ def test_serve_load_chaos_dry_smoke(shared_dry_runs):
   assert slo["objectives"]["availability"]["requests"] >= out["requests"]
 
 
-def test_serve_load_overload_ab_dry_smoke(tmp_path):
+def test_serve_load_overload_ab_dry_smoke(shared_dry_runs):
   """The brownout A/B's tier-1 smoke: one process, a ~3x phased
   overload ramp driven twice — ladder armed, then shed-only — and one
   JSON line. Dry scale pins MECHANICS only (same contract as the --ab
@@ -482,8 +543,10 @@ def test_serve_load_overload_ab_dry_smoke(tmp_path):
   device-seconds split is computed, and the deterministic incident
   drill captures exactly the induced bundle end-to-end — alert edge ->
   black-box file on disk — without a second subprocess."""
-  out = _run_dry(["--overload-ab", "--incident-dir",
-                  str(tmp_path / "bb")])
+  import pathlib
+
+  out = shared_dry_runs["overload_ab"]
+  incident_dir = pathlib.Path(out["_incident_dir"])
   assert out["metric"] == "serve_load_overload_ab" and out["dry"] is True
   assert out["latency_threshold_ms"] > 0  # calibrated, not hardcoded
   brownout, shed_only = out["brownout"], out["shed_only"]
@@ -528,5 +591,5 @@ def test_serve_load_overload_ab_dry_smoke(tmp_path):
   assert drill["alert"]
   assert drill["attrib_cells"] >= 1
   assert drill["conservation_ok"] is True
-  bundles = list((tmp_path / "bb" / "drill").glob("incident-*.json"))
+  bundles = list((incident_dir / "drill").glob("incident-*.json"))
   assert len(bundles) >= 1

@@ -1,0 +1,350 @@
+"""Session tier (PR 20): pose-in/frame-out streaming over POST /session.
+
+Pins the contracts ISSUE 20 names: frames arrive in pose order and stay
+bit-identical to the unbatched render path (fusion changes scheduling,
+never pixels); a hostile pose stream — unknown kind, truncated payload,
+oversize declared length, non-finite pose — closes THAT session cleanly
+(in-stream error frame then end frame), never a 500 and never a dead
+dispatcher (mirroring tests/serve/test_http_fuzz.py); opens past the
+session bound shed with 503 + Retry-After; idle sessions are reaped on
+the manager's injectable clock; brownout L3+ mutes the prefetch
+predictor at the source; and the attribution ledger's conservation
+invariant holds with session frames included.
+"""
+
+import http.client
+import json
+import socket
+import struct
+import threading
+
+import numpy as np
+import pytest
+
+from mpi_vision_tpu.obs.attrib import AttribConfig
+from mpi_vision_tpu.serve import RenderService, make_http_server
+from mpi_vision_tpu.serve.metrics import ServeMetrics
+from mpi_vision_tpu.serve.session import (
+    SessionClient,
+    SessionConfig,
+    SessionManager,
+    SessionOpenError,
+    protocol,
+)
+from mpi_vision_tpu.serve.session.manager import SessionLimitError
+
+
+@pytest.fixture(scope="module")
+def served():
+  # Edge cache off: every session frame is a real render, so the
+  # bit-exactness pin compares like with like. Attribution on: session
+  # frames must land in the ledger and keep conservation true.
+  svc = RenderService(max_batch=4, max_wait_ms=0.5, resilience=None,
+                      attrib=AttribConfig(),
+                      session=SessionConfig(max_sessions=2, fuse_max=2,
+                                            prefetch_horizon=0))
+  svc.add_synthetic_scenes(1, height=16, width=16, planes=2)
+  httpd = make_http_server(svc, port=0)
+  thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+  thread.start()
+  try:
+    yield svc, httpd.server_address[1]
+  finally:
+    httpd.shutdown()
+    svc.close()
+
+
+def _poses(n):
+  out = []
+  for i in range(n):
+    pose = np.eye(4, dtype=np.float32)
+    pose[0, 3] = 0.05 * i
+    pose[2, 3] = 2.0 + 0.02 * i
+    out.append(pose)
+  return out
+
+
+def _post(port, body: bytes, path="/render"):
+  conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+  try:
+    conn.request("POST", path, body=body,
+                 headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    return resp.status, dict(resp.getheaders()), resp.read()
+  finally:
+    conn.close()
+
+
+def _render_body():
+  return json.dumps({"scene_id": "scene_000",
+                     "pose": np.eye(4).tolist()}).encode()
+
+
+def _drain_events(client):
+  """Read server frames until end-of-stream/EOF; returns [(kind, parsed)]."""
+  events = []
+  while True:
+    event = client.read_event()
+    if event is None:
+      return events
+    events.append(event)
+    if event[0] == protocol.KIND_END:
+      return events
+
+
+# -- happy path -----------------------------------------------------------
+
+
+def test_session_streams_frames_in_pose_order(served):
+  svc, port = served
+  poses = _poses(5)
+  with SessionClient("127.0.0.1", port, "scene_000") as client:
+    assert client.session_id
+    assert client.shape == (16, 16, 3)
+    for pose in poses:
+      client.send_pose(pose)
+    client.end()
+    frames = list(client.frames())
+  assert [seq for seq, _ in frames] == list(range(len(poses)))
+  for _, img in frames:
+    assert img.shape == (16, 16, 3) and img.dtype == np.float32
+    assert np.all(np.isfinite(img))
+  assert svc.scheduler.dispatcher_alive()
+
+
+def test_session_frames_bit_identical_to_unbatched_renders(served):
+  """Fusion changes scheduling, never pixels (the ISSUE-20 parity pin)."""
+  svc, port = served
+  poses = _poses(4)
+  with SessionClient("127.0.0.1", port, "scene_000") as client:
+    for pose in poses:
+      client.send_pose(pose)
+    client.end()
+    frames = dict(client.frames())
+  assert len(frames) == len(poses)
+  for seq, pose in enumerate(poses):
+    solo = np.asarray(svc.render("scene_000", pose))
+    np.testing.assert_array_equal(frames[seq], solo)
+
+
+def test_stats_and_metrics_expose_the_session_block(served):
+  svc, port = served
+  with SessionClient("127.0.0.1", port, "scene_000") as client:
+    client.send_pose(np.eye(4))
+    client.end()
+    assert len(list(client.frames())) == 1
+  block = svc.stats()["session"]
+  assert block["enabled"] is True
+  assert block["max_sessions"] == 2 and block["fuse_max"] == 2
+  assert block["opened"] >= 1 and block["closed"] >= 1
+  assert block["frames"] >= 1 and block["frame_errors"] == 0
+  assert block["flushes"] >= 1 and block["active"] == 0
+
+
+def test_attrib_conservation_holds_with_session_frames(served):
+  svc, port = served
+  with SessionClient("127.0.0.1", port, "scene_000") as client:
+    for pose in _poses(3):
+      client.send_pose(pose)
+    client.end()
+    assert len(list(client.frames())) == 3
+  attrib = svc.stats()["attrib"]
+  assert attrib["conservation"]["ok"], attrib["conservation"]
+  assert attrib["totals"]["requests"] >= 3
+
+
+# -- hello validation -----------------------------------------------------
+
+
+@pytest.mark.parametrize("body", [
+    b"",                                         # empty -> KeyError
+    b"not json at all",
+    b"[1, 2, 3]",                                # not an object
+    b"{\"scene_id\": 7}",                        # non-string scene id
+    json.dumps({"scene_id": "scene_000\x1ft0,0"}).encode(),  # control char
+], ids=["empty", "notjson", "array", "intid", "ctrlchar"])
+def test_malformed_hello_is_400(served, body):
+  svc, port = served
+  status, headers, payload = _post(port, body, path="/session")
+  assert status == 400, payload
+  assert "error" in json.loads(payload)
+  assert headers.get("X-Trace-Id")
+  assert svc.scheduler.dispatcher_alive()
+
+
+def test_unknown_scene_hello_is_404(served):
+  svc, port = served
+  status, _, payload = _post(port, json.dumps({"scene_id": "nope"}).encode(),
+                             path="/session")
+  assert status == 404, payload
+  assert svc.scheduler.dispatcher_alive()
+
+
+def test_sessions_disabled_is_503():
+  # No session= -> POST /session refuses before touching scenes.
+  svc = RenderService(max_batch=2, max_wait_ms=0.5, resilience=None)
+  httpd = make_http_server(svc, port=0)
+  threading.Thread(target=httpd.serve_forever, daemon=True).start()
+  try:
+    port = httpd.server_address[1]
+    status, _, payload = _post(port, json.dumps({"scene_id": "x"}).encode(),
+                               path="/session")
+    assert status == 503
+    assert "disabled" in json.loads(payload)["error"]
+    with pytest.raises(SessionOpenError) as err:
+      SessionClient("127.0.0.1", port, "x")
+    assert err.value.status == 503
+  finally:
+    httpd.shutdown()
+    svc.close()
+
+
+# -- pose-stream fuzz -----------------------------------------------------
+
+_FUZZ_STREAMS = [
+    ("unknown_kind", struct.pack("<cI", b"Z", 0)),
+    ("server_only_kind", struct.pack("<cI", b"F", 4) + b"\x00" * 4),
+    ("oversize_length", struct.pack("<cI", b"P", 1 << 20)),
+    ("short_pose", struct.pack("<cI", b"P", 10) + b"\x00" * 10),
+    ("nonfinite_pose",
+     struct.pack("<cI", b"P", protocol.POSE_BYTES)
+     + np.full((4, 4), np.nan, dtype="<f4").tobytes()),
+    ("truncated_payload", struct.pack("<cI", b"P", protocol.POSE_BYTES)
+     + b"\x00" * 10),  # fewer bytes than declared, then write-side close
+]
+
+
+@pytest.mark.parametrize("raw", [r for _, r in _FUZZ_STREAMS],
+                         ids=[n for n, _ in _FUZZ_STREAMS])
+def test_hostile_pose_stream_closes_cleanly(served, raw):
+  """Any framing garbage -> in-stream error frame then end frame; the
+  session dies, the service doesn't."""
+  svc, port = served
+  with SessionClient("127.0.0.1", port, "scene_000") as client:
+    client.send_pose(np.eye(4))  # a good pose first: its frame must land
+    client.send_raw(raw)
+    # EOF is the only way the server can detect a payload that never
+    # finishes arriving; harmless for the other cases.
+    client.sock.shutdown(socket.SHUT_WR)
+    events = _drain_events(client)
+  kinds = [kind for kind, _ in events]
+  assert kinds, "server closed without an end frame"
+  assert kinds[0] == protocol.KIND_FRAME  # the good pose rendered
+  assert kinds[-1] == protocol.KIND_END
+  assert protocol.KIND_ERROR in kinds
+  error = next(parsed for kind, parsed in events
+               if kind == protocol.KIND_ERROR)
+  assert "bad pose stream" in error["error"]
+  assert set(kinds) <= {protocol.KIND_FRAME, protocol.KIND_ERROR,
+                        protocol.KIND_END}
+  # The barrage cost one session, nothing else.
+  assert svc.scheduler.dispatcher_alive()
+  status, _, _ = _post(port, _render_body())
+  assert status == 200
+  assert svc.stats()["session"]["active"] == 0
+
+
+def test_midstream_disconnect_does_not_kill_the_service(served):
+  svc, port = served
+  client = SessionClient("127.0.0.1", port, "scene_000")
+  client.send_pose(np.eye(4))
+  client.close()  # vanish without an end frame
+  # The reaper path is exercised elsewhere; here the read loop sees EOF.
+  status, _, _ = _post(port, _render_body())
+  assert status == 200
+  assert svc.scheduler.dispatcher_alive()
+
+
+# -- session bound --------------------------------------------------------
+
+
+def test_opens_past_the_bound_shed_503_with_retry_after(served):
+  svc, port = served
+  held = [SessionClient("127.0.0.1", port, "scene_000") for _ in range(2)]
+  try:
+    status, headers, payload = _post(
+        port, json.dumps({"scene_id": "scene_000"}).encode(), path="/session")
+    assert status == 503, payload
+    assert int(headers["Retry-After"]) >= 1
+    assert json.loads(payload)["retry_after_s"] == pytest.approx(1.0)
+    with pytest.raises(SessionOpenError) as err:
+      SessionClient("127.0.0.1", port, "scene_000")
+    assert err.value.status == 503
+  finally:
+    for client in held:
+      client.end()
+      _drain_events(client)
+      client.close()
+  assert svc.stats()["session"]["rejected"] >= 2
+  # The bound frees as sessions close: a new open succeeds.
+  with SessionClient("127.0.0.1", port, "scene_000") as client:
+    client.send_pose(np.eye(4))
+    client.end()
+    assert len(list(client.frames())) == 1
+
+
+# -- manager units: idle reap on a fake clock, brownout prefetch mute -----
+
+
+class _StubService:
+  """The slice of RenderService the manager touches in these units."""
+
+  def __init__(self):
+    self.metrics = ServeMetrics()
+    self.edge = None
+    self.brownout = None
+
+  def edge_cell_resident(self, scene_id, pose):
+    return None, False  # no lattice -> nothing to prefetch into
+
+
+def test_idle_sessions_reap_on_the_injected_clock():
+  t = [100.0]
+  svc = _StubService()
+  mgr = SessionManager(SessionConfig(max_sessions=1, idle_timeout_s=5.0),
+                       service=svc, clock=lambda: t[0])
+  session = mgr.open("scene_000")
+  assert mgr.active == 1
+  with pytest.raises(SessionLimitError):
+    mgr.open("scene_000")  # at the bound
+  t[0] += 4.0
+  assert mgr.reap_idle() == []  # inside the timeout: untouched
+  t[0] += 2.0  # 6 s idle total > 5 s
+  assert mgr.reap_idle() == [session.session_id]
+  assert session.closed and session.close_reason == "idle"
+  assert mgr.active == 0
+  snap = svc.metrics.snapshot()["session"]
+  assert snap["idle_reaped"] == 1 and snap["closed"] == 1
+  # open() reaps before counting, so the freed slot admits the next open.
+  t[0] += 100.0
+  replacement = mgr.open("scene_000")
+  assert mgr.active == 1
+  replacement.close()
+
+
+def test_brownout_l3_mutes_the_prefetch_predictor():
+  svc = _StubService()
+  svc.edge = object()  # non-None: prefetch would otherwise engage
+
+  class _Brownout:
+    level = 3
+
+  svc.brownout = _Brownout()
+  mgr = SessionManager(SessionConfig(prefetch_horizon=2), service=svc)
+  session = mgr.open("scene_000")
+  try:
+    for pose in _poses(4):
+      session._maybe_prefetch([pose])
+    snap = svc.metrics.snapshot()["session"]["prefetch"]
+    assert snap["issued"] == 0
+    assert snap["suppressed"] == 4  # muted at the source every flush
+    # Below L3 the ladder admits the class again: the predictor runs
+    # (nothing resident to skip in the stub) and nothing is suppressed.
+    svc.brownout.level = 2
+    for pose in _poses(4):
+      session._maybe_prefetch([pose])
+    snap = svc.metrics.snapshot()["session"]["prefetch"]
+    assert snap["suppressed"] == 4
+  finally:
+    session.close()
+    mgr.close_all()

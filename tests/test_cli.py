@@ -203,6 +203,26 @@ def test_incident_profile_without_profile_dir_rejected(tmp_path):
               "--incident-profile", "0.5", "--duration", "0.1"])
 
 
+@pytest.mark.parametrize("flag,value", [
+    ("--session-max", "4"),
+    ("--session-idle-s", "10"),
+    ("--session-fuse", "2"),
+    ("--session-prefetch", "3"),
+])
+def test_session_knobs_without_session_rejected(flag, value):
+  """Session knobs only shape a tier that exists; dangling they'd
+  silently leave POST /session a 503."""
+  with pytest.raises(SystemExit, match=r"require\(s\) --session"):
+    cli.main(["serve", flag, value, "--duration", "0.1"])
+
+
+def test_bad_session_config_rejected():
+  """SessionConfig validation surfaces as a CLI error, not a traceback."""
+  with pytest.raises(SystemExit, match="bad session config"):
+    cli.main(["serve", "--session", "--session-max", "0",
+              "--duration", "0.1"])
+
+
 def test_cluster_rolling_restart_requires_a_local_pool():
   """--join fronts backends some OTHER supervisor owns; a rolling
   restart needs process control. (--supervise on --join is legal now:
